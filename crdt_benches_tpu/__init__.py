@@ -1,0 +1,32 @@
+"""crdt_benches_tpu — a TPU-native batched CRDT replay/merge framework.
+
+A ground-up reimplementation of the capability surface of noib3/crdt-benches
+(reference: /root/reference, a single-threaded Rust Criterion harness replaying
+collaborative-editing traces through four CRDT libraries), re-designed for TPU:
+
+- trace replay over many simulated replicas at once as padded (replica x op)
+  integer tensors (``jax.vmap`` / ``shard_map`` over a ``replicas`` mesh axis),
+- sequence-CRDT position resolution and tombstone handling as scan/prefix-sum
+  kernels under ``jax.lax.scan`` (the sequential per-op dependency of the
+  reference's hot loop, src/main.rs:30-34, restructured around scans),
+- cross-replica update exchange and convergence checking via XLA collectives
+  (``psum`` / ``all_gather``) over a device mesh,
+- a C++ native tier (CPU rope baseline + op-log CRDT engine) mirroring the
+  reference's native (Rust) components,
+- a Criterion-equivalent measurement harness (warmup, sampling, throughput in
+  elements/sec where element = one trace patch, src/main.rs:25).
+
+Package layout:
+  traces/    trace loading + tensorization (L1)
+  oracle/    pure-Python ground-truth document replay + RGA merge oracle
+  ops/       JAX kernels: within-batch resolution, batch merge, decode
+  engine/    replica state pytrees, full-trace replay, downstream apply
+  models/    CRDT model families (RGA tree model, etc.)
+  parallel/  mesh helpers, shard_map replay, collective convergence
+  backends/  pluggable Upstream/Downstream backends (JAX, C++ rope, C++ CRDT,
+             pure Python) behind one trait, per-backend offset units
+  bench/     criterion-equivalent harness + bench matrix runner
+  utils/     config, profiling, digests
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,71 @@
+"""Pure-Python ground-truth document replay (the framework's oracle).
+
+The reference's only correctness check is a length-only assert inside the
+timed loop (src/main.rs:35,68).  This oracle upgrades that to **byte-identical
+final document content**: every other backend (JAX engine, C++ rope, C++ CRDT)
+is differentially tested against it (SURVEY.md section 4, rebuild implication).
+
+``OracleDocument`` also implements the Upstream-trait surface of the reference
+(``from_str`` / ``insert`` / ``remove`` / ``len`` / ``replace``,
+src/rope.rs:6-33) so it can serve as the pure-Python backend in the bench
+matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..traces.loader import TestData
+from ..traces.tensorize import DELETE, INSERT
+
+
+class OracleDocument:
+    """A trivial char-list document.  Char (codepoint) offsets."""
+
+    NAME = "python-oracle"
+    EDITS_USE_BYTE_OFFSETS = False
+
+    def __init__(self, content: str = ""):
+        self._chars: list[str] = list(content)
+
+    @classmethod
+    def from_str(cls, s: str) -> "OracleDocument":
+        return cls(s)
+
+    def insert(self, at: int, text: str) -> None:
+        self._chars[at:at] = list(text)
+
+    def remove(self, start: int, end: int) -> None:
+        del self._chars[start:end]
+
+    def replace(self, start: int, end: int, text: str) -> None:
+        # remove-then-insert, as the reference's default impl (src/rope.rs:21-32)
+        self._chars[start:end] = list(text)
+
+    def __len__(self) -> int:
+        return len(self._chars)
+
+    def content(self) -> str:
+        return "".join(self._chars)
+
+
+def replay_trace(trace: TestData) -> str:
+    """Replay all patches; return final content (ground truth)."""
+    doc = OracleDocument.from_str(trace.start_content)
+    for pos, del_count, ins in trace.iter_patches():
+        doc.replace(pos, pos + del_count, ins)
+    return doc.content()
+
+
+def replay_unit_ops(
+    kind: np.ndarray, pos: np.ndarray, ch: np.ndarray, start: str = ""
+) -> str:
+    """Replay exploded unit ops (tensorize.py layout); oracle for the engine's
+    exact input representation."""
+    doc = list(start)
+    for k, p, c in zip(kind.tolist(), pos.tolist(), ch.tolist()):
+        if k == INSERT:
+            doc[p:p] = [chr(c)]
+        elif k == DELETE:
+            del doc[p]
+    return "".join(doc)

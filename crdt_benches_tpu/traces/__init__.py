@@ -1,0 +1,14 @@
+from .loader import TestData, TestTxn, TestPatch, load_testing_data, trace_path, TRACES
+from .tensorize import TensorizedTrace, tensorize, explode_unit_ops
+
+__all__ = [
+    "TestData",
+    "TestTxn",
+    "TestPatch",
+    "load_testing_data",
+    "trace_path",
+    "TRACES",
+    "TensorizedTrace",
+    "tensorize",
+    "explode_unit_ops",
+]

@@ -1,0 +1,195 @@
+"""Editing-trace loading (L1 of the framework).
+
+Re-provides the capability of the reference's external ``crdt-testdata`` crate
+(reference: Cargo.toml:10, used at src/main.rs:19,52): load a gzipped-JSON
+editing trace in josephg's ``editing-traces`` format into a ``TestData`` value
+with ``start_content`` / ``end_content`` / ``txns``, a ``len()`` equal to the
+total patch count (the Criterion throughput element count, src/main.rs:25), and
+a ``chars_to_bytes()`` conversion for byte-addressed backends
+(src/main.rs:21-23).
+
+Schema (verified against the mounted trace files, SURVEY.md section 3.4)::
+
+    {"startContent": str, "endContent": str,
+     "txns": [{"time": ISO8601 str,
+               "patches": [[pos: int, delCount: int, insStr: str], ...]}, ...]}
+
+Positions and delete counts are in **character (codepoint) units**;
+``chars_to_bytes`` rewrites them into UTF-8 byte units.
+
+Pure Python + stdlib; no JAX dependency at this layer.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, NamedTuple
+
+#: The four workloads, as in the reference's hardcoded trace table
+#: (src/main.rs:10-15).  Overridable via bench config (utils/config.py) —
+#: the rebuild replaces the hardcoded const with configuration.
+TRACES = (
+    "automerge-paper",
+    "rustcode",
+    "sveltecomponent",
+    "seph-blog1",
+)
+
+_DEFAULT_TRACE_DIRS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "traces_data"),
+    "./traces_data",
+    "./traces",
+)
+
+
+class TestPatch(NamedTuple):
+    """One edit: replace ``del_count`` chars at ``pos`` with ``ins``.
+
+    Mirrors the reference's ``TestPatch(pos, del, ins)`` tuple
+    (destructured at src/main.rs:31).
+    """
+
+    pos: int
+    del_count: int
+    ins: str
+
+
+@dataclass
+class TestTxn:
+    time: str
+    patches: list[TestPatch] = field(default_factory=list)
+
+
+@dataclass
+class TestData:
+    start_content: str
+    end_content: str
+    txns: list[TestTxn]
+
+    def __len__(self) -> int:
+        """Total patch count — the throughput element count (src/main.rs:25)."""
+        return sum(len(t.patches) for t in self.txns)
+
+    def iter_patches(self) -> Iterator[TestPatch]:
+        for txn in self.txns:
+            yield from txn.patches
+
+    def chars_to_bytes(self) -> "TestData":
+        """Rewrite char-unit positions/counts into UTF-8 byte units.
+
+        Required for byte-addressed backends (the reference's cola and yrs
+        adapters set ``EDITS_USE_BYTE_OFFSETS = true``, src/rope.rs:82,147).
+
+        Only non-ASCII chars make byte offsets differ from char offsets, and
+        the traces contain at most a handful at any time (SURVEY.md section
+        3.4), so we track just the char positions of multi-byte chars in the
+        evolving document — O(#multibyte) per patch instead of replaying the
+        whole document.
+        """
+        # (char_pos, extra_bytes) for each multi-byte char currently in doc.
+        extras: list[list[int]] = [
+            [i, len(c.encode("utf-8")) - 1]
+            for i, c in enumerate(self.start_content)
+            if ord(c) >= 128
+        ]
+        new_txns: list[TestTxn] = []
+        for txn in self.txns:
+            new_patches: list[TestPatch] = []
+            for pos, del_count, ins in txn.patches:
+                byte_pos = pos + sum(e for p, e in extras if p < pos)
+                byte_del = del_count + sum(
+                    e for p, e in extras if pos <= p < pos + del_count
+                )
+                new_patches.append(TestPatch(byte_pos, byte_del, ins))
+                shift = len(ins) - del_count
+                extras = [
+                    [p + shift if p >= pos + del_count else p, e]
+                    for p, e in extras
+                    if not (pos <= p < pos + del_count)
+                ]
+                extras.extend(
+                    [pos + i, len(c.encode("utf-8")) - 1]
+                    for i, c in enumerate(ins)
+                    if ord(c) >= 128
+                )
+                extras.sort()
+            new_txns.append(TestTxn(txn.time, new_patches))
+        return TestData(self.start_content, self.end_content, new_txns)
+
+    def stats(self) -> dict:
+        """Workload characteristics (the SURVEY.md section 6 table) as a
+        self-check for the loader."""
+        patches = ins_ops = del_ops = ins_chars = del_chars = 0
+        max_ins = max_del = 0
+        unit_ops = 0
+        for pos, del_count, ins in self.iter_patches():
+            patches += 1
+            if ins:
+                ins_ops += 1
+                ins_chars += len(ins)
+                max_ins = max(max_ins, len(ins))
+            if del_count:
+                del_ops += 1
+                del_chars += del_count
+                max_del = max(max_del, del_count)
+            unit_ops += del_count + len(ins)
+        return {
+            "txns": len(self.txns),
+            "patches": patches,
+            "ins_ops": ins_ops,
+            "del_ops": del_ops,
+            "ins_chars": ins_chars,
+            "del_chars": del_chars,
+            "max_ins": max_ins,
+            "max_del": max_del,
+            "final_chars": len(self.end_content),
+            "unit_ops": unit_ops,
+        }
+
+
+def trace_path(name: str, trace_dir: str | None = None) -> str:
+    """Resolve a trace name (e.g. ``"sveltecomponent"``) to a .json.gz path."""
+    if name.endswith(".json.gz"):
+        if os.path.exists(name):
+            return name
+        raise FileNotFoundError(f"trace file {name!r} does not exist")
+    candidates = [trace_dir] if trace_dir else list(_DEFAULT_TRACE_DIRS)
+    for d in candidates:
+        if d is None:
+            continue
+        p = os.path.join(d, f"{name}.json.gz")
+        if os.path.exists(p):
+            return os.path.normpath(p)
+    raise FileNotFoundError(
+        f"trace {name!r} not found in {candidates}; "
+        "pass trace_dir= or set cwd to the repo root"
+    )
+
+
+def load_testing_data(path_or_name: str, trace_dir: str | None = None) -> TestData:
+    """Load a gzipped-JSON editing trace (the ``load_testing_data`` capability,
+    reference src/main.rs:19,52)."""
+    path = trace_path(path_or_name, trace_dir)
+    with gzip.open(path, "rt", encoding="utf-8") as f:
+        raw = json.load(f)
+    try:
+        txns = [
+            TestTxn(
+                time=t.get("time", ""),
+                patches=[TestPatch(p[0], p[1], p[2]) for p in t["patches"]],
+            )
+            for t in raw["txns"]
+        ]
+        return TestData(
+            start_content=raw["startContent"],
+            end_content=raw["endContent"],
+            txns=txns,
+        )
+    except (KeyError, IndexError, TypeError) as e:
+        raise ValueError(
+            f"{path}: not a valid editing-traces file "
+            "(expected startContent/endContent/txns[].patches)"
+        ) from e

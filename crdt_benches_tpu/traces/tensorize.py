@@ -1,0 +1,116 @@
+"""Trace tensorization: patches -> padded integer op tensors.
+
+The reference replays patches one at a time through a mutable rope
+(src/main.rs:30-34).  The TPU engine instead consumes the trace as fixed-shape
+integer arrays: each patch ``(pos, del, ins)`` is *exploded* into unit ops —
+``del`` single-char deletes at ``pos`` followed by one single-char insert per
+char of ``ins`` (at ``pos``, ``pos+1``, ...).  Unit ops are padded to a
+multiple of the scan batch size ``B``; a ``kind == PAD`` op is a no-op.
+
+Each insert unit op is pre-assigned its **slot id** (its index in the
+insertion-order physical buffer): slot ids are dense, deterministic, and
+computable at tensorize time, which lets the device engine scatter new chars
+without dynamic allocation.  Slot ids double as CRDT element ids
+(``(agent, seq)`` with ``seq`` = slot) — the analog of diamond-types' agent
+ids / op-log times (reference src/rope.rs:117-120).
+
+Pure NumPy; no JAX dependency at this layer (SURVEY.md section 7, layer 1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .loader import TestData
+
+# Op kinds.
+PAD = 0
+INSERT = 1
+DELETE = 2
+
+
+@dataclass
+class TensorizedTrace:
+    """A trace as padded unit-op tensors plus static sizing metadata."""
+
+    kind: np.ndarray  # int32[N_pad]  PAD / INSERT / DELETE
+    pos: np.ndarray  # int32[N_pad]  visible char position at op time
+    ch: np.ndarray  # int32[N_pad]  codepoint for INSERT, 0 otherwise
+    slot: np.ndarray  # int32[N_pad]  preassigned slot id for INSERT, -1 otherwise
+    init_chars: np.ndarray  # int32[S] start-content codepoints (slots 0..S-1)
+    n_ops: int  # real (unpadded) unit-op count
+    n_patches: int  # reference throughput element count (src/main.rs:25)
+    n_inserts: int  # INSERT unit-op count
+    capacity: int  # S + n_inserts = total slots ever allocated
+    batch: int  # scan batch size the padding is aligned to
+    end_content: str
+
+    @property
+    def n_batches(self) -> int:
+        return len(self.kind) // self.batch
+
+    def batched(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Reshape the op streams to (n_batches, batch)."""
+        nb, b = self.n_batches, self.batch
+        return (
+            self.kind.reshape(nb, b),
+            self.pos.reshape(nb, b),
+            self.ch.reshape(nb, b),
+            self.slot.reshape(nb, b),
+        )
+
+
+def explode_unit_ops(trace: TestData) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Explode patches into (kind, pos, ch) unit-op arrays (no padding)."""
+    kinds: list[int] = []
+    poss: list[int] = []
+    chs: list[int] = []
+    for pos, del_count, ins in trace.iter_patches():
+        for _ in range(del_count):
+            kinds.append(DELETE)
+            poss.append(pos)
+            chs.append(0)
+        for i, c in enumerate(ins):
+            kinds.append(INSERT)
+            poss.append(pos + i)
+            chs.append(ord(c))
+    return (
+        np.asarray(kinds, dtype=np.int32),
+        np.asarray(poss, dtype=np.int32),
+        np.asarray(chs, dtype=np.int32),
+    )
+
+
+def tensorize(trace: TestData, batch: int = 256) -> TensorizedTrace:
+    """Tensorize a trace with padding aligned to ``batch`` unit ops."""
+    kind, pos, ch = explode_unit_ops(trace)
+    n_ops = len(kind)
+    n_pad = (-n_ops) % batch if n_ops else batch
+    if n_pad:
+        kind = np.concatenate([kind, np.zeros(n_pad, np.int32)])
+        pos = np.concatenate([pos, np.zeros(n_pad, np.int32)])
+        ch = np.concatenate([ch, np.zeros(n_pad, np.int32)])
+
+    init_chars = np.asarray([ord(c) for c in trace.start_content], dtype=np.int32)
+    s = len(init_chars)
+    is_ins = kind == INSERT
+    # slot id = S + (number of inserts strictly before this op)
+    slot = np.where(
+        is_ins, s + np.cumsum(is_ins, dtype=np.int64) - 1, -1
+    ).astype(np.int32)
+    n_inserts = int(is_ins.sum())
+    return TensorizedTrace(
+        kind=kind,
+        pos=pos,
+        ch=ch,
+        slot=slot,
+        init_chars=init_chars,
+        n_ops=n_ops,
+        n_patches=len(trace),
+        n_inserts=n_inserts,
+        capacity=s + n_inserts,
+        batch=batch,
+        end_content=trace.end_content,
+    )

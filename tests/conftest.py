@@ -1,0 +1,43 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective paths are
+validated on host CPU devices instead (the driver separately dry-run-compiles
+the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import pytest  # noqa: E402
+
+from crdt_benches_tpu.traces import load_testing_data  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def svelte_trace():
+    return load_testing_data("sveltecomponent")
+
+
+@pytest.fixture(scope="session")
+def rustcode_trace():
+    return load_testing_data("rustcode")
+
+
+@pytest.fixture(scope="session")
+def seph_trace():
+    return load_testing_data("seph-blog1")
+
+
+@pytest.fixture(scope="session")
+def automerge_trace():
+    return load_testing_data("automerge-paper")

@@ -1,0 +1,99 @@
+"""Trace-layer tests: schema, stats self-check (SURVEY.md section 6 table),
+chars_to_bytes, tensorizer invariants."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.traces import load_testing_data, tensorize
+from crdt_benches_tpu.traces.tensorize import DELETE, INSERT, PAD
+from crdt_benches_tpu.oracle import replay_trace, replay_unit_ops
+
+# Expected workload constants, measured independently in the survey
+# (BASELINE.md "Workload constants" table).
+EXPECTED_STATS = {
+    "sveltecomponent": dict(
+        txns=18335, patches=19749, ins_ops=17786, del_ops=3227,
+        ins_chars=93984, del_chars=75533, final_chars=18451, unit_ops=169517,
+    ),
+    "rustcode": dict(
+        txns=36981, patches=40173, ins_ops=35249, del_ops=7148,
+        ins_chars=522531, del_chars=457313, final_chars=65218, unit_ops=979844,
+    ),
+    "seph-blog1": dict(
+        txns=137154, patches=137993, ins_ops=128855, del_ops=12021,
+        ins_chars=212489, del_chars=155720, final_chars=56769, unit_ops=368209,
+    ),
+    "automerge-paper": dict(
+        txns=259778, patches=259778, ins_ops=182315, del_ops=77463,
+        ins_chars=182315, del_chars=77463, final_chars=104852, unit_ops=259778,
+    ),
+}
+
+
+@pytest.mark.parametrize("name", list(EXPECTED_STATS))
+def test_stats_match_survey(name):
+    trace = load_testing_data(name)
+    stats = trace.stats()
+    for key, want in EXPECTED_STATS[name].items():
+        assert stats[key] == want, f"{name}.{key}: {stats[key]} != {want}"
+    assert len(trace) == EXPECTED_STATS[name]["patches"]
+
+
+def test_all_traces_start_empty_end_ascii():
+    for name in EXPECTED_STATS:
+        trace = load_testing_data(name)
+        assert trace.start_content == ""
+        assert all(ord(c) < 128 for c in trace.end_content)
+
+
+def test_oracle_replay_svelte(svelte_trace):
+    assert replay_trace(svelte_trace) == svelte_trace.end_content
+
+
+def test_oracle_replay_seph(seph_trace):
+    assert replay_trace(seph_trace) == seph_trace.end_content
+
+
+def test_chars_to_bytes_rustcode(rustcode_trace):
+    """rustcode inserts 12 non-ASCII chars mid-trace (SURVEY.md 3.4); replaying
+    the byte-offset trace over a *byte* document must still converge."""
+    btrace = rustcode_trace.chars_to_bytes()
+    doc = bytearray()
+    for pos, del_count, ins in btrace.iter_patches():
+        doc[pos : pos + del_count] = ins.encode("utf-8")
+    assert doc.decode("utf-8") == rustcode_trace.end_content
+
+
+def test_chars_to_bytes_identity_on_ascii(svelte_trace):
+    btrace = svelte_trace.chars_to_bytes()
+    for (p1, d1, i1), (p2, d2, i2) in zip(
+        svelte_trace.iter_patches(), btrace.iter_patches()
+    ):
+        assert (p1, d1, i1) == (p2, d2, i2)
+
+
+def test_tensorize_invariants(svelte_trace):
+    tt = tensorize(svelte_trace, batch=256)
+    assert len(tt.kind) % 256 == 0
+    assert tt.n_ops == EXPECTED_STATS["sveltecomponent"]["unit_ops"]
+    assert tt.n_patches == len(svelte_trace)
+    assert tt.capacity == len(tt.init_chars) + tt.n_inserts
+    # padding is all PAD and only at the tail
+    assert (tt.kind[tt.n_ops :] == PAD).all()
+    assert (tt.kind[: tt.n_ops] != PAD).all()
+    # slots: dense, increasing over insert ops, -1 elsewhere
+    ins_mask = tt.kind == INSERT
+    slots = tt.slot[ins_mask]
+    assert (np.diff(slots) == 1).all()
+    assert slots[0] == len(tt.init_chars)
+    assert (tt.slot[~ins_mask] == -1).all()
+    # delete ops carry no char
+    assert (tt.ch[tt.kind == DELETE] == 0).all()
+
+
+def test_unit_op_replay_matches_end_content(svelte_trace):
+    tt = tensorize(svelte_trace, batch=256)
+    out = replay_unit_ops(
+        tt.kind[: tt.n_ops], tt.pos[: tt.n_ops], tt.ch[: tt.n_ops], start=""
+    )
+    assert out == svelte_trace.end_content
