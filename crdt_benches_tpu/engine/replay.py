@@ -1,0 +1,119 @@
+"""Full-trace replay: lax.scan over op batches, vmap over replicas.
+
+The TPU analog of the reference's timed closure (src/main.rs:28-37): document
+init (``from_str``), the hot per-patch loop, and the final check — except the
+loop is a compiled scan over op *batches* and the whole thing is batched over
+a replica axis.  Throughput comes from the replica axis and from vectorizing
+the within-batch work, not from parallelizing the op stream (SURVEY.md
+section 7, hard part 1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.apply import DocState, apply_batch, decode_state, init_state
+from ..ops.resolve import resolve_batch
+from ..traces.tensorize import TensorizedTrace
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def replay_batches(state: DocState, kind_b, pos_b, slot_b) -> DocState:
+    """Scan all op batches into the document state.  Shapes:
+    kind_b/pos_b/slot_b are int32[n_batches, B]."""
+
+    def step(st, batch):
+        kind, pos, slot = batch
+        resolved = resolve_batch(kind, pos, st.nvis)
+        return apply_batch(st, resolved, slot), None
+
+    state, _ = jax.lax.scan(step, state, (kind_b, pos_b, slot_b))
+    return state
+
+
+class ReplayEngine:
+    """Host-side driver for replaying one tensorized trace on-device.
+
+    ``n_replicas > 1`` vmaps the whole replay over a replica axis — every
+    replica carries and computes its own full state (the honest equivalent of
+    running the reference's single-threaded loop N times in parallel).  Use
+    ``parallel/`` for sharding replicas across a device mesh.
+    """
+
+    def __init__(self, tt: TensorizedTrace, n_replicas: int = 1, lane: int = 128):
+        self.tt = tt
+        self.n_replicas = n_replicas
+        self.capacity = _round_up(max(tt.capacity, 1), lane)
+        self.n_init = len(tt.init_chars)
+
+        kind_b, pos_b, _, slot_b = tt.batched()
+        self.kind_b = jnp.asarray(kind_b)
+        self.pos_b = jnp.asarray(pos_b)
+        self.slot_b = jnp.asarray(slot_b)
+
+        # slot -> codepoint is static for a given trace: init content occupies
+        # slots 0..S-1, each insert op's preassigned slot gets its char.
+        chars = np.zeros(self.capacity, np.int32)
+        chars[: self.n_init] = tt.init_chars
+        ins = tt.slot >= 0
+        chars[tt.slot[ins]] = tt.ch[ins]
+        self.chars = jnp.asarray(chars)
+
+        if n_replicas == 1:
+            self._replay = replay_batches
+        else:
+            self._replay = jax.jit(
+                jax.vmap(replay_batches, in_axes=(0, None, None, None)),
+                donate_argnums=(0,),
+            )
+
+    def fresh_state(self) -> DocState:
+        st = init_state(self.capacity, self.n_init)
+        if self.n_replicas > 1:
+            st = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (self.n_replicas,) + x.shape), st
+            )
+        return st
+
+    def run(self, state: DocState | None = None) -> DocState:
+        """Replay the full trace; returns final state (device)."""
+        if state is None:
+            state = self.fresh_state()
+        return self._replay(state, self.kind_b, self.pos_b, self.slot_b)
+
+    def run_blocking(self) -> DocState:
+        state = self.run()
+        jax.block_until_ready(state)
+        return state
+
+    # ---- decode / checks -------------------------------------------------
+
+    def decode(self, state: DocState, replica: int = 0) -> str:
+        """Materialize a replica's visible document as a Python string."""
+        st = (
+            jax.tree.map(lambda x: x[replica], state)
+            if self.n_replicas > 1
+            else state
+        )
+        codes, nvis = jax.jit(decode_state)(st, self.chars)
+        codes = np.asarray(codes)[: int(nvis)]
+        return "".join(map(chr, codes.tolist()))
+
+    def lengths(self, state: DocState) -> np.ndarray:
+        """Per-replica visible char counts — the reference's length oracle
+        (src/main.rs:35), available without full decode."""
+        return np.atleast_1d(np.asarray(state.nvis))
+
+
+def replay_trace_jax(tt: TensorizedTrace) -> str:
+    """Convenience: single-replica replay -> final content string."""
+    eng = ReplayEngine(tt, n_replicas=1)
+    return eng.decode(eng.run_blocking())

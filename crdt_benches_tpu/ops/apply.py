@@ -1,0 +1,162 @@
+"""Vectorized batch application to per-replica document state tensors.
+
+Given a resolved batch (ops/resolve.py) expressed in pre-batch *rank* space,
+update the big fixed-shape state arrays in O(capacity) vectorized work:
+
+1. gather visibility in document order and prefix-sum it (rank -> physical),
+2. tombstone deleted slots / set visibility of new slots (scatters),
+3. merge the batch's new slots into the document-order permutation with a
+   counting merge: ``new_index_old[i] = i + #inserts at gaps <= i`` and
+   ``new_index_ins[j] = gap_j + #inserts before j`` — two disjoint scatters,
+   no sort (SURVEY.md section 7 hard-part 3, "re-compaction via prefix-sum").
+
+The physical buffer holds every slot ever allocated (tombstones included), in
+document order; ``visible`` is indexed by slot id.  This is the TPU analog of
+the reference CRDTs' rope/B-tree structures (e.g. diamond-types' op-log +
+checkout, reference src/rope.rs:105-137) with a statically-known capacity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .resolve import ORIGIN_BATCH, ResolvedBatch
+
+
+class DocState(NamedTuple):
+    """Per-replica document state (a scan carry / vmap operand).
+
+    capacity C = init chars + total inserts (padded); all arrays fixed-shape.
+    """
+
+    order: jax.Array  # int32[C]  slot ids in document order (incl. tombstones)
+    visible: jax.Array  # bool[C]  by slot id
+    origin: jax.Array  # int32[C] by slot id: left-origin slot (-1 = head)
+    length: jax.Array  # int32    used entries of `order`
+    nvis: jax.Array  # int32    visible char count
+
+
+def init_state(capacity: int, n_init: int = 0) -> DocState:
+    """Fresh document: slots 0..n_init-1 hold the start content (the
+    ``from_str`` capability, reference src/rope.rs:10)."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    return DocState(
+        order=jnp.where(idx < n_init, idx, -1),
+        visible=idx < n_init,
+        origin=jnp.where(idx < n_init, idx - 1, -1),
+        length=jnp.int32(n_init),
+        nvis=jnp.int32(n_init),
+    )
+
+
+def _doc_order_visibility(state: DocState):
+    """vis[i] = is the i-th document-order entry a visible char;
+    cumvis = inclusive prefix sum (rank+1 at visible entries)."""
+    C = state.order.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    valid = idx < state.length
+    slot_at = jnp.where(valid, state.order, 0)
+    vis = valid & state.visible[slot_at]
+    cumvis = jnp.cumsum(vis.astype(jnp.int32))
+    return slot_at, vis, cumvis
+
+
+def rank_to_phys(cumvis: jax.Array, rank: jax.Array) -> jax.Array:
+    """Physical document-order index of the visible char with given rank."""
+    return jnp.searchsorted(cumvis, rank + 1, side="left").astype(jnp.int32)
+
+
+def apply_batch(
+    state: DocState, resolved: ResolvedBatch, slots: jax.Array
+) -> DocState:
+    """Apply one resolved batch.  ``slots``: int32[B] preassigned slot ids for
+    insert ops (-1 otherwise, from the tensorizer)."""
+    C = state.order.shape[0]
+    B = slots.shape[0]
+    drop = jnp.int32(C)  # any out-of-range index with mode="drop"
+
+    slot_at, vis, cumvis = _doc_order_visibility(state)
+
+    # --- deletes of pre-batch chars: rank -> phys -> slot, clear visibility
+    dr = resolved.del_rank
+    has_del = dr >= 0
+    dphys = rank_to_phys(cumvis, jnp.where(has_del, dr, 0))
+    dslot = state.order[jnp.clip(dphys, 0, C - 1)]
+    visible = state.visible.at[jnp.where(has_del, dslot, drop)].set(
+        False, mode="drop"
+    )
+
+    # --- batch inserts: visibility (dead-on-arrival stays False)
+    is_ins = resolved.ins_gvis >= 0
+    ins_idx = jnp.where(is_ins, slots, drop)
+    visible = visible.at[ins_idx].set(resolved.ins_alive, mode="drop")
+
+    # --- origin codes -> slot ids, scattered by slot
+    oc = resolved.origin
+    oc_rank = jnp.clip(oc, 0, ORIGIN_BATCH - 1)
+    origin_from_rank = state.order[
+        jnp.clip(rank_to_phys(cumvis, oc_rank), 0, C - 1)
+    ]
+    origin_from_batch = slots[jnp.clip(oc - ORIGIN_BATCH, 0, B - 1)]
+    origin_slot = jnp.where(
+        oc < 0, -1, jnp.where(oc >= ORIGIN_BATCH, origin_from_batch, origin_from_rank)
+    )
+    origin = state.origin.at[ins_idx].set(
+        jnp.where(is_ins, origin_slot, -1), mode="drop"
+    )
+
+    # --- gap rank -> physical gap (index in pre-batch doc order)
+    gv = resolved.ins_gvis
+    g_phys = jnp.where(
+        gv >= state.nvis,
+        state.length,
+        rank_to_phys(cumvis, jnp.where(is_ins, gv, 0)),
+    )
+
+    # --- counting merge of new slots into the order permutation
+    bump = jnp.zeros(C + 1, jnp.int32).at[
+        jnp.where(is_ins, g_phys, C + 1)
+    ].add(1, mode="drop")
+    csum = jnp.cumsum(bump)  # csum[x] = #inserts with gap <= x
+    idx = jnp.arange(C, dtype=jnp.int32)
+    new_idx_old = idx + csum[idx]
+    n_before = jnp.where(g_phys > 0, csum[jnp.clip(g_phys - 1, 0)], 0)
+    new_idx_ins = g_phys + n_before + resolved.ins_seq
+
+    valid = idx < state.length
+    order = (
+        jnp.full(C, -1, jnp.int32)
+        .at[jnp.where(valid, new_idx_old, drop)]
+        .set(jnp.where(valid, state.order, -1), mode="drop")
+        .at[jnp.where(is_ins, new_idx_ins, drop)]
+        .set(slots, mode="drop")
+    )
+
+    n_ins = jnp.sum(is_ins.astype(jnp.int32))
+    n_live = jnp.sum((is_ins & resolved.ins_alive).astype(jnp.int32))
+    n_del = jnp.sum(has_del.astype(jnp.int32))
+    return DocState(
+        order=order,
+        visible=visible,
+        origin=origin,
+        length=state.length + n_ins,
+        nvis=state.nvis - n_del + n_live,
+    )
+
+
+def decode_state(state: DocState, chars: jax.Array):
+    """Materialize the visible document: returns (codepoints[C], nvis) where
+    the first ``nvis`` entries are the document's chars in order.  The analog
+    of diamond-types' ``checkout_tip()`` (reference src/rope.rs:135), upgraded
+    from length-only to full content."""
+    C = state.order.shape[0]
+    slot_at, vis, cumvis = _doc_order_visibility(state)
+    out = (
+        jnp.zeros(C, jnp.int32)
+        .at[jnp.where(vis, cumvis - 1, C)]
+        .set(chars[slot_at], mode="drop")
+    )
+    return out, cumvis[-1]

@@ -1,0 +1,212 @@
+"""Within-batch op resolution — the sequential heart, kept tiny on purpose.
+
+The reference's hot loop applies one patch at a time to a mutable rope, so
+every op's position depends on all prior ops (reference src/main.rs:30-34 and
+SURVEY.md section 3.5 — "the core algorithmic obstacle").  The TPU engine
+restructures this: ops are processed in batches of ``B``; the *sequential*
+per-op dependency is resolved by a ``lax.scan`` over a small **token list**
+(size O(B), independent of document size), and only the batch *summary* is
+applied to the big per-replica state tensors in one vectorized pass
+(ops/apply.py).
+
+Token list
+----------
+The current visible document during a batch is represented as a sequence of
+tokens:
+
+- ``RUN(a, len)`` — a run of ``len`` surviving pre-batch visible chars,
+  identified by their pre-batch visible *ranks* ``a .. a+len-1`` (rank = index
+  among chars visible at batch start).  Deletes split runs, so runs only ever
+  contain surviving chars and stay ascending.
+- ``INS(j)`` — the char inserted by batch op ``j`` (length 1).
+- ``DEAD(j)`` — a batch insert later deleted in the same batch (length 0).
+  Kept in place so it still receives a stable position for its tombstone.
+
+Crucially the scan state depends on the pre-batch document **only through its
+visible char count** ``v0`` — ranks are resolved to physical slots after the
+scan, outside the sequential region.
+
+Outputs per op ``j`` (all fixed-shape, -1 = not applicable):
+
+- ``del_rank[j]``   pre-batch visible rank tombstoned by a DELETE op
+- ``ins_gvis[j]``   for INSERT ops: rank of the first *surviving* pre-batch
+                    char after the inserted char at batch end (``v0`` = none —
+                    the insert belongs at the document tail)
+- ``ins_seq[j]``    tie-break order among batch inserts that share a gap
+- ``ins_alive[j]``  1 unless the insert was deleted within the batch
+- ``origin[j]``     identity of the char immediately left of the insert at
+                    insert time: ``-1`` = document head, ``0 <= r < v0`` = the
+                    pre-batch char of rank ``r``, ``ORIGIN_BATCH + k`` = the
+                    char inserted by batch op ``k``.  This is the CRDT
+                    left-origin (the analog of diamond-types' op-log parents,
+                    reference src/rope.rs:117-126), used for update encoding
+                    and merge.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..traces.tensorize import DELETE, INSERT
+
+# Token types.
+FREE, RUN, TINS, TDEAD = 0, 1, 2, 3
+
+#: Origin codes >= ORIGIN_BATCH refer to batch op indices.
+ORIGIN_BATCH = 1 << 24
+_BIG = jnp.int32(1 << 30)
+
+
+class ResolvedBatch(NamedTuple):
+    del_rank: jax.Array  # int32[B]
+    ins_gvis: jax.Array  # int32[B]  (-1 for non-insert ops)
+    ins_seq: jax.Array  # int32[B]
+    ins_alive: jax.Array  # bool[B]
+    origin: jax.Array  # int32[B]  (-2 for non-insert ops)
+
+
+def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBatch:
+    """Resolve one batch of unit ops against a document with ``v0`` visible
+    chars.  ``kind``/``pos``: int32[B].  Fully jit/vmap-compatible."""
+    B = kind.shape[0]
+    T = 2 * B + 2
+
+    ttype0 = jnp.zeros(T, jnp.int32).at[0].set(RUN)
+    ta0 = jnp.zeros(T, jnp.int32)
+    tlen0 = jnp.zeros(T, jnp.int32).at[0].set(v0)
+
+    didx = jnp.arange(T, dtype=jnp.int32)
+
+    def step(carry, op):
+        ttype, ta, tlen = carry
+        k, p, j = op
+        is_ins = k == INSERT
+        is_del = k == DELETE  # refined below once `total` is known
+
+        cum = jnp.cumsum(tlen)  # free tokens have len 0 -> flat tail
+        total = cum[-1]
+        pre = cum - tlen
+        # Malformed-stream robustness: positions clamp to [0, total]; deletes
+        # beyond the end are no-ops (mirrors oracle semantics).
+        p = jnp.clip(p, 0, total)
+        is_del = is_del & (p < total)
+        # Token containing the char at offset p (pre[t] <= p < cum[t]).  An
+        # insert at the very end finds no such token (the free tail keeps cum
+        # flat), so clamp to the first FREE index — the off == 0 path then
+        # places the new token there.
+        n_used = jnp.sum((ttype != FREE).astype(jnp.int32))
+        t = jnp.searchsorted(cum, p, side="right").astype(jnp.int32)
+        t = jnp.minimum(t, n_used)
+        off = p - pre[t]
+
+        a, ln, tt = ta[t], tlen[t], ttype[t]
+        hit_run = tt == RUN
+
+        # Replacement of token t by m in {1, 2, 3} new tokens.
+        #   INSERT off == 0 : [ INS(j), old_t ]                        m = 2
+        #   INSERT off  > 0 : [ RUN(a,off), INS(j), RUN(a+off,ln-off)] m = 3
+        #   DELETE on INS   : [ DEAD(j') ]                             m = 1
+        #   DELETE on RUN   : [ RUN(a,off), RUN(a+off+1,ln-off-1) ]    m = 2
+        #   PAD             : [ old_t ]                                m = 1
+        split = is_ins & (off > 0)
+        m = jnp.where(
+            is_ins,
+            jnp.where(split, 3, 2),
+            jnp.where(is_del, jnp.where(hit_run, 2, 1), 1),
+        )
+
+        # New token triple (only the first m are used).
+        n0t = jnp.where(
+            is_ins,
+            jnp.where(split, RUN, TINS),
+            jnp.where(is_del, jnp.where(hit_run, RUN, TDEAD), tt),
+        )
+        n0a = jnp.where(is_ins, jnp.where(split, a, j), jnp.where(is_del & ~hit_run, a, a))
+        n0l = jnp.where(
+            is_ins,
+            jnp.where(split, off, 1),
+            jnp.where(is_del, jnp.where(hit_run, off, 0), ln),
+        )
+        n1t = jnp.where(is_ins, jnp.where(split, TINS, tt), RUN)
+        n1a = jnp.where(
+            is_ins, jnp.where(split, j, a), a + off + 1
+        )
+        n1l = jnp.where(is_ins, jnp.where(split, 1, ln), ln - off - 1)
+        n2t, n2a, n2l = RUN, a + off, ln - off
+
+        src = jnp.clip(didx - (m - 1), 0, T - 1)
+        shifted_t = ttype[src]
+        shifted_a = ta[src]
+        shifted_l = tlen[src]
+
+        def place(old, shifted, x0, x1, x2):
+            out = jnp.where(didx < t, old, shifted)
+            out = jnp.where(didx == t, x0, out)
+            out = jnp.where((m >= 2) & (didx == t + 1), x1, out)
+            out = jnp.where((m == 3) & (didx == t + 2), x2, out)
+            return out
+
+        ttype_n = place(ttype, shifted_t, n0t, n1t, n2t)
+        ta_n = place(ta, shifted_a, n0a, n1a, n2a)
+        tlen_n = place(tlen, shifted_l, n0l, n1l, n2l)
+
+        # Per-op outputs.
+        del_rank = jnp.where(is_del & hit_run, a + off, -1)
+        # Origin: char at offset p-1 at insert time.
+        tp = jnp.searchsorted(cum, p - 1, side="right").astype(jnp.int32)
+        origin_char = jnp.where(
+            ttype[tp] == RUN,
+            ta[tp] + (p - 1 - pre[tp]),
+            ORIGIN_BATCH + ta[tp],
+        )
+        origin = jnp.where(is_ins, jnp.where(p == 0, -1, origin_char), -2)
+
+        return (ttype_n, ta_n, tlen_n), (del_rank, origin)
+
+    ops = (kind, pos, jnp.arange(B, dtype=jnp.int32))
+    (ttype, ta, tlen), (del_rank, origin) = jax.lax.scan(
+        step, (ttype0, ta0, tlen0), ops
+    )
+
+    # ---- post-scan extraction (vectorized over the token list) ----
+    is_instok = (ttype == TINS) | (ttype == TDEAD)
+    # First surviving pre-batch char after each token: suffix-min of run starts.
+    run_start = jnp.where((ttype == RUN) & (tlen > 0), ta, _BIG)
+    suff = jnp.flip(jax.lax.cummin(jnp.flip(run_start)))
+    nxt = jnp.concatenate([suff[1:], jnp.full((1,), _BIG, jnp.int32)])
+    gvis = jnp.where(nxt >= _BIG, v0, nxt)
+
+    # Tie-break: rank among instok tokens within the same gap.  Instok tokens
+    # sharing a gap are contiguous (any surviving RUN between two inserts
+    # would give the earlier one a smaller gap), so group starts are where the
+    # gap differs from the previous instok token's gap.
+    tpos = jnp.arange(ttype.shape[0], dtype=jnp.int32)
+    ci = jnp.cumsum(is_instok.astype(jnp.int32))  # inclusive count
+    prev_ipos = jax.lax.cummax(jnp.where(is_instok, tpos, -1))
+    prev_ipos = jnp.concatenate([jnp.full((1,), -1, jnp.int32), prev_ipos[:-1]])
+    prev_gvis = jnp.where(prev_ipos >= 0, gvis[jnp.clip(prev_ipos, 0)], -1)
+    boundary = is_instok & ((prev_ipos < 0) | (prev_gvis != gvis))
+    base = jnp.where(boundary, ci - 1, -1)
+    seq = ci - 1 - jax.lax.cummax(base)
+
+    # Scatter token results to per-op arrays (drop non-instok tokens).
+    B_ = B
+    opidx = jnp.where(is_instok, ta, B_)
+    ins_gvis = jnp.full(B_, -1, jnp.int32).at[opidx].set(gvis, mode="drop")
+    ins_seq = jnp.zeros(B_, jnp.int32).at[opidx].set(seq, mode="drop")
+    ins_alive = (
+        jnp.zeros(B_, jnp.bool_)
+        .at[opidx]
+        .set(ttype == TINS, mode="drop")
+    )
+
+    return ResolvedBatch(
+        del_rank=del_rank,
+        ins_gvis=ins_gvis,
+        ins_seq=ins_seq,
+        ins_alive=ins_alive,
+        origin=origin,
+    )

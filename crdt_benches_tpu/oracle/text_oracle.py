@@ -65,7 +65,7 @@ def replay_unit_ops(
     doc = list(start)
     for k, p, c in zip(kind.tolist(), pos.tolist(), ch.tolist()):
         if k == INSERT:
-            doc[p:p] = [chr(c)]
-        elif k == DELETE:
+            doc[max(p, 0) : max(p, 0)] = [chr(c)]  # p > len appends, p < 0 prepends
+        elif k == DELETE and 0 <= p < len(doc):  # out-of-range delete: no-op
             del doc[p]
     return "".join(doc)

@@ -56,15 +56,19 @@ class TestPatch(NamedTuple):
     del_count: int
     ins: str
 
+    __test__ = False  # "Test*" name; keep pytest collection away
+
 
 @dataclass
 class TestTxn:
+    __test__ = False  # "Test*" name; keep pytest collection away
     time: str
     patches: list[TestPatch] = field(default_factory=list)
 
 
 @dataclass
 class TestData:
+    __test__ = False  # "Test*" name; keep pytest collection away
     start_content: str
     end_content: str
     txns: list[TestTxn]

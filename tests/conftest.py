@@ -3,20 +3,32 @@
 Multi-chip TPU hardware is not available in CI; sharding/collective paths are
 validated on host CPU devices instead (the driver separately dry-run-compiles
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+This environment's sitecustomize registers the single-client axon TPU plugin
+in every python process and force-overrides the ``jax_platforms`` config to
+"axon,cpu", so env vars alone cannot keep tests off the TPU.  Overriding the
+config again here — before any backend is initialized — reliably pins tests
+to CPU (a second TPU client would deadlock against any concurrently running
+jax process, and TPU compiles are far too slow for this many test shapes).
+Set CRDT_TPU_TESTS=1 to opt out and run tests on the real chip (serially,
+with nothing else using it).
 """
 
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("CRDT_TPU_TESTS") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,127 @@
+"""JAX engine correctness: resolve+apply vs the pure-Python oracle,
+byte-identical (the upgrade over the reference's length-only assert,
+src/main.rs:35)."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.oracle import replay_unit_ops
+from crdt_benches_tpu.traces import tensorize
+from crdt_benches_tpu.traces.loader import TestData, TestTxn, TestPatch
+from crdt_benches_tpu.traces.tensorize import TensorizedTrace, DELETE, INSERT
+from crdt_benches_tpu.engine.replay import ReplayEngine, replay_trace_jax
+
+
+def tensorize_ops(kinds, poss, chs, batch=8, start=""):
+    """Build a TensorizedTrace directly from unit ops (test helper)."""
+    kind = np.asarray(kinds, np.int32)
+    pos = np.asarray(poss, np.int32)
+    ch = np.asarray(chs, np.int32)
+    n = len(kind)
+    n_pad = (-n) % batch if n else batch
+    kind = np.concatenate([kind, np.zeros(n_pad, np.int32)])
+    pos = np.concatenate([pos, np.zeros(n_pad, np.int32)])
+    ch = np.concatenate([ch, np.zeros(n_pad, np.int32)])
+    init = np.asarray([ord(c) for c in start], np.int32)
+    s = len(init)
+    is_ins = kind == INSERT
+    slot = np.where(is_ins, s + np.cumsum(is_ins) - 1, -1).astype(np.int32)
+    n_ins = int(is_ins.sum())
+    return TensorizedTrace(
+        kind=kind, pos=pos, ch=ch, slot=slot, init_chars=init,
+        n_ops=n, n_patches=n, n_inserts=n_ins, capacity=s + n_ins,
+        batch=batch, end_content="",
+    )
+
+
+def check(kinds, poss, chs, batch=8, start=""):
+    tt = tensorize_ops(kinds, poss, chs, batch=batch, start=start)
+    want = replay_unit_ops(
+        tt.kind[: tt.n_ops], tt.pos[: tt.n_ops], tt.ch[: tt.n_ops], start=start
+    )
+    got = replay_trace_jax(tt)
+    assert got == want, f"got {got!r} want {want!r}"
+
+
+A, B_, C_ = ord("a"), ord("b"), ord("c")
+
+
+def test_append_only():
+    check([INSERT] * 4, [0, 1, 2, 3], [A, B_, C_, A])
+
+
+def test_insert_at_head_repeatedly():
+    check([INSERT] * 4, [0, 0, 0, 0], [A, B_, C_, A])
+
+
+def test_insert_middle():
+    # "ab" then 'c' between them
+    check([INSERT] * 3, [0, 1, 1], [A, B_, C_])
+
+
+def test_delete_simple():
+    check([INSERT, INSERT, DELETE], [0, 1, 0], [A, B_, 0])
+
+
+def test_delete_batch_insert_same_batch():
+    # insert 3, delete the middle one, insert again at that spot
+    check(
+        [INSERT, INSERT, INSERT, DELETE, INSERT],
+        [0, 1, 2, 1, 1],
+        [A, B_, C_, 0, A],
+    )
+
+
+def test_cross_batch_boundary():
+    # batch=2 forces resolution state handoff across batches
+    check([INSERT] * 5 + [DELETE] * 2, [0, 0, 1, 3, 2, 1, 1], [A, B_, C_, A, B_, 0, 0], batch=2)
+
+
+def test_with_start_content():
+    check([INSERT, DELETE, INSERT], [3, 0, 4], [A, 0, B_], start="xyz")
+
+
+def test_delete_then_insert_at_same_pos_across_batches():
+    check([INSERT, INSERT, DELETE, INSERT], [0, 1, 0, 0], [A, B_, 0, C_], batch=2)
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("batch", [4, 16, 64])
+def test_random_streams(seed, batch):
+    """Property test: random valid unit-op streams, byte-identical replay."""
+    rng = np.random.default_rng(seed)
+    n = 300
+    doc_len = 0
+    kinds, poss, chs = [], [], []
+    for _ in range(n):
+        if doc_len == 0 or rng.random() < 0.65:
+            kinds.append(INSERT)
+            poss.append(int(rng.integers(0, doc_len + 1)))
+            chs.append(int(rng.integers(A, A + 26)))
+            doc_len += 1
+        else:
+            kinds.append(DELETE)
+            poss.append(int(rng.integers(0, doc_len)))
+            chs.append(0)
+            doc_len -= 1
+    check(kinds, poss, chs, batch=batch)
+
+
+def test_svelte_full_trace_byte_identical(svelte_trace):
+    """Config 2 of BASELINE.json: sveltecomponent, 1 replica, CPU JAX backend,
+    byte-identical final document."""
+    tt = tensorize(svelte_trace, batch=256)
+    got = replay_trace_jax(tt)
+    assert got == svelte_trace.end_content
+
+
+def test_vmap_replicas_agree(svelte_trace):
+    """4 replicas replaying the same trace must all converge byte-identically
+    (the de-facto cross-implementation agreement test of the reference,
+    SURVEY.md section 4.3)."""
+    tt = tensorize(svelte_trace, batch=256)
+    eng = ReplayEngine(tt, n_replicas=4)
+    state = eng.run_blocking()
+    assert (eng.lengths(state) == len(svelte_trace.end_content)).all()
+    for r in (0, 3):
+        assert eng.decode(state, replica=r) == svelte_trace.end_content
