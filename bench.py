@@ -1,0 +1,90 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Metric (BASELINE.json north star): aggregate CRDT replay throughput on the
+automerge-paper trace, many replicas batched on one chip via the JAX engine,
+in elements/sec (element = one trace patch, the reference's Criterion
+throughput unit, reference src/main.rs:25).
+
+vs_baseline = aggregate JAX throughput / single-core native C++ CRDT
+throughput on the same trace (the reference's workload is a single-threaded
+CRDT replay on one CPU core; our cpp-crdt treap engine is the local
+stand-in since no reference numbers are published — BASELINE.md).
+
+Environment knobs:
+  CRDT_BENCH_TRACE     trace name (default automerge-paper)
+  CRDT_BENCH_REPLICAS  replica count (default auto: 256 on TPU, 8 on CPU)
+  CRDT_BENCH_SAMPLES   timed samples (default 3)
+  CRDT_BENCH_BATCH     op batch size (default 512)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    trace_name = os.environ.get("CRDT_BENCH_TRACE", "automerge-paper")
+    samples = int(os.environ.get("CRDT_BENCH_SAMPLES", "3"))
+    batch = int(os.environ.get("CRDT_BENCH_BATCH", "512"))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from crdt_benches_tpu.bench.harness import measure
+    from crdt_benches_tpu.traces.loader import load_testing_data
+    from crdt_benches_tpu.traces.patches import patch_arrays
+
+    trace = load_testing_data(trace_name)
+    elements = len(trace)
+    end_len = len(trace.end_content)
+
+    # ---- single-core native CRDT baseline (untimed setup, timed replay) ----
+    baseline_eps = None
+    try:
+        from crdt_benches_tpu.backends.native import CppCrdt, native_available
+
+        if native_available():
+            pa = patch_arrays(trace)
+
+            def native_iter():
+                assert CppCrdt.replay_patches(pa) == end_len
+
+            times = measure(native_iter, warmup=1, samples=samples)
+            baseline_eps = elements / min(times)
+    except Exception as e:  # baseline is advisory; the metric must still print
+        print(f"native baseline failed: {e}", file=sys.stderr)
+
+    # ---- JAX batched replay ----
+    import jax
+
+    platform = jax.devices()[0].platform
+    default_r = 256 if platform not in ("cpu",) else 8
+    replicas = int(os.environ.get("CRDT_BENCH_REPLICAS", str(default_r)))
+
+    from crdt_benches_tpu.backends.jax_backend import JaxReplayBackend
+
+    backend = JaxReplayBackend(n_replicas=replicas, batch=batch)
+    backend.prepare(trace)
+    times = measure(backend.replay_once, warmup=1, samples=samples)
+    agg_eps = elements * replicas / min(times)
+
+    vs = agg_eps / baseline_eps if baseline_eps else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"{trace_name} aggregate replay throughput, "
+                    f"{replicas} replicas, jax-{platform} "
+                    f"(baseline: cpp-crdt 1 core)"
+                ),
+                "value": round(agg_eps, 1),
+                "unit": "elements/sec",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""The pluggable-backend traits — the capability at the center of the
+reference's design (SURVEY.md section 2.3): one uniform editing interface over
+interchangeable document/CRDT engines, with per-backend offset units.
+
+Mirrors the reference's two traits:
+
+- ``Upstream`` (reference src/rope.rs:6-33): ``NAME``,
+  ``EDITS_USE_BYTE_OFFSETS`` (default False), ``from_str`` / ``insert`` /
+  ``remove`` / ``__len__``, and a default ``replace`` = remove-then-insert.
+- ``Downstream`` (reference src/rope.rs:185-191): ``upstream_updates(trace)``
+  pre-generates one encoded update per patch by replaying the trace on a
+  separate upstream replica (untimed), and ``apply_update`` integrates one
+  update into this replica (timed).
+
+Backends that operate on whole op *batches* (the JAX engine) additionally
+implement ``BatchedReplay``, the TPU-native face of the same capability — the
+bench harness prefers it when present so the replay loop runs on-device
+instead of through per-op Python calls.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Sequence
+
+from ..traces.loader import TestData
+
+
+class Upstream(ABC):
+    """Uniform local-editing interface over document engines."""
+
+    NAME: str = "?"
+    #: If True the bench feeds byte offsets (trace.chars_to_bytes()), matching
+    #: the reference's cola/yrs adapters (src/rope.rs:82,147).
+    EDITS_USE_BYTE_OFFSETS: bool = False
+
+    @classmethod
+    @abstractmethod
+    def from_str(cls, s: str) -> "Upstream":
+        ...
+
+    @abstractmethod
+    def insert(self, at: int, text: str) -> None:
+        ...
+
+    @abstractmethod
+    def remove(self, start: int, end: int) -> None:
+        ...
+
+    @abstractmethod
+    def __len__(self) -> int:
+        """Length in codepoints, or bytes when EDITS_USE_BYTE_OFFSETS."""
+
+    def replace(self, start: int, end: int, text: str) -> None:
+        """Default: remove-then-insert (reference src/rope.rs:21-32)."""
+        if end > start:
+            self.remove(start, end)
+        if text:
+            self.insert(start, text)
+
+    def content(self) -> str | None:
+        """Final document content, if the backend stores text (cola-style
+        length-only engines return None; reference src/rope.rs:86-97)."""
+        return None
+
+
+class Downstream(ABC):
+    """Remote-replica interface: pre-generated updates, timed apply."""
+
+    NAME: str = "?"
+    EDITS_USE_BYTE_OFFSETS: bool = False
+
+    @classmethod
+    @abstractmethod
+    def upstream_updates(cls, trace: TestData) -> tuple["Downstream", Sequence[Any]]:
+        """Replay ``trace`` on a fresh upstream replica, emitting one encoded
+        update per patch; return (fresh downstream replica, updates)."""
+
+    @abstractmethod
+    def apply_update(self, update: Any) -> None:
+        ...
+
+    @abstractmethod
+    def __len__(self) -> int:
+        ...
+
+    def clone(self) -> "Downstream":
+        """Fresh copy for one timed iteration (reference src/main.rs:64)."""
+        raise NotImplementedError
+
+
+class BatchedReplay(ABC):
+    """Whole-trace replay interface for batched/on-device backends.
+
+    The timed region covers document init + full replay + the final length
+    check, matching the reference's timed closure (src/main.rs:28-37)."""
+
+    NAME: str = "?"
+
+    @abstractmethod
+    def prepare(self, trace: TestData) -> None:
+        """Untimed: load/tensorize/stage the trace (analog of trace loading
+        at src/main.rs:19, which Criterion does not time)."""
+
+    @abstractmethod
+    def replay_once(self) -> int:
+        """Timed: init + replay + return final length (blocking)."""
+
+    def final_content(self) -> str | None:
+        return None
+
+    @property
+    def replicas(self) -> int:
+        return 1
+
+
+_UPSTREAM_REGISTRY: dict[str, type] = {}
+_DOWNSTREAM_REGISTRY: dict[str, type] = {}
+
+
+def register_upstream(cls):
+    _UPSTREAM_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def register_downstream(cls):
+    _DOWNSTREAM_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def upstream_backends() -> dict[str, type]:
+    return dict(_UPSTREAM_REGISTRY)
+
+
+def downstream_backends() -> dict[str, type]:
+    return dict(_DOWNSTREAM_REGISTRY)

@@ -1,0 +1,55 @@
+"""JAX engine as a bench backend (the 'tpu'/'jax' column of the bench table).
+
+Implements BatchedReplay: the timed region is document init + full replay +
+final length fetch with ``block_until_ready`` (matching the reference's timed
+closure — doc init and final check included, reference src/main.rs:28-37 —
+plus honest device sync, SURVEY.md section 7 hard-part 6).  Trace
+tensorization and op upload happen untimed in ``prepare`` (the analog of
+untimed trace loading, src/main.rs:19).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..engine.replay import ReplayEngine
+from ..traces.loader import TestData
+from ..traces.tensorize import tensorize
+from .base import BatchedReplay
+
+
+class JaxReplayBackend(BatchedReplay):
+    def __init__(self, n_replicas: int = 1, batch: int = 256):
+        self.n_replicas = n_replicas
+        self.batch = batch
+        self._eng: ReplayEngine | None = None
+        self._tt = None
+
+    @property
+    def NAME(self) -> str:  # type: ignore[override]
+        plat = jax.devices()[0].platform
+        return f"jax-{plat}" + (f"-r{self.n_replicas}" if self.n_replicas > 1 else "")
+
+    @property
+    def replicas(self) -> int:
+        return self.n_replicas
+
+    def prepare(self, trace: TestData) -> None:
+        self._tt = tensorize(trace, batch=self.batch)
+        self._eng = ReplayEngine(self._tt, n_replicas=self.n_replicas)
+        self._end_len = len(trace.end_content)
+
+    def replay_once(self) -> int:
+        eng = self._eng
+        state = eng.run()  # includes fresh_state init (timed, as in reference)
+        lengths = np.asarray(state.nvis)  # device->host sync point
+        n = int(lengths.reshape(-1)[0])
+        assert (lengths == self._end_len).all(), (
+            f"length mismatch: {lengths} != {self._end_len}"
+        )
+        return n
+
+    def final_content(self) -> str:
+        state = self._eng.run_blocking()
+        return self._eng.decode(state)

@@ -1,0 +1,257 @@
+"""ctypes bindings to the C++ native tier (native/libcrdtnative.so).
+
+Two backends behind the Upstream trait (reference src/rope.rs:6-33):
+
+- ``CppRope`` — gap-buffer text rope; the "CPU rope backend" baseline column
+  of the bench table (BASELINE.md config 1).
+- ``CppCrdt`` — treap-based sequence CRDT with op log + incremental update
+  encode/decode; also implements Downstream (reference src/rope.rs:185-225
+  capability).
+
+Each also exposes a ``replay_patches`` one-call path so benchmark iterations
+run the hot loop natively (per-op ctypes calls would measure FFI overhead,
+not the engine).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ..traces.loader import TestData
+from ..traces.patches import PatchArrays, patch_arrays
+from .base import Downstream, Upstream, register_downstream, register_upstream
+
+_LIB_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libcrdtnative.so"),
+    "./native/libcrdtnative.so",
+)
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i64 = ctypes.c_int64
+_vp = ctypes.c_void_p
+
+
+def _load_lib():
+    for p in _LIB_PATHS:
+        if os.path.exists(p):
+            lib = ctypes.CDLL(os.path.normpath(p))
+            break
+    else:
+        raise OSError(
+            "libcrdtnative.so not found — build it with `make -C native`"
+        )
+    sig = lambda fn, res, args: (setattr(fn, "restype", res), setattr(fn, "argtypes", args))
+    sig(lib.rope_new, _vp, [_i32p, _i64])
+    sig(lib.rope_free, None, [_vp])
+    sig(lib.rope_len, _i64, [_vp])
+    sig(lib.rope_insert, None, [_vp, _i64, _i32p, _i64])
+    sig(lib.rope_remove, None, [_vp, _i64, _i64])
+    sig(lib.rope_read, None, [_vp, _i32p])
+    sig(lib.rope_replay, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64])
+    sig(lib.rope_replay_read, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _i32p, _i64])
+    sig(lib.crdt_new, _vp, [_i32p, _i64, ctypes.c_uint32])
+    sig(lib.crdt_free, None, [_vp])
+    sig(lib.crdt_len, _i64, [_vp])
+    sig(lib.crdt_oplog_len, _i64, [_vp])
+    sig(lib.crdt_insert, None, [_vp, _i64, _i32p, _i64])
+    sig(lib.crdt_remove, None, [_vp, _i64, _i64])
+    sig(lib.crdt_read, None, [_vp, _i32p])
+    sig(lib.crdt_encode_from, _i64, [_vp, _i64, _u8p, _i64])
+    sig(lib.crdt_apply_update, None, [_vp, _u8p, _i64])
+    sig(lib.crdt_apply_updates, _i64, [_vp, _u8p, _i64p, _i64])
+    sig(lib.crdt_replay, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64])
+    sig(lib.crdt_gen_updates, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _u8p, _i64, _i64p])
+    return lib
+
+
+_lib = None
+
+
+def lib():
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+def native_available() -> bool:
+    try:
+        lib()
+        return True
+    except OSError:
+        return False
+
+
+def _codes(s: str) -> np.ndarray:
+    return np.asarray([ord(c) for c in s], np.int32)
+
+
+@register_upstream
+class CppRope(Upstream):
+    """Gap-buffer rope (native/rope.cpp)."""
+
+    NAME = "cpp-rope"
+
+    def __init__(self, handle):
+        self._h = handle
+
+    @classmethod
+    def from_str(cls, s: str) -> "CppRope":
+        return cls(lib().rope_new(_codes(s), len(s)))
+
+    def insert(self, at: int, text: str) -> None:
+        lib().rope_insert(self._h, at, _codes(text), len(text))
+
+    def remove(self, start: int, end: int) -> None:
+        lib().rope_remove(self._h, start, end)
+
+    def __len__(self) -> int:
+        return lib().rope_len(self._h)
+
+    def content(self) -> str:
+        out = np.zeros(len(self), np.int32)
+        lib().rope_read(self._h, out)
+        return "".join(map(chr, out.tolist()))
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            lib().rope_free(self._h)
+            self._h = None
+
+    # fast whole-iteration path
+    @staticmethod
+    def replay_patches(pa: PatchArrays) -> int:
+        return lib().rope_replay(
+            pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
+            pa.ins_flat, pa.n_patches,
+        )
+
+    @staticmethod
+    def replay_patches_content(pa: PatchArrays) -> str:
+        out = np.zeros(max(pa.end_len * 2 + 16, 64), np.int32)
+        n = lib().rope_replay_read(
+            pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
+            pa.ins_flat, pa.n_patches, out, len(out),
+        )
+        return "".join(map(chr, out[:n].tolist()))
+
+
+@register_upstream
+class CppCrdt(Upstream):
+    """Treap op-log sequence CRDT (native/crdt.cpp)."""
+
+    NAME = "cpp-crdt"
+
+    def __init__(self, handle):
+        self._h = handle
+
+    @classmethod
+    def from_str(cls, s: str, agent: int = 1) -> "CppCrdt":
+        return cls(lib().crdt_new(_codes(s), len(s), agent))
+
+    def insert(self, at: int, text: str) -> None:
+        lib().crdt_insert(self._h, at, _codes(text), len(text))
+
+    def remove(self, start: int, end: int) -> None:
+        lib().crdt_remove(self._h, start, end)
+
+    def __len__(self) -> int:
+        return lib().crdt_len(self._h)
+
+    def content(self) -> str:
+        out = np.zeros(len(self), np.int32)
+        lib().crdt_read(self._h, out)
+        return "".join(map(chr, out.tolist()))
+
+    def oplog_len(self) -> int:
+        return lib().crdt_oplog_len(self._h)
+
+    def encode_from(self, from_op: int) -> bytes:
+        buf = np.zeros(4096, np.uint8)
+        n = lib().crdt_encode_from(self._h, from_op, buf, len(buf))
+        if n < 0:
+            buf = np.zeros(-n, np.uint8)
+            n = lib().crdt_encode_from(self._h, from_op, buf, len(buf))
+        return bytes(buf[:n].tobytes())
+
+    def apply_update(self, update: bytes) -> None:
+        arr = np.frombuffer(update, np.uint8)
+        lib().crdt_apply_update(self._h, arr, len(arr))
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            lib().crdt_free(self._h)
+            self._h = None
+
+    @staticmethod
+    def replay_patches(pa: PatchArrays) -> int:
+        return lib().crdt_replay(
+            pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
+            pa.ins_flat, pa.n_patches,
+        )
+
+
+@register_downstream
+class CppCrdtDownstream(Downstream):
+    """Downstream over the native CRDT: one encoded update per patch,
+    generated untimed on a separate upstream replica; timed apply loop runs
+    in one native call (reference src/main.rs:50-70 semantics)."""
+
+    NAME = "cpp-crdt"
+
+    def __init__(self, start_content: str, flat: np.ndarray, offsets: np.ndarray):
+        self._start = start_content
+        self._flat = flat
+        self._offsets = offsets
+        self._doc = CppCrdt.from_str(start_content, agent=2)
+
+    OP_WIRE = 21  # bytes per op record (native/crdt.cpp OP_WIRE)
+
+    @classmethod
+    def upstream_updates(cls, trace: TestData):
+        pa = patch_arrays(trace)
+        # exact size: one wire record per unit op (delete or inserted char)
+        cap = int(pa.del_count.sum() + len(pa.ins_flat)) * cls.OP_WIRE
+        offsets = np.zeros(pa.n_patches + 1, np.int64)
+        buf = np.zeros(max(cap, 1), np.uint8)
+        n = lib().crdt_gen_updates(
+            pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
+            pa.ins_flat, pa.n_patches, buf, len(buf), offsets,
+        )
+        assert n >= 0, f"update buffer undersized: need {-n}, had {cap}"
+        inst = cls(trace.start_content, buf[:n], offsets)
+        updates = [
+            bytes(buf[offsets[i] : offsets[i + 1]].tobytes())
+            for i in range(pa.n_patches)
+        ]
+        return inst, updates
+
+    def clone(self) -> "CppCrdtDownstream":
+        return CppCrdtDownstream(self._start, self._flat, self._offsets)
+
+    def apply_update(self, update: bytes) -> None:
+        self._doc.apply_update(update)
+
+    def apply_all_native(self) -> int:
+        """The whole timed downstream iteration in one native call: fresh
+        replica + apply every update + final length.  The fresh replica
+        becomes this object's document, so ``len``/``content`` afterwards
+        reflect the run."""
+        doc = CppCrdt.from_str(self._start, agent=2)
+        n = lib().crdt_apply_updates(
+            doc._h, self._flat, self._offsets, len(self._offsets) - 1
+        )
+        self._doc = doc
+        return n
+
+    def __len__(self) -> int:
+        return len(self._doc)
+
+    def content(self) -> str:
+        return self._doc.content()

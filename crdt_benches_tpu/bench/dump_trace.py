@@ -1,0 +1,33 @@
+"""Dump a trace as the flat binary format consumed by native/bench_native.
+
+Usage: python -m crdt_benches_tpu.bench.dump_trace <trace-name> [out.bin]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..traces.loader import load_testing_data
+from ..traces.patches import patch_arrays
+
+
+def dump(name: str, out_path: str | None = None) -> str:
+    trace = load_testing_data(name)
+    pa = patch_arrays(trace)
+    out_path = out_path or f"/tmp/{name}.bin"
+    with open(out_path, "wb") as f:
+        np.asarray([pa.n_patches, len(pa.init), len(pa.ins_flat)], np.int64).tofile(f)
+        pa.pos.tofile(f)
+        pa.del_count.tofile(f)
+        pa.ins_off.tofile(f)
+        pa.ins_flat.tofile(f)
+        pa.init.tofile(f)
+    return out_path
+
+
+if __name__ == "__main__":
+    name = sys.argv[1] if len(sys.argv) > 1 else "automerge-paper"
+    out = dump(name, sys.argv[2] if len(sys.argv) > 2 else None)
+    print(out)

@@ -1,0 +1,165 @@
+"""Criterion-equivalent measurement harness.
+
+Re-provides the measurement capabilities the reference gets from the
+``criterion`` crate (reference src/main.rs:25-37 and SURVEY.md section 2.2):
+warmup, repeated timed samples, robust statistics (median/mean/stddev/min),
+throughput in **elements/sec where element = one trace patch**
+(``Throughput::Elements``, reference src/main.rs:25), benchmark ids of the
+form ``group/trace/backend`` (``BenchmarkId::new``, src/main.rs:27), JSON
+result persistence, and named baseline save/compare (criterion's
+``--save-baseline`` / ``--baseline`` CLI capability).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Callable
+
+RESULTS_DIR = "bench_results"
+
+
+@dataclass
+class Sample:
+    seconds: float
+
+
+@dataclass
+class BenchResult:
+    group: str  # "upstream" | "downstream" | ...
+    trace: str
+    backend: str
+    elements: int  # throughput element count (= patch count)
+    samples: list[float] = field(default_factory=list)
+    replicas: int = 1
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def bench_id(self) -> str:
+        return f"{self.group}/{self.trace}/{self.backend}"
+
+    @property
+    def median(self) -> float:
+        s = sorted(self.samples)
+        n = len(s)
+        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+    @property
+    def mean(self) -> float:
+        return sum(self.samples) / len(self.samples)
+
+    @property
+    def stddev(self) -> float:
+        if len(self.samples) < 2:
+            return 0.0
+        m = self.mean
+        return math.sqrt(sum((x - m) ** 2 for x in self.samples) / (len(self.samples) - 1))
+
+    @property
+    def best(self) -> float:
+        return min(self.samples)
+
+    @property
+    def elements_per_sec(self) -> float:
+        """Criterion throughput: elements / median sample time, scaled by the
+        replica count for batched backends (aggregate throughput)."""
+        return self.elements * self.replicas / self.median
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d.update(
+            median=self.median,
+            mean=self.mean,
+            stddev=self.stddev,
+            elements_per_sec=self.elements_per_sec,
+        )
+        return d
+
+
+def measure(
+    fn: Callable[[], object],
+    *,
+    warmup: int = 1,
+    samples: int = 5,
+    min_sample_time: float = 0.0,
+) -> list[float]:
+    """Time ``fn`` ``samples`` times after ``warmup`` untimed calls.
+
+    ``fn`` must be synchronous/blocking (device backends call
+    ``block_until_ready`` internally — honest timing per SURVEY.md section 7
+    hard-part 6).  If one call is shorter than ``min_sample_time``, loops
+    within the sample and divides (criterion's iteration batching).
+    """
+    for _ in range(warmup):
+        fn()
+    out: list[float] = []
+    for _ in range(samples):
+        iters = 0
+        t0 = time.perf_counter()
+        while True:
+            fn()
+            iters += 1
+            dt = time.perf_counter() - t0
+            if dt >= min_sample_time:
+                break
+        out.append(dt / iters)
+    return out
+
+
+# ---- persistence / baselines (criterion --save-baseline / --baseline) ----
+
+
+def save_results(results: list[BenchResult], name: str = "latest",
+                 results_dir: str = RESULTS_DIR) -> str:
+    os.makedirs(results_dir, exist_ok=True)
+    path = os.path.join(results_dir, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump([r.to_dict() for r in results], f, indent=2)
+    return path
+
+
+def load_results(name: str, results_dir: str = RESULTS_DIR) -> dict[str, dict]:
+    path = os.path.join(results_dir, f"{name}.json")
+    with open(path) as f:
+        return {d["group"] + "/" + d["trace"] + "/" + d["backend"]: d for d in json.load(f)}
+
+
+def compare_to_baseline(
+    results: list[BenchResult], baseline_name: str, results_dir: str = RESULTS_DIR
+) -> list[str]:
+    """Human-readable change report vs a saved baseline (criterion's
+    regression comparison capability)."""
+    base = load_results(baseline_name, results_dir)
+    lines = []
+    for r in results:
+        b = base.get(r.bench_id)
+        if not b:
+            lines.append(f"{r.bench_id}: new")
+            continue
+        change = (r.median - b["median"]) / b["median"] * 100.0
+        lines.append(
+            f"{r.bench_id}: {r.median * 1e3:.2f}ms vs {b['median'] * 1e3:.2f}ms "
+            f"({change:+.1f}%)"
+        )
+    return lines
+
+
+def markdown_table(results: list[BenchResult]) -> str:
+    """The bench table: one row per (group, trace), one column per backend
+    (the 'tpu column next to the CPU rope baseline' of the north star)."""
+    backends = sorted({r.backend for r in results})
+    rows: dict[tuple[str, str], dict[str, BenchResult]] = {}
+    for r in results:
+        rows.setdefault((r.group, r.trace), {})[r.backend] = r
+    out = ["| group | trace | " + " | ".join(backends) + " |"]
+    out.append("|---" * (2 + len(backends)) + "|")
+    for (group, trace), by_backend in sorted(rows.items()):
+        cells = []
+        for b in backends:
+            r = by_backend.get(b)
+            cells.append(f"{r.elements_per_sec:,.0f}/s" if r else "—")
+        out.append(f"| {group} | {trace} | " + " | ".join(cells) + " |")
+    return "\n".join(out)

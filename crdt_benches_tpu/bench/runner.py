@@ -1,0 +1,170 @@
+"""Bench matrix runner — the harness entry point (the capability of the
+reference's Criterion main, reference src/main.rs:17-85), configurable
+instead of hardcoded (SURVEY.md section 5 "config system": the trace list and
+backend matrix were consts/commented code at src/main.rs:10-15,43-46,76-79).
+
+Groups:
+  upstream    — local-edit replay throughput per (trace x backend)
+  downstream  — remote-update-apply throughput per (trace x backend)
+
+Usage:
+  python -m crdt_benches_tpu.bench.runner --traces sveltecomponent \
+      --backends cpp-rope,cpp-crdt,jax --replicas 8 --samples 5 \
+      [--save-baseline NAME] [--baseline NAME] [--filter upstream]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..traces.loader import TRACES, load_testing_data
+from ..traces.patches import patch_arrays
+from ..backends.base import upstream_backends
+from .harness import (
+    BenchResult,
+    compare_to_baseline,
+    markdown_table,
+    measure,
+    save_results,
+)
+
+
+def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
+                 replicas: int, batch: int) -> BenchResult | None:
+    trace = load_testing_data(trace_name)
+    elements = len(trace)
+    native_names = _native_upstreams()
+    if backend in native_names:
+        from ..backends.native import native_available
+
+        if not native_available():
+            return None
+        pa = patch_arrays(trace)
+        cls = native_names[backend]
+        end_len = len(trace.end_content)
+
+        def iter_fn():
+            n = cls.replay_patches(pa)
+            assert n == end_len, f"{backend}: {n} != {end_len}"
+
+        times = measure(iter_fn, warmup=warmup, samples=samples,
+                        min_sample_time=0.05)
+        return BenchResult("upstream", trace_name, backend, elements, times)
+    if backend == "python-oracle":
+        from ..oracle import OracleDocument
+
+        def iter_fn():
+            doc = OracleDocument.from_str(trace.start_content)
+            for pos, d, ins in trace.iter_patches():
+                doc.replace(pos, pos + d, ins)
+            assert len(doc) == len(trace.end_content)
+
+        times = measure(iter_fn, warmup=0, samples=max(2, samples // 2))
+        return BenchResult("upstream", trace_name, backend, elements, times)
+    if backend == "jax":
+        from ..backends.jax_backend import JaxReplayBackend
+
+        b = JaxReplayBackend(n_replicas=replicas, batch=batch)
+        b.prepare(trace)
+        times = measure(b.replay_once, warmup=warmup, samples=samples)
+        return BenchResult(
+            "upstream", trace_name, b.NAME, elements, times, replicas=replicas
+        )
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def _native_upstreams() -> dict[str, type]:
+    """Registered Upstream backends with a native whole-replay path
+    (@register_upstream in backends/native.py populates the registry)."""
+    try:
+        from ..backends import native  # noqa: F401  (triggers registration)
+    except OSError:
+        pass
+    return {
+        name: cls
+        for name, cls in upstream_backends().items()
+        if hasattr(cls, "replay_patches")
+    }
+
+
+def run_downstream(trace_name: str, backend: str, samples: int,
+                   warmup: int) -> BenchResult | None:
+    trace = load_testing_data(trace_name)
+    elements = len(trace)
+    if backend == "cpp-crdt":
+        from ..backends.native import CppCrdtDownstream, native_available
+
+        if not native_available():
+            return None
+        down, _updates = CppCrdtDownstream.upstream_updates(trace)  # untimed
+        end_len = len(trace.end_content)
+
+        def iter_fn():
+            n = down.apply_all_native()
+            assert n == end_len
+
+        times = measure(iter_fn, warmup=warmup, samples=samples,
+                        min_sample_time=0.05)
+        return BenchResult("downstream", trace_name, backend, elements, times)
+    if backend == "jax":
+        try:
+            from ..engine.downstream import JaxDownstreamBackend
+        except ImportError:
+            return None
+        b = JaxDownstreamBackend()
+        b.prepare(trace)
+        times = measure(b.replay_once, warmup=warmup, samples=samples)
+        return BenchResult("downstream", trace_name, b.NAME, elements, times)
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--traces", default=",".join(TRACES))
+    ap.add_argument("--backends", default="cpp-rope,cpp-crdt,jax")
+    ap.add_argument("--filter", default="", help="substring filter on group")
+    ap.add_argument("--samples", type=int, default=5)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--save-baseline", default=None)
+    ap.add_argument("--baseline", default=None)
+    args = ap.parse_args(argv)
+
+    results: list[BenchResult] = []
+    for trace in args.traces.split(","):
+        for backend in args.backends.split(","):
+            if not args.filter or args.filter in "upstream":
+                r = run_upstream(trace, backend, args.samples, args.warmup,
+                                 args.replicas, args.batch)
+                if r:
+                    results.append(r)
+                    print(
+                        f"upstream/{trace}/{r.backend}: median "
+                        f"{r.median * 1e3:.2f}ms -> {r.elements_per_sec:,.0f} el/s",
+                        file=sys.stderr,
+                    )
+            if backend in ("cpp-crdt", "jax") and (
+                not args.filter or args.filter in "downstream"
+            ):
+                r = run_downstream(trace, backend, args.samples, args.warmup)
+                if r:
+                    results.append(r)
+                    print(
+                        f"downstream/{trace}/{r.backend}: median "
+                        f"{r.median * 1e3:.2f}ms -> {r.elements_per_sec:,.0f} el/s",
+                        file=sys.stderr,
+                    )
+
+    print(markdown_table(results))
+    save_results(results, "latest")
+    if args.save_baseline:
+        save_results(results, args.save_baseline)
+    if args.baseline:
+        print("\n".join(compare_to_baseline(results, args.baseline)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

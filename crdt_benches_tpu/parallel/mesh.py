@@ -1,0 +1,110 @@
+"""Device-mesh scaling: replicas sharded over devices via shard_map.
+
+The reference has no parallelism at all (SURVEY.md section 2.3 — one
+synchronous thread); the TPU-native replacement axes are:
+
+- **replica-parallelism** (the DP analog): simulated replicas sharded over a
+  ``replicas`` mesh axis, each shard vmapping its local replicas;
+- **cross-replica reduction**: convergence checking via ``pmin``/``pmax``/
+  ``psum`` over the mesh axis (the downstream/merge analog of reference
+  src/main.rs:65-68), riding ICI within a slice / DCN across slices — these
+  are XLA collectives, not a hand-rolled comm backend.
+
+Works identically on a real multi-chip mesh and on a virtual
+``--xla_force_host_platform_device_count`` CPU mesh (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.apply import DocState, apply_batch, init_state
+from ..ops.resolve import resolve_batch
+from ..utils.digest import doc_digest
+
+AXIS = "replicas"
+
+
+def replica_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (AXIS,))
+
+
+def _local_replay_step(state: DocState, kind, pos, slot) -> DocState:
+    """One op-batch step for a single replica (resolve + apply)."""
+    resolved = resolve_batch(kind, pos, state.nvis)
+    return apply_batch(state, resolved, slot)
+
+
+def sharded_replay_and_digest(mesh: Mesh):
+    """Build the full sharded step: every shard replays its local replicas
+    through all op batches, computes local digests, then the mesh agrees on
+    convergence via pmin/pmax collectives.
+
+    Returns (step_fn, state_sharding).  ``step_fn(state, kind_b, pos_b,
+    slot_b, chars) -> (state, digests, converged)`` where state/digests are
+    sharded over replicas and ``converged`` is a replicated scalar bool.
+    """
+
+    def shard_body(state: DocState, kind_b, pos_b, slot_b, chars):
+        def batch_step(st, batch):
+            k, p, s = batch
+            return jax.vmap(_local_replay_step, in_axes=(0, None, None, None))(
+                st, k, p, s
+            ), None
+
+        state, _ = jax.lax.scan(batch_step, state, (kind_b, pos_b, slot_b))
+        digests = jax.vmap(
+            lambda st: doc_digest(st.order, st.visible, st.length, chars)
+        )(state)
+        # Convergence across ALL replicas on ALL devices: every digest equal.
+        local_min = jnp.min(digests, axis=0)
+        local_max = jnp.max(digests, axis=0)
+        gmin = jax.lax.pmin(local_min, AXIS)
+        gmax = jax.lax.pmax(local_max, AXIS)
+        converged = jnp.all(gmin == gmax)
+        return state, digests, converged
+
+    from jax.experimental.shard_map import shard_map
+
+    dummy = DocState(0, 0, 0, 0, 0)
+    state_spec = jax.tree.map(lambda _: P(AXIS), dummy)
+    step = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(state_spec, P(), P(), P(), P()),
+        out_specs=(state_spec, P(AXIS), P()),
+        check_rep=False,
+    )
+    state_sharding = jax.tree.map(
+        lambda _: NamedSharding(mesh, P(AXIS)), dummy
+    )
+    return jax.jit(step), state_sharding
+
+
+def make_sharded_state(
+    mesh: Mesh, n_replicas: int, capacity: int, n_init: int = 0
+) -> DocState:
+    """Replica states sharded over the mesh: (R, C) arrays with R split
+    across devices."""
+    if n_replicas % mesh.devices.size:
+        raise ValueError(
+            f"n_replicas={n_replicas} not divisible by mesh size {mesh.devices.size}"
+        )
+    st = init_state(capacity, n_init)
+    st = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n_replicas,) + jnp.shape(x)), st
+    )
+    sharding = jax.tree.map(
+        lambda _: NamedSharding(mesh, P(AXIS)), DocState(0, 0, 0, 0, 0)
+    )
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), st, sharding
+    )

@@ -1,0 +1,76 @@
+// Standalone native bench binary (no Python in the loop).
+//
+// Reads a flat binary trace dump produced by
+// `python -m crdt_benches_tpu.bench.dump_trace <name>` and times upstream
+// replay through both native backends (gap-buffer rope and treap CRDT),
+// reporting elements/sec where element = one patch — the reference's
+// Criterion throughput semantics (reference src/main.rs:25).
+//
+// Dump format (little-endian int64 header then int32 arrays):
+//   [n_patches, init_n, ins_flat_n]
+//   pos[n_patches] del[n_patches] ins_off[n_patches+1] ins_flat[ins_flat_n]
+//   init[init_n]
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" int64_t rope_replay(const int32_t*, int64_t, const int32_t*,
+                               const int32_t*, const int32_t*, const int32_t*,
+                               int64_t);
+extern "C" int64_t crdt_replay(const int32_t*, int64_t, const int32_t*,
+                               const int32_t*, const int32_t*, const int32_t*,
+                               int64_t);
+
+static std::vector<int32_t> read_i32(FILE* f, int64_t n) {
+    std::vector<int32_t> v((size_t)n);
+    if (n && fread(v.data(), 4, (size_t)n, f) != (size_t)n) {
+        fprintf(stderr, "short read\n");
+        exit(1);
+    }
+    return v;
+}
+
+int main(int argc, char** argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s trace.bin [samples=7]\n", argv[0]);
+        return 1;
+    }
+    int samples = argc > 2 ? atoi(argv[2]) : 7;
+    FILE* f = fopen(argv[1], "rb");
+    if (!f) { perror("open"); return 1; }
+    int64_t hdr[3];
+    if (fread(hdr, 8, 3, f) != 3) { fprintf(stderr, "bad header\n"); return 1; }
+    int64_t n_patches = hdr[0], init_n = hdr[1], flat_n = hdr[2];
+    auto pos = read_i32(f, n_patches);
+    auto del = read_i32(f, n_patches);
+    auto off = read_i32(f, n_patches + 1);
+    auto flat = read_i32(f, flat_n);
+    auto init = read_i32(f, init_n);
+    fclose(f);
+
+    struct { const char* name; int64_t (*fn)(const int32_t*, int64_t, const int32_t*, const int32_t*, const int32_t*, const int32_t*, int64_t); } backends[] = {
+        {"cpp-rope", rope_replay},
+        {"cpp-crdt", crdt_replay},
+    };
+
+    for (auto& b : backends) {
+        double best = 1e300;
+        int64_t len = 0;
+        len = b.fn(init.data(), init_n, pos.data(), del.data(), off.data(),
+                   flat.data(), n_patches);  // warmup
+        for (int s = 0; s < samples; s++) {
+            auto t0 = std::chrono::steady_clock::now();
+            len = b.fn(init.data(), init_n, pos.data(), del.data(), off.data(),
+                       flat.data(), n_patches);
+            auto t1 = std::chrono::steady_clock::now();
+            double dt = std::chrono::duration<double>(t1 - t0).count();
+            if (dt < best) best = dt;
+        }
+        printf("%-10s len=%lld  %.4fs  %.0f elements/sec\n", b.name,
+               (long long)len, best, (double)n_patches / best);
+    }
+    return 0;
+}

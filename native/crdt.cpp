@@ -1,0 +1,424 @@
+// Native sequence CRDT engine — the op-log capability of the reference's
+// diamond-types path (SURVEY.md C8+C11; reference src/rope.rs:105-137 and
+// 193-225): agent ids, an append-only op log, position-addressed local edits,
+// incremental binary update encoding from a version frontier (the analog of
+// encode_from, reference src/rope.rs:214), and decode-and-merge apply.
+//
+// Design (original, TPU-era native tier): elements live in an order-statistic
+// treap (randomized BST with parent pointers) over the full sequence
+// *including tombstones*; each node tracks subtree totals for both all
+// elements and visible elements, so
+//   - visible-rank -> node is O(log n) (position resolution for local edits),
+//   - insert-after-origin is O(log n) (remote integration),
+//   - tombstone delete is O(log n) count maintenance up the parent chain.
+// An id -> node hash map resolves remote ops' origins/targets.  Update wire
+// format is fixed-width little-endian records (content compression is out of
+// scope, as in the reference's EncodeOptions, src/rope.rs:201-208).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Id {
+    uint32_t agent;
+    uint32_t seq;
+    bool operator==(const Id& o) const { return agent == o.agent && seq == o.seq; }
+};
+
+struct IdHash {
+    size_t operator()(const Id& id) const {
+        return ((uint64_t)id.agent << 32 | id.seq) * 0x9E3779B97F4A7C15ull;
+    }
+};
+
+struct Node {
+    Node *l = nullptr, *r = nullptr, *p = nullptr;
+    Node *origin = nullptr;   // left-origin element (nullptr = head)
+    uint64_t prio;
+    uint32_t cnt_all = 1;     // subtree size incl. tombstones
+    uint32_t cnt_vis = 1;     // visible subtree size
+    bool visible = true;
+    int32_t ch;
+    Id id;
+};
+
+inline uint32_t call(Node* n) { return n ? n->cnt_all : 0; }
+inline uint32_t cvis(Node* n) { return n ? n->cnt_vis : 0; }
+
+// Op log records.
+enum OpType : uint8_t { OP_INSERT = 1, OP_DELETE = 2 };
+struct Op {
+    uint8_t type;
+    Id id;        // inserted element / delete target
+    Id origin;    // left origin for inserts ({0,0} = document head)
+    int32_t ch;
+};
+
+constexpr Id HEAD{0, 0};  // agent 0 reserved for the head sentinel
+
+// Total order on ids for concurrent-sibling ordering: (seq, agent)
+// lexicographic.  seq is a Lamport clock (bumped past every integrated op),
+// so causally-later inserts at the same origin always order first — the RGA
+// intention-preservation property.
+inline bool id_less(const Id& a, const Id& b) {
+    return a.seq != b.seq ? a.seq < b.seq : a.agent < b.agent;
+}
+
+constexpr size_t OP_WIRE = 1 + 4 * 5;  // type + id(2x4) + origin(2x4) + ch(4)
+
+struct Crdt {
+    Node* root = nullptr;
+    std::unordered_map<Id, Node*, IdHash> by_id;
+    std::vector<Op> oplog;
+    uint32_t agent;
+    uint32_t next_seq = 1;
+    uint64_t rng = 0x853c49e6748fea9bull;
+
+    uint64_t rand64() {
+        rng ^= rng << 13; rng ^= rng >> 7; rng ^= rng << 17;
+        return rng;
+    }
+
+    // ---- treap primitives ----
+    static void pull(Node* n) {
+        n->cnt_all = 1 + call(n->l) + call(n->r);
+        n->cnt_vis = (n->visible ? 1 : 0) + cvis(n->l) + cvis(n->r);
+    }
+
+    void rot_up(Node* x) {  // rotate x above its parent
+        Node* p = x->p;
+        Node* g = p->p;
+        if (p->l == x) { p->l = x->r; if (x->r) x->r->p = p; x->r = p; }
+        else { p->r = x->l; if (x->l) x->l->p = p; x->l = p; }
+        p->p = x; x->p = g;
+        if (g) { (g->l == p ? g->l : g->r) = x; } else root = x;
+        pull(p); pull(x);
+    }
+
+    void bubble(Node* x) {
+        while (x->p && x->p->prio < x->prio) rot_up(x);
+        for (Node* a = x->p; a; a = a->p) pull(a);
+    }
+
+    Node* by_vis_rank(uint32_t r) const {  // r-th visible element (0-based)
+        Node* n = root;
+        while (n) {
+            uint32_t lv = cvis(n->l);
+            if (r < lv) { n = n->l; continue; }
+            r -= lv;
+            if (n->visible) {
+                if (r == 0) return n;
+                r -= 1;
+            }
+            n = n->r;
+        }
+        return nullptr;
+    }
+
+    Node* first() const {
+        Node* n = root;
+        while (n && n->l) n = n->l;
+        return n;
+    }
+
+    Node* successor(Node* n) const {
+        if (n->r) {
+            n = n->r;
+            while (n->l) n = n->l;
+            return n;
+        }
+        while (n->p && n->p->r == n) n = n->p;
+        return n->p;
+    }
+
+    // Index of node in the full sequence (incl. tombstones); head = -1.
+    int64_t pos_all(Node* n) const {
+        if (!n) return -1;
+        int64_t r = call(n->l);
+        for (Node* a = n; a->p; a = a->p)
+            if (a->p->r == a) r += call(a->p->l) + 1;
+        return r;
+    }
+
+    // Insert a fresh node immediately after `after` in sequence order
+    // (after == nullptr: at the very front).
+    Node* insert_after(Node* after, int32_t ch, Id id) {
+        Node* n = new Node;
+        n->prio = rand64();
+        n->ch = ch;
+        n->id = id;
+        if (!after) {
+            if (!root) { root = n; by_id.emplace(id, n); return n; }
+            Node* f = first();
+            f->l = n; n->p = f;
+        } else if (!after->r) {
+            after->r = n; n->p = after;
+        } else {
+            Node* s = after->r;
+            while (s->l) s = s->l;
+            s->l = n; n->p = s;
+        }
+        for (Node* a = n->p; a; a = a->p) pull(a);
+        bubble(n);
+        by_id.emplace(id, n);
+        return n;
+    }
+
+    // RGA integration point: scan right from `origin` skipping concurrent
+    // sibling subtrees whose root id orders after `id` (children of one
+    // origin sit in descending id order; descendants have origins deeper in
+    // the region, ancestors'-sibling elements have origins left of it).
+    Node* integration_point(Node* origin, Id id) {
+        int64_t o_pos = pos_all(origin);
+        Node* last = origin;
+        Node* e = origin ? successor(origin) : first();
+        while (e) {
+            int64_t eo_pos = pos_all(e->origin);
+            if (eo_pos < o_pos) break;  // left the origin's child region
+            if (eo_pos == o_pos && id_less(e->id, id)) break;  // smaller sib
+            last = e;
+            e = successor(e);
+        }
+        return last;  // insert immediately after this node
+    }
+
+    void tombstone(Node* n) {
+        if (!n->visible) return;
+        n->visible = false;
+        for (Node* a = n; a; a = a->p) pull(a);
+    }
+
+    uint32_t len() const { return cvis(root); }
+
+    // ---- local (upstream) edits: position-addressed ----
+    void local_insert(uint32_t at, const int32_t* codes, size_t n) {
+        uint32_t l = len();
+        if (at > l) at = l;
+        Node* origin_node = at == 0 ? nullptr : by_vis_rank(at - 1);
+        for (size_t i = 0; i < n; i++) {
+            Id id{agent, next_seq++};  // next_seq is a Lamport clock
+            Id origin = origin_node ? origin_node->id : HEAD;
+            oplog.push_back(Op{OP_INSERT, id, origin, codes[i]});
+            // Local ops carry the max Lamport seen, so the sibling scan
+            // terminates immediately and this is an O(1) placement.
+            Node* after = integration_point(origin_node, id);
+            Node* n_ = insert_after(after, codes[i], id);
+            n_->origin = origin_node;
+            origin_node = n_;
+        }
+    }
+
+    void local_remove(uint32_t start, uint32_t end) {
+        uint32_t l = len();
+        if (start > l) start = l;
+        if (end > l) end = l;
+        for (uint32_t i = start; i < end; i++) {
+            Node* n = by_vis_rank(start);  // ranks shift as we delete
+            if (!n) break;
+            oplog.push_back(Op{OP_DELETE, n->id, HEAD, 0});
+            tombstone(n);
+        }
+    }
+
+    // ---- remote integration ----
+    void integrate(const Op& op) {
+        if (op.type == OP_INSERT) {
+            if (by_id.count(op.id)) return;  // idempotent
+            Node* origin_node = nullptr;
+            if (!(op.origin == HEAD)) {
+                auto it = by_id.find(op.origin);
+                if (it == by_id.end()) return;  // missing causal dep: drop
+                origin_node = it->second;
+            }
+            if (op.id.seq >= next_seq) next_seq = op.id.seq + 1;  // Lamport
+            oplog.push_back(op);
+            Node* after = integration_point(origin_node, op.id);
+            Node* n = insert_after(after, op.ch, op.id);
+            n->origin = origin_node;
+        } else {
+            auto it = by_id.find(op.id);
+            if (it != by_id.end() && it->second->visible) {
+                oplog.push_back(op);
+                tombstone(it->second);
+            }
+        }
+    }
+
+    void read(int32_t* out) const {
+        // iterative in-order traversal, visible only
+        std::vector<Node*> stack;
+        Node* n = root;
+        size_t k = 0;
+        while (n || !stack.empty()) {
+            while (n) { stack.push_back(n); n = n->l; }
+            n = stack.back(); stack.pop_back();
+            if (n->visible) out[k++] = n->ch;
+            n = n->r;
+        }
+    }
+
+    void free_all() {
+        std::vector<Node*> stack;
+        if (root) stack.push_back(root);
+        while (!stack.empty()) {
+            Node* n = stack.back(); stack.pop_back();
+            if (n->l) stack.push_back(n->l);
+            if (n->r) stack.push_back(n->r);
+            delete n;
+        }
+    }
+};
+
+void encode_op(const Op& op, uint8_t* out) {
+    out[0] = op.type;
+    memcpy(out + 1, &op.id.agent, 4);
+    memcpy(out + 5, &op.id.seq, 4);
+    memcpy(out + 9, &op.origin.agent, 4);
+    memcpy(out + 13, &op.origin.seq, 4);
+    memcpy(out + 17, &op.ch, 4);
+}
+
+Op decode_op(const uint8_t* in) {
+    Op op;
+    op.type = in[0];
+    memcpy(&op.id.agent, in + 1, 4);
+    memcpy(&op.id.seq, in + 5, 4);
+    memcpy(&op.origin.agent, in + 9, 4);
+    memcpy(&op.origin.seq, in + 13, 4);
+    memcpy(&op.ch, in + 17, 4);
+    return op;
+}
+
+Crdt* crdt_make(const int32_t* init, int64_t n, uint32_t agent) {
+    Crdt* c = new Crdt;
+    c->agent = agent;
+    c->rng ^= (uint64_t)agent * 0xD1342543DE82EF95ull + 1;
+    if (n > 0) c->local_insert(0, init, (size_t)n);
+    return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* crdt_new(const int32_t* init, int64_t n, uint32_t agent) {
+    return crdt_make(init, n, agent);
+}
+
+void crdt_free(void* h) {
+    Crdt* c = static_cast<Crdt*>(h);
+    c->free_all();
+    delete c;
+}
+
+int64_t crdt_len(void* h) { return static_cast<Crdt*>(h)->len(); }
+
+int64_t crdt_oplog_len(void* h) {
+    return (int64_t)static_cast<Crdt*>(h)->oplog.size();
+}
+
+void crdt_insert(void* h, int64_t at, const int32_t* codes, int64_t n) {
+    static_cast<Crdt*>(h)->local_insert((uint32_t)at, codes, (size_t)n);
+}
+
+void crdt_remove(void* h, int64_t start, int64_t end) {
+    static_cast<Crdt*>(h)->local_remove((uint32_t)start, (uint32_t)end);
+}
+
+void crdt_read(void* h, int32_t* out) { static_cast<Crdt*>(h)->read(out); }
+
+// Incremental update: serialize ops[from_op..] (the version-frontier encoding
+// capability; analog of reference src/rope.rs:214).  Returns bytes written,
+// or -(bytes needed) if cap is too small.
+int64_t crdt_encode_from(void* h, int64_t from_op, uint8_t* out, int64_t cap) {
+    Crdt* c = static_cast<Crdt*>(h);
+    int64_t n_ops = (int64_t)c->oplog.size() - from_op;
+    if (n_ops < 0) n_ops = 0;
+    int64_t need = n_ops * (int64_t)OP_WIRE;
+    if (need > cap) return -need;
+    for (int64_t i = 0; i < n_ops; i++)
+        encode_op(c->oplog[(size_t)(from_op + i)], out + i * OP_WIRE);
+    return need;
+}
+
+// Decode-and-merge one update (analog of decode_and_add, reference
+// src/rope.rs:223).  Idempotent; unknown-origin ops are dropped.
+void crdt_apply_update(void* h, const uint8_t* bytes, int64_t n) {
+    Crdt* c = static_cast<Crdt*>(h);
+    for (int64_t off = 0; off + (int64_t)OP_WIRE <= n; off += OP_WIRE)
+        c->integrate(decode_op(bytes + off));
+}
+
+// Apply a batch of concatenated updates (offsets[i]..offsets[i+1] each) —
+// the downstream hot loop (reference src/main.rs:65-67) in one native call.
+int64_t crdt_apply_updates(void* h, const uint8_t* flat, const int64_t* offsets,
+                           int64_t n_updates) {
+    Crdt* c = static_cast<Crdt*>(h);
+    for (int64_t u = 0; u < n_updates; u++) {
+        const uint8_t* p = flat + offsets[u];
+        int64_t nb = offsets[u + 1] - offsets[u];
+        for (int64_t off = 0; off + (int64_t)OP_WIRE <= nb; off += OP_WIRE)
+            c->integrate(decode_op(p + off));
+    }
+    return c->len();
+}
+
+// One timed upstream iteration entirely native: init + per-patch replace +
+// final length (reference src/main.rs:28-37 semantics).
+int64_t crdt_replay(const int32_t* init, int64_t init_n,
+                    const int32_t* pos, const int32_t* del_count,
+                    const int32_t* ins_off, const int32_t* ins_flat,
+                    int64_t n_patches) {
+    Crdt* c = crdt_make(init, init_n, 1);
+    for (int64_t i = 0; i < n_patches; i++) {
+        uint32_t p = (uint32_t)pos[i];
+        uint32_t d = (uint32_t)del_count[i];
+        if (d) c->local_remove(p, p + d);
+        int32_t a = ins_off[i], b = ins_off[i + 1];
+        if (b > a) c->local_insert(p, ins_flat + a, (size_t)(b - a));
+    }
+    int64_t out = c->len();
+    c->free_all();
+    delete c;
+    return out;
+}
+
+// Untimed downstream generation (analog of upstream_updates, reference
+// src/rope.rs:196-220): replay every patch on a fresh upstream replica,
+// emitting one encoded update per patch (ops since the previous patch).
+// Returns total bytes (or -needed if cap too small); offsets_out must hold
+// n_patches+1 entries.
+int64_t crdt_gen_updates(const int32_t* init, int64_t init_n,
+                         const int32_t* pos, const int32_t* del_count,
+                         const int32_t* ins_off, const int32_t* ins_flat,
+                         int64_t n_patches, uint8_t* out, int64_t cap,
+                         int64_t* offsets_out) {
+    Crdt* c = crdt_make(init, init_n, 1);
+    int64_t total = 0;
+    offsets_out[0] = 0;
+    for (int64_t i = 0; i < n_patches; i++) {
+        size_t from = c->oplog.size();
+        uint32_t p = (uint32_t)pos[i];
+        uint32_t d = (uint32_t)del_count[i];
+        if (d) c->local_remove(p, p + d);
+        int32_t a = ins_off[i], b = ins_off[i + 1];
+        if (b > a) c->local_insert(p, ins_flat + a, (size_t)(b - a));
+        int64_t n_ops = (int64_t)(c->oplog.size() - from);
+        int64_t need = n_ops * (int64_t)OP_WIRE;
+        if (total + need <= cap) {
+            for (int64_t k = 0; k < n_ops; k++)
+                encode_op(c->oplog[from + (size_t)k], out + total + k * OP_WIRE);
+        }
+        total += need;
+        offsets_out[i + 1] = total;
+    }
+    c->free_all();
+    delete c;
+    return total <= cap ? total : -total;
+}
+
+}  // extern "C"

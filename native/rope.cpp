@@ -1,0 +1,161 @@
+// CPU rope baseline — the native tier of crdt_benches_tpu.
+//
+// Re-provides the capability of the reference's Upstream trait surface
+// (reference src/rope.rs:6-33: from_str/insert/remove/len/replace with
+// replace = remove-then-insert) as a gap buffer over int32 codepoints, plus a
+// one-call whole-trace replay entry so the benchmark hot loop
+// (reference src/main.rs:30-34) runs entirely in native code rather than
+// through per-op FFI calls.
+//
+// A gap buffer is the right CPU baseline for these workloads: real editing
+// traces are overwhelmingly local, so the gap rarely moves far and most ops
+// are O(1) amortized; worst case is O(distance) memmove.  Exposed through a
+// plain C ABI for ctypes (no pybind11 in this environment).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <algorithm>
+
+namespace {
+
+struct Rope {
+    int32_t* buf;       // [0, gap_start) ++ [gap_end, cap) is the document
+    size_t cap;
+    size_t gap_start;
+    size_t gap_end;
+
+    size_t len() const { return cap - (gap_end - gap_start); }
+
+    void reserve(size_t need) {
+        size_t gap = gap_end - gap_start;
+        if (gap >= need) return;
+        size_t new_cap = std::max(cap * 2, cap + need + 4096);
+        int32_t* nb = static_cast<int32_t*>(malloc(new_cap * sizeof(int32_t)));
+        size_t tail = cap - gap_end;
+        memcpy(nb, buf, gap_start * sizeof(int32_t));
+        memcpy(nb + new_cap - tail, buf + gap_end, tail * sizeof(int32_t));
+        free(buf);
+        buf = nb;
+        gap_end = new_cap - tail;
+        cap = new_cap;
+    }
+
+    void move_gap(size_t at) {  // place gap_start at document position `at`
+        if (at < gap_start) {
+            size_t n = gap_start - at;
+            memmove(buf + gap_end - n, buf + at, n * sizeof(int32_t));
+            gap_start = at;
+            gap_end -= n;
+        } else if (at > gap_start) {
+            size_t n = at - gap_start;
+            memmove(buf + gap_start, buf + gap_end, n * sizeof(int32_t));
+            gap_start = at;
+            gap_end += n;
+        }
+    }
+
+    void insert(size_t at, const int32_t* codes, size_t n) {
+        if (at > len()) at = len();
+        reserve(n);
+        move_gap(at);
+        memcpy(buf + gap_start, codes, n * sizeof(int32_t));
+        gap_start += n;
+    }
+
+    void remove(size_t start, size_t end) {
+        size_t l = len();
+        if (start > l) start = l;
+        if (end > l) end = l;
+        if (end <= start) return;
+        move_gap(start);
+        gap_end += end - start;
+    }
+
+    void read(int32_t* out) const {
+        memcpy(out, buf, gap_start * sizeof(int32_t));
+        memcpy(out + gap_start, buf + gap_end, (cap - gap_end) * sizeof(int32_t));
+    }
+};
+
+Rope* make(const int32_t* codes, size_t n) {
+    Rope* r = new Rope;
+    size_t cap = std::max<size_t>(n * 2 + 4096, 8192);
+    r->buf = static_cast<int32_t*>(malloc(cap * sizeof(int32_t)));
+    r->cap = cap;
+    memcpy(r->buf, codes, n * sizeof(int32_t));
+    r->gap_start = n;
+    r->gap_end = cap;
+    return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rope_new(const int32_t* codes, int64_t n) { return make(codes, (size_t)n); }
+
+void rope_free(void* h) {
+    Rope* r = static_cast<Rope*>(h);
+    free(r->buf);
+    delete r;
+}
+
+int64_t rope_len(void* h) { return (int64_t)static_cast<Rope*>(h)->len(); }
+
+void rope_insert(void* h, int64_t at, const int32_t* codes, int64_t n) {
+    static_cast<Rope*>(h)->insert((size_t)at, codes, (size_t)n);
+}
+
+void rope_remove(void* h, int64_t start, int64_t end) {
+    static_cast<Rope*>(h)->remove((size_t)start, (size_t)end);
+}
+
+void rope_read(void* h, int32_t* out) { static_cast<Rope*>(h)->read(out); }
+
+// One timed benchmark iteration, entirely native: doc init from start
+// content, per-patch replace (remove-then-insert, reference src/rope.rs:21-32),
+// returns the final length (the reference's length oracle, src/main.rs:35).
+//
+// Patch layout (from the Python trace layer): pos[i], del_count[i], and the
+// insert text for patch i is ins_flat[ins_off[i] .. ins_off[i+1]).
+int64_t rope_replay(const int32_t* init, int64_t init_n,
+                    const int32_t* pos, const int32_t* del_count,
+                    const int32_t* ins_off, const int32_t* ins_flat,
+                    int64_t n_patches) {
+    Rope* r = make(init, (size_t)init_n);
+    for (int64_t i = 0; i < n_patches; i++) {
+        size_t p = (size_t)pos[i];
+        size_t d = (size_t)del_count[i];
+        if (d) r->remove(p, p + d);
+        int32_t a = ins_off[i], b = ins_off[i + 1];
+        if (b > a) r->insert(p, ins_flat + a, (size_t)(b - a));
+    }
+    int64_t out = (int64_t)r->len();
+    free(r->buf);
+    delete r;
+    return out;
+}
+
+// Replay and also write the final document (for byte-identical checks).
+// Returns final length; writes at most out_cap codepoints.
+int64_t rope_replay_read(const int32_t* init, int64_t init_n,
+                         const int32_t* pos, const int32_t* del_count,
+                         const int32_t* ins_off, const int32_t* ins_flat,
+                         int64_t n_patches, int32_t* out, int64_t out_cap) {
+    Rope* r = make(init, (size_t)init_n);
+    for (int64_t i = 0; i < n_patches; i++) {
+        size_t p = (size_t)pos[i];
+        size_t d = (size_t)del_count[i];
+        if (d) r->remove(p, p + d);
+        int32_t a = ins_off[i], b = ins_off[i + 1];
+        if (b > a) r->insert(p, ins_flat + a, (size_t)(b - a));
+    }
+    int64_t n = (int64_t)r->len();
+    if (n <= out_cap) r->read(out);
+    free(r->buf);
+    delete r;
+    return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,127 @@
+"""Mesh/shard_map layer: sharded replay over the virtual 8-device CPU mesh,
+psum/pmin/pmax convergence, and the driver entry points."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crdt_benches_tpu.parallel.mesh import (
+    make_sharded_state,
+    replica_mesh,
+    sharded_replay_and_digest,
+)
+from crdt_benches_tpu.traces.tensorize import tensorize
+from crdt_benches_tpu.utils.digest import doc_digest
+from crdt_benches_tpu.engine.replay import ReplayEngine
+
+needs_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (virtual) devices"
+)
+
+
+@needs_8
+def test_sharded_replay_converges(svelte_trace):
+    """16 replicas over 8 devices replay sveltecomponent's first batches;
+    digests agree across devices and match the single-replica engine."""
+    tt = tensorize(svelte_trace, batch=256)
+    nb = 32  # first 32 batches only (test speed)
+    kind_b, pos_b, _, slot_b = tt.batched()
+    kind_b, pos_b, slot_b = kind_b[:nb], pos_b[:nb], slot_b[:nb]
+
+    capacity = ((tt.capacity + 127) // 128) * 128
+    chars = np.zeros(capacity, np.int32)
+    ins = tt.slot >= 0
+    chars[tt.slot[ins]] = tt.ch[ins]
+
+    mesh = replica_mesh(8)
+    step, _ = sharded_replay_and_digest(mesh)
+    state = make_sharded_state(mesh, 16, capacity, 0)
+    state, digests, converged = step(
+        state, jnp.asarray(kind_b), jnp.asarray(pos_b), jnp.asarray(slot_b),
+        jnp.asarray(chars),
+    )
+    jax.block_until_ready(state)
+    assert bool(np.asarray(converged))
+    digests = np.asarray(digests)
+    assert (digests == digests[0]).all()
+
+    # cross-check against the unsharded single-replica engine
+    eng = ReplayEngine(tt, n_replicas=1)
+    st1 = eng.fresh_state()
+    from crdt_benches_tpu.engine.replay import replay_batches
+
+    st1 = replay_batches(st1, jnp.asarray(kind_b), jnp.asarray(pos_b),
+                         jnp.asarray(slot_b))
+    ref = np.asarray(doc_digest(st1.order, st1.visible, st1.length, eng.chars))
+    assert (digests[0] == ref).all()
+
+
+@needs_8
+def test_sharded_divergence_detected():
+    """A tampered replica (one visibility bit flipped after replay) must
+    break the cross-device convergence verdict."""
+    import __graft_entry__ as g
+
+    tt = g._tiny_problem()
+    kind_b, pos_b, _, slot_b = tt.batched()
+    capacity = 128
+    chars = np.zeros(capacity, np.int32)
+    ins = tt.slot >= 0
+    chars[tt.slot[ins]] = tt.ch[ins]
+
+    mesh = replica_mesh(8)
+    step, _ = sharded_replay_and_digest(mesh)
+    state = make_sharded_state(mesh, 8, capacity, 0)
+    args = (jnp.asarray(kind_b), jnp.asarray(pos_b), jnp.asarray(slot_b),
+            jnp.asarray(chars))
+    state, _, converged = step(state, *args)
+    assert bool(np.asarray(converged))
+
+    # tombstone one live char on replica 0 only, then a PAD-only step
+    live_slot = int(tt.slot[ins][0])
+    tampered = state._replace(
+        visible=state.visible.at[0, live_slot].set(False),
+        nvis=state.nvis.at[0].add(-1),
+    )
+    pad = jnp.zeros((1, tt.batch), jnp.int32)
+    _, _, converged2 = step(tampered, pad, pad, pad - 1, jnp.asarray(chars))
+    assert not bool(np.asarray(converged2))
+
+
+def test_entry_and_dryrun():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert len(out) == 5
+    if jax.device_count() >= 8:
+        g.dryrun_multichip(8)
+
+
+def test_harness_stats_and_baseline(tmp_path):
+    from crdt_benches_tpu.bench.harness import (
+        BenchResult, compare_to_baseline, markdown_table, measure, save_results,
+    )
+
+    calls = []
+    times = measure(lambda: calls.append(1), warmup=2, samples=3)
+    assert len(times) == 3 and len(calls) == 5
+
+    r = BenchResult("upstream", "t", "b", elements=1000,
+                    samples=[0.2, 0.1, 0.3])
+    assert r.median == 0.2
+    assert r.elements_per_sec == 1000 / 0.2
+    r2 = BenchResult("upstream", "t", "jax-r4", elements=1000,
+                     samples=[0.1], replicas=4)
+    assert r2.elements_per_sec == 4000 / 0.1
+
+    d = str(tmp_path)
+    save_results([r, r2], "base", results_dir=d)
+    lines = compare_to_baseline(
+        [BenchResult("upstream", "t", "b", 1000, [0.1])], "base", results_dir=d
+    )
+    assert any("-50.0%" in ln for ln in lines)
+    table = markdown_table([r, r2])
+    assert "upstream" in table and "jax-r4" in table
