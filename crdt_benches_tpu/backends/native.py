@@ -40,7 +40,9 @@ _vp = ctypes.c_void_p
 def _load_lib():
     for p in _LIB_PATHS:
         if os.path.exists(p):
-            lib = ctypes.CDLL(os.path.normpath(p))
+            p = os.path.normpath(p)
+            _check_fresh(p)
+            lib = ctypes.CDLL(p)
             break
     else:
         raise OSError(
@@ -68,6 +70,32 @@ def _load_lib():
     sig(lib.crdt_replay, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64])
     sig(lib.crdt_gen_updates, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _u8p, _i64, _i64p])
     return lib
+
+
+def _check_fresh(so_path: str) -> None:
+    """Rebuild (best-effort) if any C++ source is newer than the .so, so
+    edits to native/ can't be silently ignored in favor of a stale binary."""
+    import glob
+    import subprocess
+
+    native_dir = os.path.dirname(so_path)
+    srcs = glob.glob(os.path.join(native_dir, "*.cpp"))
+    if not srcs:
+        return
+    if max(map(os.path.getmtime, srcs)) <= os.path.getmtime(so_path):
+        return
+    import sys
+
+    print(
+        f"note: {so_path} older than native sources; rebuilding",
+        file=sys.stderr,
+    )
+    try:
+        subprocess.run(
+            ["make", "-C", native_dir], check=True, capture_output=True
+        )
+    except Exception as e:  # keep the stale lib usable; tests will tell
+        print(f"warning: native rebuild failed ({e})", file=sys.stderr)
 
 
 _lib = None
@@ -209,7 +237,7 @@ class CppCrdtDownstream(Downstream):
         self._start = start_content
         self._flat = flat
         self._offsets = offsets
-        self._doc = CppCrdt.from_str(start_content, agent=2)
+        self._doc = CppCrdt.from_str(start_content, agent=1)
 
     OP_WIRE = 21  # bytes per op record (native/crdt.cpp OP_WIRE)
 
@@ -243,7 +271,7 @@ class CppCrdtDownstream(Downstream):
         replica + apply every update + final length.  The fresh replica
         becomes this object's document, so ``len``/``content`` afterwards
         reflect the run."""
-        doc = CppCrdt.from_str(self._start, agent=2)
+        doc = CppCrdt.from_str(self._start, agent=1)
         n = lib().crdt_apply_updates(
             doc._h, self._flat, self._offsets, len(self._offsets) - 1
         )

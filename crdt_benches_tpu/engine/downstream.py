@@ -1,0 +1,356 @@
+"""Downstream path: remote-update generation + timed batched apply.
+
+The capability of the reference's ``Downstream`` trait and its diamond-types
+implementation (reference src/rope.rs:185-225, bench at src/main.rs:50-81):
+
+- ``upstream_updates`` (UNTIMED, reference src/main.rs:60): replay the trace
+  on a fresh upstream replica and emit one encoded update per edit.  The
+  reference's encoding is diamond-types' incremental binary format from a
+  version frontier (``oplog.encode_from``, src/rope.rs:214); ours is the
+  TPU-native equivalent — **updates are integer tensors**: per op-batch, the
+  inserted element ids (slots), each insert's *anchor* (the nearest preceding
+  element from an earlier batch, i.e. an element the receiver has already
+  integrated), a rank among same-anchor inserts, and each delete's target
+  element id.  This is the same structural summarization diamond-types
+  performs when it run-length-encodes sequential-insert runs into updates —
+  resolved structure at encode time, pure merge work at apply time.
+
+- ``apply_update`` (TIMED, reference src/main.rs:64-67): integrate updates
+  into a downstream replica that starts from ``start_content`` only.  With
+  anchors resolved to already-integrated elements, integration is fully
+  vectorized per batch — slot->position scatter, counting merge of the new
+  elements into the order permutation, visibility scatters — with **no
+  sequential scan at all**: the per-op dependency was discharged at encode
+  time, so the timed path is O(capacity) vectorized work per batch.
+
+Correctness argument for anchor-based integration: once two elements are both
+present in a sequence CRDT, their relative order never changes (tombstones
+preserve positions).  Hence each batch insert's nearest preceding
+earlier-batch element in the *final* upstream order is exactly the element it
+must follow at integration time, and same-anchor inserts keep their final
+relative order as consecutive ranks.  Induction over batches reproduces the
+upstream order permutation element-for-element; byte-identical final content
+is asserted in tests (upgrading the reference's length-only check,
+src/main.rs:68).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.apply import init_state
+from ..traces.loader import TestData
+from ..traces.tensorize import INSERT, TensorizedTrace, tensorize
+from .replay import (
+    _round_up,
+    broadcast_replicas,
+    replay_batches_collect,
+    select_replica,
+    slot_char_table,
+)
+
+
+class DownState(NamedTuple):
+    """Downstream replica state — like DocState minus origins (origins were
+    consumed at encode time)."""
+
+    order: jax.Array  # int32[C] slot ids in document order (incl. tombstones)
+    visible: jax.Array  # bool[C] by slot id
+    length: jax.Array  # int32  used entries of `order`
+    nvis: jax.Array  # int32  visible char count
+
+
+@dataclass
+class DownstreamUpdates:
+    """One trace's pre-generated updates, as batched tensors.
+
+    Each row b is one update covering a batch of B unit ops:
+    ``ins_slot[b]`` int32[B] inserted element ids (-1 = not an insert),
+    ``anchor[b]`` int32[B] already-integrated element the insert follows
+    (-1 = document head), ``rank[b]`` int32[B] order among same-anchor
+    inserts, ``dslot[b]`` int32[B] deleted element ids (-1 = not a delete).
+    """
+
+    ins_slot: np.ndarray  # int32[n_batches, B]
+    anchor: np.ndarray  # int32[n_batches, B]
+    rank: np.ndarray  # int32[n_batches, B]
+    dslot: np.ndarray  # int32[n_batches, B]
+    capacity: int  # padded physical buffer size
+    n_init: int  # start-content length (slots 0..n_init-1)
+    chars: np.ndarray  # int32[capacity] slot -> codepoint
+    end_content: str
+    n_patches: int
+
+    def nbytes(self) -> int:
+        """Total wire size of the update tensors (the analog of the encoded
+        update byte payloads the reference ships, src/rope.rs:199)."""
+        return sum(
+            a.nbytes for a in (self.ins_slot, self.anchor, self.rank, self.dslot)
+        )
+
+
+def _prev_smaller(vals: np.ndarray) -> np.ndarray:
+    """For each i: the largest j < i with vals[j] < vals[i], else -1
+    (classic previous-smaller-value monotonic stack, amortized O(n))."""
+    out = np.empty(len(vals), np.int64)
+    stack: list[int] = []
+    v = vals.tolist()
+    for i, x in enumerate(v):
+        while stack and v[stack[-1]] >= x:
+            stack.pop()
+        out[i] = stack[-1] if stack else -1
+        stack.append(i)
+    return out
+
+
+def generate_updates(tt: TensorizedTrace, lane: int = 128) -> DownstreamUpdates:
+    """UNTIMED update generation: one upstream replay (device) + anchor/rank
+    extraction (host, single pass).  The analog of reference
+    ``upstream_updates`` (src/rope.rs:196-220), which is likewise untimed
+    (src/main.rs:60)."""
+    capacity = _round_up(max(tt.capacity, 1), lane)
+    n_init = len(tt.init_chars)
+    kind_b, pos_b, _, slot_b = tt.batched()
+    n_batches, B = kind_b.shape
+
+    state, dslot_b = replay_batches_collect(
+        init_state(capacity, n_init),
+        jnp.asarray(kind_b),
+        jnp.asarray(pos_b),
+        jnp.asarray(slot_b),
+    )
+    length = int(state.length)
+    order = np.asarray(state.order)[:length]  # final doc order, incl. tombstones
+    dslot_b = np.asarray(dslot_b)
+
+    # batch index of every slot: -1 for init content, op_index // B for inserts
+    batch_of_slot = np.full(capacity, -1, np.int32)
+    is_ins = tt.kind == INSERT
+    op_of_ins = np.nonzero(is_ins)[0]
+    batch_of_slot[tt.slot[is_ins]] = (op_of_ins // B).astype(np.int32)
+
+    pos_of_slot = np.full(capacity, -1, np.int64)
+    pos_of_slot[order] = np.arange(length)
+    arrb = batch_of_slot[order]  # batch index at each final doc position
+
+    # Anchor of the element at position q = nearest p < q with a smaller
+    # batch index (an element integrated in an earlier batch, or init = -1).
+    a_pos_all = _prev_smaller(arrb)
+
+    ins_slot = np.full((n_batches, B), -1, np.int32)
+    anchor = np.full((n_batches, B), -1, np.int32)
+    rank = np.zeros((n_batches, B), np.int32)
+
+    slots = tt.slot[is_ins]  # every insert's slot, in op order
+    q = pos_of_slot[slots]
+    a_pos = a_pos_all[q]
+    a_slot = np.where(a_pos >= 0, order[np.clip(a_pos, 0, None)], -1)
+    # rank among inserts of the same batch sharing an anchor, in doc order
+    b_of_ins = (op_of_ins // B).astype(np.int64)
+    sort = np.lexsort((q, a_pos, b_of_ins))
+    key_b, key_a = b_of_ins[sort], a_pos[sort]
+    grp_start = np.concatenate(
+        [[True], (key_b[1:] != key_b[:-1]) | (key_a[1:] != key_a[:-1])]
+    )
+    idx = np.arange(len(sort))
+    r_sorted = idx - np.maximum.accumulate(np.where(grp_start, idx, 0))
+    r = np.empty_like(r_sorted)
+    r[sort] = r_sorted
+
+    row, col = np.divmod(op_of_ins, B)
+    ins_slot[row, col] = slots
+    anchor[row, col] = a_slot
+    rank[row, col] = r.astype(np.int32)
+
+    chars = slot_char_table(tt, capacity)
+    return DownstreamUpdates(
+        ins_slot=ins_slot,
+        anchor=anchor,
+        rank=rank,
+        dslot=dslot_b,
+        capacity=capacity,
+        n_init=n_init,
+        chars=chars,
+        end_content=tt.end_content,
+        n_patches=tt.n_patches,
+    )
+
+
+def init_down_state(capacity: int, n_init: int) -> DownState:
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    return DownState(
+        order=jnp.where(idx < n_init, idx, -1),
+        visible=idx < n_init,
+        length=jnp.int32(n_init),
+        nvis=jnp.int32(n_init),
+    )
+
+
+def apply_update_batch(
+    state: DownState, ins: jax.Array, anchor: jax.Array, rank: jax.Array,
+    dslot: jax.Array
+) -> DownState:
+    """Integrate one update batch — fully vectorized (no scan).  The timed
+    analog of ``oplog.decode_and_add`` (reference src/rope.rs:222-224)."""
+    C = state.order.shape[0]
+    drop = jnp.int32(C)
+    idx = jnp.arange(C, dtype=jnp.int32)
+    valid = idx < state.length
+    is_ins = ins >= 0
+
+    # slot -> current physical position
+    phys = (
+        jnp.zeros(C, jnp.int32)
+        .at[jnp.where(valid, state.order, drop)]
+        .set(idx, mode="drop")
+    )
+    a_phys = jnp.where(anchor >= 0, phys[jnp.clip(anchor, 0, C - 1)], -1)
+    gap = jnp.where(is_ins, a_phys + 1, C + 1)
+
+    # counting merge of the new elements into the order permutation
+    bump = jnp.zeros(C + 1, jnp.int32).at[gap].add(1, mode="drop")
+    csum = jnp.cumsum(bump)
+    new_idx_old = idx + csum[idx]
+    n_before = jnp.where(gap > 0, csum[jnp.clip(gap - 1, 0)], 0)
+    new_idx_ins = gap + n_before + rank
+
+    order = (
+        jnp.full(C, -1, jnp.int32)
+        .at[jnp.where(valid, new_idx_old, drop)]
+        .set(jnp.where(valid, state.order, -1), mode="drop")
+        .at[jnp.where(is_ins, new_idx_ins, drop)]
+        .set(ins, mode="drop")
+    )
+    # visibility: new inserts visible, then this batch's deletes tombstone
+    # (covers same-batch insert+delete: set-True then set-False)
+    visible = (
+        state.visible.at[jnp.where(is_ins, ins, drop)]
+        .set(True, mode="drop")
+        .at[jnp.where(dslot >= 0, dslot, drop)]
+        .set(False, mode="drop")
+    )
+    length = state.length + jnp.sum(is_ins.astype(jnp.int32))
+    valid2 = idx < length
+    nvis = jnp.sum(
+        valid2 & visible[jnp.where(valid2, order, 0)], dtype=jnp.int32
+    )
+    return DownState(order=order, visible=visible, length=length, nvis=nvis)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def apply_updates(state: DownState, ins_b, anchor_b, rank_b, dslot_b) -> DownState:
+    """Scan all update batches into the downstream state (the timed hot loop,
+    reference src/main.rs:65-67)."""
+
+    def step(st, upd):
+        return apply_update_batch(st, *upd), None
+
+    state, _ = jax.lax.scan(step, state, (ins_b, anchor_b, rank_b, dslot_b))
+    return state
+
+
+def decode_down_state(state: DownState, chars: jax.Array):
+    """Visible document codepoints in order (first ``nvis`` entries)."""
+    C = state.order.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    valid = idx < state.length
+    slot_at = jnp.where(valid, state.order, 0)
+    vis = valid & state.visible[slot_at]
+    cumvis = jnp.cumsum(vis.astype(jnp.int32))
+    out = (
+        jnp.zeros(C, jnp.int32)
+        .at[jnp.where(vis, cumvis - 1, C)]
+        .set(chars[slot_at], mode="drop")
+    )
+    return out, cumvis[-1]
+
+
+decode_down_state_jit = jax.jit(decode_down_state)
+
+
+class JaxDownstreamEngine:
+    """Host-side driver: untimed generation, timed repeated apply.
+
+    ``n_replicas > 1`` vmaps the apply over a replica axis (every replica
+    integrates the same update stream — the batched-downstream analog of the
+    upstream replica axis)."""
+
+    def __init__(self, tt: TensorizedTrace, n_replicas: int = 1):
+        self.upd = generate_updates(tt)
+        self.n_replicas = n_replicas
+        self.ins_b = jnp.asarray(self.upd.ins_slot)
+        self.anchor_b = jnp.asarray(self.upd.anchor)
+        self.rank_b = jnp.asarray(self.upd.rank)
+        self.dslot_b = jnp.asarray(self.upd.dslot)
+        self.chars = jnp.asarray(self.upd.chars)
+        if n_replicas == 1:
+            self._apply = apply_updates
+        else:
+            self._apply = jax.jit(
+                jax.vmap(apply_updates, in_axes=(0, None, None, None, None)),
+                donate_argnums=(0,),
+            )
+
+    def fresh_state(self) -> DownState:
+        return broadcast_replicas(
+            init_down_state(self.upd.capacity, self.upd.n_init),
+            self.n_replicas,
+        )
+
+    def run(self) -> DownState:
+        return self._apply(
+            self.fresh_state(), self.ins_b, self.anchor_b, self.rank_b,
+            self.dslot_b,
+        )
+
+    def decode(self, state: DownState, replica: int = 0) -> str:
+        st = select_replica(state, replica, self.n_replicas)
+        codes, nvis = decode_down_state_jit(st, self.chars)
+        codes = np.asarray(codes)[: int(nvis)]
+        return "".join(map(chr, codes.tolist()))
+
+
+class JaxDownstreamBackend:
+    """Downstream bench backend (bench/runner.py): timed region = fresh
+    replica init + full update apply + final length fetch, matching the
+    reference's timed closure (clone + apply loop + length assert,
+    src/main.rs:62-69)."""
+
+    def __init__(self, n_replicas: int = 1, batch: int = 256):
+        self.n_replicas = n_replicas
+        self.batch = batch
+        self._eng: JaxDownstreamEngine | None = None
+
+    @property
+    def NAME(self) -> str:
+        plat = jax.devices()[0].platform
+        tag = f"-r{self.n_replicas}" if self.n_replicas > 1 else ""
+        return f"jax-{plat}{tag}"
+
+    @property
+    def replicas(self) -> int:
+        return self.n_replicas
+
+    def prepare(self, trace: TestData) -> None:
+        tt = tensorize(trace, batch=self.batch)
+        self._eng = JaxDownstreamEngine(tt, n_replicas=self.n_replicas)
+        self._end_len = len(trace.end_content)
+
+    def replay_once(self) -> int:
+        state = self._eng.run()
+        lengths = np.asarray(state.nvis)  # device -> host sync point
+        assert (lengths == self._end_len).all(), (
+            f"length mismatch: {lengths} != {self._end_len}"
+        )
+        return int(lengths.reshape(-1)[0])
+
+    def final_content(self) -> str:
+        state = self._eng.run()
+        jax.block_until_ready(state)
+        return self._eng.decode(state)

@@ -16,13 +16,48 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.apply import DocState, apply_batch, decode_state, init_state
+from ..ops.apply import (
+    DocState,
+    apply_batch,
+    apply_batch_collect,
+    decode_state,
+    init_state,
+)
 from ..ops.resolve import resolve_batch
 from ..traces.tensorize import TensorizedTrace
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+#: Module-level jit so repeated decodes reuse one compilation per shape.
+decode_state_jit = jax.jit(decode_state)
+
+
+def slot_char_table(tt: TensorizedTrace, capacity: int) -> np.ndarray:
+    """slot -> codepoint table: static per trace (init content in slots
+    0..S-1, each insert op's preassigned slot gets its char)."""
+    chars = np.zeros(capacity, np.int32)
+    chars[: len(tt.init_chars)] = tt.init_chars
+    ins = tt.slot >= 0
+    chars[tt.slot[ins]] = tt.ch[ins]
+    return chars
+
+
+def broadcast_replicas(state, n_replicas: int):
+    """Tile a single-replica state pytree along a leading replica axis."""
+    if n_replicas == 1:
+        return state
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n_replicas,) + jnp.shape(x)), state
+    )
+
+
+def select_replica(state, replica: int, n_replicas: int):
+    return (
+        jax.tree.map(lambda x: x[replica], state) if n_replicas > 1 else state
+    )
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -37,6 +72,22 @@ def replay_batches(state: DocState, kind_b, pos_b, slot_b) -> DocState:
 
     state, _ = jax.lax.scan(step, state, (kind_b, pos_b, slot_b))
     return state
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def replay_batches_collect(state: DocState, kind_b, pos_b, slot_b):
+    """Like :func:`replay_batches` but also stacks each op's tombstoned slot:
+    returns (state, dslot_b int32[n_batches, B]).  Used by update generation
+    (engine/downstream.py) — the untimed upstream replay that the reference's
+    ``upstream_updates`` performs (reference src/rope.rs:196-220)."""
+
+    def step(st, batch):
+        kind, pos, slot = batch
+        resolved = resolve_batch(kind, pos, st.nvis)
+        st, dslot = apply_batch_collect(st, resolved, slot)
+        return st, dslot
+
+    return jax.lax.scan(step, state, (kind_b, pos_b, slot_b))
 
 
 class ReplayEngine:
@@ -59,13 +110,7 @@ class ReplayEngine:
         self.pos_b = jnp.asarray(pos_b)
         self.slot_b = jnp.asarray(slot_b)
 
-        # slot -> codepoint is static for a given trace: init content occupies
-        # slots 0..S-1, each insert op's preassigned slot gets its char.
-        chars = np.zeros(self.capacity, np.int32)
-        chars[: self.n_init] = tt.init_chars
-        ins = tt.slot >= 0
-        chars[tt.slot[ins]] = tt.ch[ins]
-        self.chars = jnp.asarray(chars)
+        self.chars = jnp.asarray(slot_char_table(tt, self.capacity))
 
         if n_replicas == 1:
             self._replay = replay_batches
@@ -76,12 +121,9 @@ class ReplayEngine:
             )
 
     def fresh_state(self) -> DocState:
-        st = init_state(self.capacity, self.n_init)
-        if self.n_replicas > 1:
-            st = jax.tree.map(
-                lambda x: jnp.broadcast_to(x, (self.n_replicas,) + x.shape), st
-            )
-        return st
+        return broadcast_replicas(
+            init_state(self.capacity, self.n_init), self.n_replicas
+        )
 
     def run(self, state: DocState | None = None) -> DocState:
         """Replay the full trace; returns final state (device)."""
@@ -98,12 +140,8 @@ class ReplayEngine:
 
     def decode(self, state: DocState, replica: int = 0) -> str:
         """Materialize a replica's visible document as a Python string."""
-        st = (
-            jax.tree.map(lambda x: x[replica], state)
-            if self.n_replicas > 1
-            else state
-        )
-        codes, nvis = jax.jit(decode_state)(st, self.chars)
+        st = select_replica(state, replica, self.n_replicas)
+        codes, nvis = decode_state_jit(st, self.chars)
         codes = np.asarray(codes)[: int(nvis)]
         return "".join(map(chr, codes.tolist()))
 

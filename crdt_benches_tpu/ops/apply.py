@@ -74,6 +74,19 @@ def apply_batch(
 ) -> DocState:
     """Apply one resolved batch.  ``slots``: int32[B] preassigned slot ids for
     insert ops (-1 otherwise, from the tensorizer)."""
+    state, _ = apply_batch_collect(state, resolved, slots)
+    return state
+
+
+def apply_batch_collect(
+    state: DocState, resolved: ResolvedBatch, slots: jax.Array
+) -> tuple[DocState, jax.Array]:
+    """Like :func:`apply_batch` but also returns ``dslot``: int32[B], the slot
+    id tombstoned by each DELETE op (-1 for non-deletes) — covering both
+    pre-batch targets and same-batch inserts.  Update generation
+    (engine/downstream.py) uses it to name every delete's target element, the
+    analog of diamond-types encoding delete targets into updates
+    (reference src/rope.rs:201-214)."""
     C = state.order.shape[0]
     B = slots.shape[0]
     drop = jnp.int32(C)  # any out-of-range index with mode="drop"
@@ -138,13 +151,20 @@ def apply_batch(
     n_ins = jnp.sum(is_ins.astype(jnp.int32))
     n_live = jnp.sum((is_ins & resolved.ins_alive).astype(jnp.int32))
     n_del = jnp.sum(has_del.astype(jnp.int32))
-    return DocState(
+    new_state = DocState(
         order=order,
         visible=visible,
         origin=origin,
         length=state.length + n_ins,
         nvis=state.nvis - n_del + n_live,
     )
+    db = resolved.del_batch
+    out_dslot = jnp.where(
+        has_del,
+        dslot,
+        jnp.where(db >= 0, slots[jnp.clip(db, 0, B - 1)], -1),
+    )
+    return new_state, out_dslot
 
 
 def decode_state(state: DocState, chars: jax.Array):

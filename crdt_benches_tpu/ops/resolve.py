@@ -66,6 +66,10 @@ class ResolvedBatch(NamedTuple):
     ins_seq: jax.Array  # int32[B]
     ins_alive: jax.Array  # bool[B]
     origin: jax.Array  # int32[B]  (-2 for non-insert ops)
+    del_batch: jax.Array  # int32[B]  batch op index of a same-batch insert
+    #                       killed by this DELETE op (-1 otherwise) — needed
+    #                       by update generation (engine/downstream.py) to
+    #                       name every delete's target element.
 
 
 def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBatch:
@@ -155,6 +159,7 @@ def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBat
 
         # Per-op outputs.
         del_rank = jnp.where(is_del & hit_run, a + off, -1)
+        del_batch = jnp.where(is_del & (tt == TINS), a, -1)
         # Origin: char at offset p-1 at insert time.
         tp = jnp.searchsorted(cum, p - 1, side="right").astype(jnp.int32)
         origin_char = jnp.where(
@@ -164,10 +169,10 @@ def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBat
         )
         origin = jnp.where(is_ins, jnp.where(p == 0, -1, origin_char), -2)
 
-        return (ttype_n, ta_n, tlen_n), (del_rank, origin)
+        return (ttype_n, ta_n, tlen_n), (del_rank, origin, del_batch)
 
     ops = (kind, pos, jnp.arange(B, dtype=jnp.int32))
-    (ttype, ta, tlen), (del_rank, origin) = jax.lax.scan(
+    (ttype, ta, tlen), (del_rank, origin, del_batch) = jax.lax.scan(
         step, (ttype0, ta0, tlen0), ops
     )
 
@@ -209,4 +214,5 @@ def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBat
         ins_seq=ins_seq,
         ins_alive=ins_alive,
         origin=origin,
+        del_batch=del_batch,
     )

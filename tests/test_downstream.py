@@ -1,0 +1,115 @@
+"""Downstream (remote-update-apply) correctness: generated updates integrate
+into a fresh replica to byte-identical final content (the upgrade over the
+reference's length-only downstream assert, src/main.rs:68)."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.downstream import (
+    JaxDownstreamEngine,
+    generate_updates,
+)
+from crdt_benches_tpu.oracle import replay_unit_ops
+from crdt_benches_tpu.traces import tensorize
+from crdt_benches_tpu.traces.tensorize import DELETE, INSERT
+
+from test_engine import tensorize_ops
+
+A, B_, C_ = ord("a"), ord("b"), ord("c")
+
+
+def check_downstream(kinds, poss, chs, batch=8, start="", n_replicas=1):
+    tt = tensorize_ops(kinds, poss, chs, batch=batch, start=start)
+    want = replay_unit_ops(
+        tt.kind[: tt.n_ops], tt.pos[: tt.n_ops], tt.ch[: tt.n_ops], start=start
+    )
+    eng = JaxDownstreamEngine(tt, n_replicas=n_replicas)
+    state = eng.run()
+    for r in range(n_replicas):
+        assert eng.decode(state, replica=r) == want
+
+
+def test_append_only():
+    check_downstream([INSERT] * 4, [0, 1, 2, 3], [A, B_, C_, A])
+
+
+def test_insert_at_head():
+    check_downstream([INSERT] * 4, [0, 0, 0, 0], [A, B_, C_, A])
+
+
+def test_inserts_span_batches():
+    # 20 ops across 3 batches of 8: interleaved head/tail inserts
+    kinds = [INSERT] * 20
+    poss = [0, 1, 0, 2, 1, 5, 0, 7, 3, 9, 0, 1, 2, 3, 4, 15, 0, 17, 5, 19]
+    chs = [A + (i % 26) for i in range(20)]
+    check_downstream(kinds, poss, chs)
+
+
+def test_delete_prebatch():
+    check_downstream(
+        [INSERT, INSERT, INSERT, INSERT, INSERT, INSERT, INSERT, INSERT,
+         DELETE, DELETE],
+        [0, 1, 2, 3, 4, 5, 6, 7, 0, 3],
+        [A + i for i in range(8)] + [0, 0],
+    )
+
+
+def test_same_batch_insert_and_delete():
+    # insert then delete within one batch: the killed insert must tombstone
+    check_downstream(
+        [INSERT, INSERT, INSERT, DELETE, INSERT, DELETE, INSERT, INSERT],
+        [0, 1, 2, 1, 1, 2, 0, 4],
+        [A, B_, C_, 0, A, 0, B_, C_],
+    )
+
+
+def test_with_start_content():
+    check_downstream(
+        [INSERT, DELETE, INSERT, DELETE],
+        [3, 0, 5, 1],
+        [A, 0, B_, 0],
+        start="hello",
+    )
+
+
+def test_vmapped_replicas():
+    check_downstream(
+        [INSERT] * 6 + [DELETE] * 2,
+        [0, 0, 2, 1, 4, 3, 2, 0],
+        [A + i for i in range(6)] + [0, 0],
+        n_replicas=3,
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_ops_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    kinds, poss, chs = [], [], []
+    doc_len = 4
+    for _ in range(300):
+        if doc_len == 0 or rng.random() < 0.65:
+            kinds.append(INSERT)
+            poss.append(int(rng.integers(0, doc_len + 1)))
+            chs.append(int(rng.integers(97, 123)))
+            doc_len += 1
+        else:
+            kinds.append(DELETE)
+            poss.append(int(rng.integers(0, doc_len)))
+            chs.append(0)
+            doc_len -= 1
+    check_downstream(kinds, poss, chs, batch=32, start="base")
+
+
+def test_svelte_trace_byte_identical(svelte_trace):
+    tt = tensorize(svelte_trace, batch=512)
+    eng = JaxDownstreamEngine(tt)
+    state = eng.run()
+    assert int(np.asarray(state.nvis)) == len(svelte_trace.end_content)
+    assert eng.decode(state) == svelte_trace.end_content
+
+
+def test_update_wire_size_reported(svelte_trace):
+    tt = tensorize(svelte_trace, batch=512)
+    upd = generate_updates(tt)
+    assert upd.nbytes() > 0
+    assert upd.n_patches == len(svelte_trace)

@@ -159,3 +159,25 @@ def test_concurrent_runs_interleave_convergently():
     x.apply_update(uz); y.apply_update(uz)
     assert x.content() == y.content()
     assert x.content()[3] == "X"
+
+
+def test_downstream_nonempty_start_content():
+    """Regression: the downstream replica must share the upstream's init
+    element ids (agent mismatch silently dropped every update that referenced
+    start-content chars — caught only because all four real traces start
+    empty)."""
+    from crdt_benches_tpu.traces.loader import TestData, TestTxn, TestPatch
+
+    trace = TestData(
+        "hello world", "helXo wrld!",
+        [TestTxn("", [TestPatch(3, 1, "X"), TestPatch(7, 1, ""),
+                      TestPatch(10, 0, "!")])],
+    )
+    down, updates = CppCrdtDownstream.upstream_updates(trace)
+    assert down.apply_all_native() == len(trace.end_content)
+    assert down.content() == trace.end_content
+    # per-update path too
+    down2, _ = CppCrdtDownstream.upstream_updates(trace)
+    for u in updates:
+        down2.apply_update(u)
+    assert down2.content() == trace.end_content
