@@ -39,14 +39,14 @@ _vp = ctypes.c_void_p
 
 def _load_lib():
     for p in _LIB_PATHS:
+        p = os.path.normpath(p)
+        _check_fresh(p)  # builds the .so if missing/stale (it is untracked)
         if os.path.exists(p):
-            p = os.path.normpath(p)
-            _check_fresh(p)
             lib = ctypes.CDLL(p)
             break
     else:
         raise OSError(
-            "libcrdtnative.so not found — build it with `make -C native`"
+            "libcrdtnative.so not found and `make -C native` failed"
         )
     sig = lambda fn, res, args: (setattr(fn, "restype", res), setattr(fn, "argtypes", args))
     sig(lib.rope_new, _vp, [_i32p, _i64])
@@ -73,29 +73,28 @@ def _load_lib():
 
 
 def _check_fresh(so_path: str) -> None:
-    """Rebuild (best-effort) if any C++ source is newer than the .so, so
-    edits to native/ can't be silently ignored in favor of a stale binary."""
+    """Build the .so if missing, rebuild if any C++ source is newer — edits
+    to native/ can't be silently ignored in favor of a stale binary, and a
+    fresh checkout self-builds on first use."""
     import glob
     import subprocess
+    import sys
 
     native_dir = os.path.dirname(so_path)
     srcs = glob.glob(os.path.join(native_dir, "*.cpp"))
     if not srcs:
         return
-    if max(map(os.path.getmtime, srcs)) <= os.path.getmtime(so_path):
+    if os.path.exists(so_path) and max(map(os.path.getmtime, srcs)) <= (
+        os.path.getmtime(so_path)
+    ):
         return
-    import sys
-
-    print(
-        f"note: {so_path} older than native sources; rebuilding",
-        file=sys.stderr,
-    )
+    print(f"note: building {so_path} from native sources", file=sys.stderr)
     try:
         subprocess.run(
             ["make", "-C", native_dir], check=True, capture_output=True
         )
-    except Exception as e:  # keep the stale lib usable; tests will tell
-        print(f"warning: native rebuild failed ({e})", file=sys.stderr)
+    except Exception as e:  # a stale lib (if any) stays usable; tests tell
+        print(f"warning: native build failed ({e})", file=sys.stderr)
 
 
 _lib = None

@@ -50,6 +50,7 @@ from ..traces.tensorize import INSERT, TensorizedTrace, tensorize
 from .replay import (
     _round_up,
     broadcast_replicas,
+    decode_to_str,
     replay_batches_collect,
     select_replica,
     slot_char_table,
@@ -255,23 +256,6 @@ def apply_updates(state: DownState, ins_b, anchor_b, rank_b, dslot_b) -> DownSta
     return state
 
 
-def decode_down_state(state: DownState, chars: jax.Array):
-    """Visible document codepoints in order (first ``nvis`` entries)."""
-    C = state.order.shape[0]
-    idx = jnp.arange(C, dtype=jnp.int32)
-    valid = idx < state.length
-    slot_at = jnp.where(valid, state.order, 0)
-    vis = valid & state.visible[slot_at]
-    cumvis = jnp.cumsum(vis.astype(jnp.int32))
-    out = (
-        jnp.zeros(C, jnp.int32)
-        .at[jnp.where(vis, cumvis - 1, C)]
-        .set(chars[slot_at], mode="drop")
-    )
-    return out, cumvis[-1]
-
-
-decode_down_state_jit = jax.jit(decode_down_state)
 
 
 class JaxDownstreamEngine:
@@ -310,10 +294,9 @@ class JaxDownstreamEngine:
         )
 
     def decode(self, state: DownState, replica: int = 0) -> str:
-        st = select_replica(state, replica, self.n_replicas)
-        codes, nvis = decode_down_state_jit(st, self.chars)
-        codes = np.asarray(codes)[: int(nvis)]
-        return "".join(map(chr, codes.tolist()))
+        return decode_to_str(
+            select_replica(state, replica, self.n_replicas), self.chars
+        )
 
 
 class JaxDownstreamBackend:

@@ -1,0 +1,439 @@
+"""Multi-agent concurrent merge: divergent replicas -> converged document.
+
+The reference's merge capability is exercised through diamond-types'
+``decode_and_add`` (reference src/rope.rs:222-224) and automerge's
+``doc.merge`` (src/rope.rs:235): integrate concurrent remote edits into a
+local replica so that all replicas converge to one deterministic document.
+The reference never *tests* concurrency (its downstream topology is one
+writer, SURVEY.md section 4); this module makes concurrent merge a
+first-class, batched, device-resident operation (BASELINE.md configs 4-5).
+
+Design — merge as sort + batched integration
+--------------------------------------------
+Every element has a globally unique id ``(lamport, agent)``; every op is
+``INSERT(elem, origin, ch)`` or ``DELETE(target)``.  Lamport clocks respect
+causality (an op's clock exceeds every op it has seen), so sorting the union
+of op logs by ``(lamport, agent)`` yields a causal total order with
+deterministic tie-breaks — the reference's deterministic-merge analog of
+diamond-types' agent/seq ordering.
+
+The key classical fact (causal-tree / RGA equivalence): **integrating ops in
+ascending id order, placing each insert directly after its origin, produces
+the RGA document order** — a later sibling under the same origin lands closer
+to the origin, which is exactly RGA's newest-first sibling rule, and
+causality guarantees the origin is already present.  A sequential O(1)
+insertion rule becomes a batched kernel:
+
+1. sort + dedup (idempotence under duplicated delivery) — ``jnp.sort`` on
+   packed int64 ids, O(N log N) on device;
+2. per op-batch: a tiny ``lax.scan`` threads same-batch origin chains
+   (successor-pointer splicing in op-index space, O(B) state);
+3. pointer-doubling list ranking turns chains into (head, rank) pairs —
+   O(B log B), no sequential dependence;
+4. one counting merge splices all batch inserts into the order permutation
+   (same O(C) vectorized pass as ops/apply.py), deletes clear visibility.
+
+Convergence is then checked by digest equality across replicas/devices via
+collectives (parallel/mesh.py).  Delivery order, duplication, and batch
+boundaries cannot change the result (tests/test_merge.py fault-injection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.apply import init_state
+from ..traces.tensorize import DELETE, INSERT, PAD, TensorizedTrace
+from .downstream import DownState, init_down_state
+from .replay import _round_up, decode_to_str, replay_batches_collect
+
+
+@dataclass
+class OpLog:
+    """One agent's op log in exchange ("wire") format — the update-exchange
+    tensors that replace the reference's ``Vec<Update>`` in-memory network
+    (reference src/rope.rs:199,216,257).
+
+    ``elem``: inserted element's global slot (INSERT) or target slot
+    (DELETE).  ``origin``: global slot of the left-origin element (-1 =
+    document head); -2 for deletes.  ``lamport``: per-op Lamport clock.
+    """
+
+    lamport: np.ndarray  # int32[N]
+    agent: np.ndarray  # int32[N]
+    kind: np.ndarray  # int32[N]  PAD / INSERT / DELETE
+    elem: np.ndarray  # int32[N]
+    origin: np.ndarray  # int32[N]
+    ch: np.ndarray  # int32[N]
+
+    def __len__(self) -> int:
+        return len(self.lamport)
+
+    @staticmethod
+    def concat(logs: "list[OpLog]") -> "OpLog":
+        return OpLog(
+            *(
+                np.concatenate([getattr(l, f) for l in logs])
+                for f in ("lamport", "agent", "kind", "elem", "origin", "ch")
+            )
+        )
+
+
+def agent_oplog(
+    tt: TensorizedTrace, agent: int, slot_base: int, n_base: int
+) -> OpLog:
+    """Build agent ``agent``'s op log by replaying its local edit stream
+    (UNTIMED, like the reference's update generation, src/main.rs:60).
+
+    The agent starts from the shared base document (``tt.init_chars``, global
+    slots ``0..n_base-1``, which must be identical across agents); its local
+    insert slot ``k`` (local ``k >= n_base``) maps to global slot
+    ``slot_base + (k - n_base)``.  Local op ``i`` gets Lamport clock
+    ``n_base + 1 + i`` — it has seen the base plus its own prior ops.
+    """
+    if len(tt.init_chars) != n_base:
+        raise ValueError("all agents must share the same base document")
+    capacity = _round_up(max(tt.capacity, 1), 128)
+    kind_b, pos_b, _, slot_b = tt.batched()
+    state, dslot_b = replay_batches_collect(
+        init_state(capacity, n_base),
+        jnp.asarray(kind_b),
+        jnp.asarray(pos_b),
+        jnp.asarray(slot_b),
+    )
+    origin_local = np.asarray(state.origin)
+    dslot = np.asarray(dslot_b).reshape(-1)[: tt.n_ops]
+
+    def to_global(local: np.ndarray) -> np.ndarray:
+        return np.where(
+            local < 0, local, np.where(
+                local < n_base, local, slot_base + (local - n_base)
+            )
+        ).astype(np.int32)
+
+    kind = tt.kind[: tt.n_ops].astype(np.int32)
+    is_ins = kind == INSERT
+    elem = np.where(is_ins, to_global(tt.slot[: tt.n_ops]), to_global(dslot))
+    origin = np.where(
+        is_ins, to_global(origin_local[np.clip(tt.slot[: tt.n_ops], 0, None)]),
+        -2,
+    ).astype(np.int32)
+    n = tt.n_ops
+    return OpLog(
+        lamport=(n_base + 1 + np.arange(n, dtype=np.int32)),
+        agent=np.full(n, agent, np.int32),
+        kind=kind,
+        elem=elem.astype(np.int32),
+        origin=origin,
+        ch=tt.ch[: tt.n_ops].astype(np.int32),
+    )
+
+
+# ---- device merge kernel ---------------------------------------------------
+
+
+def _sort_dedup(lamport, agent, kind, elem, origin, ch):
+    """Sort ops by (lamport, agent) — a causal total order with deterministic
+    tie-breaks — and PAD-out exact duplicates (idempotent delivery).  PAD ops
+    sort to the end.  Two stable int32 argsorts give the lexicographic order
+    without int64 keys (x64 is typically disabled)."""
+    inf = jnp.int32(2**31 - 1)
+    is_pad = kind == PAD
+    lam_k = jnp.where(is_pad, inf, lamport)
+    p1 = jnp.argsort(agent, stable=True)
+    p2 = jnp.argsort(lam_k[p1], stable=True)
+    perm = p1[p2]
+    lam_s, ag_s = lam_k[perm], agent[perm]
+    dup = jnp.concatenate(
+        [
+            jnp.zeros(1, bool),
+            (lam_s[1:] == lam_s[:-1])
+            & (ag_s[1:] == ag_s[:-1])
+            & (lam_s[1:] < inf),
+        ]
+    )
+    take = lambda x: x[perm]
+    kind = jnp.where(dup, PAD, take(kind))
+    return take(lamport), take(agent), kind, take(elem), take(origin), take(ch)
+
+
+def _integrate_batch(state: DownState, kind, elem, origin, ch_unused):
+    """Integrate one id-sorted op batch (B ops) into the document.
+
+    Steps: locate same-batch origins; scan-splice successor chains in
+    op-index space; pointer-double to (head, rank); counting-merge the new
+    elements after their external anchors; scatter visibility."""
+    C = state.order.shape[0]
+    B = kind.shape[0]
+    drop = jnp.int32(C)
+    idx = jnp.arange(C, dtype=jnp.int32)
+    j32 = jnp.arange(B, dtype=jnp.int32)
+    is_ins = kind == INSERT
+    is_del = kind == DELETE
+
+    # Which batch op (if any) inserted each element: elem -> op index.
+    opof = (
+        jnp.full(C, -1, jnp.int32)
+        .at[jnp.where(is_ins, elem, drop)]
+        .set(j32, mode="drop")
+    )
+    org_op = jnp.where(
+        origin >= 0, opof[jnp.clip(origin, 0, C - 1)], -1
+    )  # batch op that inserted my origin (-1 = external)
+    internal = is_ins & (org_op >= 0) & (org_op < j32)
+
+    # Representative head per external-origin group: smallest op index sharing
+    # my external origin (others chain after it in the scan).
+    ext_origin = jnp.where(is_ins & ~internal, origin, -2)
+    headof = (
+        jnp.full(C + 1, jnp.int32(B), jnp.int32)
+        .at[jnp.clip(ext_origin, -1, C - 1) + 1]
+        .min(jnp.where(ext_origin >= -1, j32, B), mode="drop")
+    )
+    rep = jnp.where(
+        is_ins & ~internal,
+        headof[jnp.clip(ext_origin, -1, C - 1) + 1],
+        -1,
+    )
+
+    # Node space for chain splicing: 0..B-1 = batch inserts,
+    # B..2B-1 = external-head sentinels (sentinel B+r for rep r), 2B = nil.
+    NIL = 2 * B
+
+    def splice(nxt, op):
+        j, ins, intern, k, r = op
+        pred = jnp.where(intern, k, B + r)  # insert directly after this node
+        old = nxt[pred]
+        nxt = jnp.where(
+            ins, nxt.at[j].set(old).at[pred].set(j), nxt
+        )
+        return nxt, None
+
+    nxt0 = jnp.full(2 * B + 1, NIL, jnp.int32)
+    nxt, _ = jax.lax.scan(
+        splice, nxt0, (j32, is_ins, internal, org_op, rep)
+    )
+
+    # Pointer-double predecessors to find (sentinel head, rank) per insert.
+    pred0 = (
+        jnp.full(2 * B + 1, NIL, jnp.int32)
+        .at[jnp.where(nxt < NIL, nxt, NIL)]
+        .set(jnp.arange(2 * B + 1, dtype=jnp.int32), mode="promise_in_bounds")
+    )
+    pred0 = pred0.at[NIL].set(NIL)
+    # sentinels and nil are roots: point to themselves with distance 0
+    node = jnp.arange(2 * B + 1, dtype=jnp.int32)
+    is_root = node >= B
+    par = jnp.where(is_root, node, pred0[node])
+    dist = jnp.where(is_root | (par == node), 0, 1).astype(jnp.int32)
+    n_rounds = max(1, (2 * B).bit_length())
+
+    def double(pd, _):
+        par, dist = pd
+        return (par[par], dist + jnp.where(par != node, dist[par], 0)), None
+
+    (par, dist), _ = jax.lax.scan(double, (par, dist), None, length=n_rounds)
+    # per-insert: head sentinel (par in B..2B-1) and rank = dist - 1
+    head_sent = par[j32]
+    rank = dist[j32] - 1
+    head_op = head_sent - B  # the rep op whose external origin anchors chain
+
+    # External anchor element and its physical position.
+    valid = idx < state.length
+    phys = (
+        jnp.zeros(C, jnp.int32)
+        .at[jnp.where(valid, state.order, drop)]
+        .set(idx, mode="drop")
+    )
+    anchor_elem = origin[jnp.clip(head_op, 0, B - 1)]  # -1 = document head
+    a_phys = jnp.where(
+        anchor_elem >= 0, phys[jnp.clip(anchor_elem, 0, C - 1)], -1
+    )
+    gap = jnp.where(is_ins, a_phys + 1, C + 1)
+
+    bump = jnp.zeros(C + 1, jnp.int32).at[gap].add(1, mode="drop")
+    csum = jnp.cumsum(bump)
+    new_idx_old = idx + csum[idx]
+    n_before = jnp.where(gap > 0, csum[jnp.clip(gap - 1, 0)], 0)
+    new_idx_ins = gap + n_before + rank
+
+    order = (
+        jnp.full(C, -1, jnp.int32)
+        .at[jnp.where(valid, new_idx_old, drop)]
+        .set(jnp.where(valid, state.order, -1), mode="drop")
+        .at[jnp.where(is_ins, new_idx_ins, drop)]
+        .set(elem, mode="drop")
+    )
+    visible = (
+        state.visible.at[jnp.where(is_ins, elem, drop)]
+        .set(True, mode="drop")
+        .at[jnp.where(is_del, elem, drop)]
+        .set(False, mode="drop")
+    )
+    length = state.length + jnp.sum(is_ins.astype(jnp.int32))
+    valid2 = idx < length
+    nvis = jnp.sum(
+        valid2 & visible[jnp.where(valid2, order, 0)], dtype=jnp.int32
+    )
+    return DownState(order=order, visible=visible, length=length, nvis=nvis)
+
+
+@partial(jax.jit, static_argnames=("batch",))
+def merge_oplogs(
+    state: DownState,
+    lamport: jax.Array,
+    agent: jax.Array,
+    kind: jax.Array,
+    elem: jax.Array,
+    origin: jax.Array,
+    ch: jax.Array,
+    *,
+    batch: int = 256,
+) -> DownState:
+    """Merge a union of op logs (any delivery order, duplicates allowed) into
+    ``state``.  N must be a multiple of ``batch`` (PAD-pad beforehand)."""
+    lamport, agent, kind, elem, origin, ch = _sort_dedup(
+        lamport, agent, kind, elem, origin, ch
+    )
+    nb = kind.shape[0] // batch
+    rs = lambda x: x.reshape(nb, batch)
+
+    def step(st, ops):
+        return _integrate_batch(st, *ops), None
+
+    state, _ = jax.lax.scan(
+        step, state, (rs(kind), rs(elem), rs(origin), rs(ch))
+    )
+    return state
+
+
+# ---- host-side driver ------------------------------------------------------
+
+
+class MergeSimulation:
+    """Simulate A agents editing concurrently from a shared base, then every
+    replica merging the union of op logs (BASELINE.md configs 4-5).
+
+    ``streams``: one TensorizedTrace per agent (its local edit stream).  All
+    must share the same base document.
+    """
+
+    def __init__(self, streams: list[TensorizedTrace], base: str = "",
+                 batch: int = 256):
+        self.batch = batch
+        self.n_agents = len(streams)
+        n_base = len(base)
+        if any(len(tt.init_chars) != n_base for tt in streams):
+            raise ValueError("all agent streams must share the base document")
+        slot_base = n_base
+        logs, self.chars_parts = [], []
+        for a, tt in enumerate(streams):
+            logs.append(agent_oplog(tt, agent=a + 1, slot_base=slot_base,
+                                    n_base=n_base))
+            ins = tt.slot >= n_base
+            self.chars_parts.append(tt.ch[ins])
+            slot_base += tt.n_inserts
+        self.capacity = _round_up(max(slot_base, 1), 128)
+        self.n_base = n_base
+        chars = np.zeros(self.capacity, np.int32)
+        chars[:n_base] = np.asarray([ord(c) for c in base], np.int32)
+        off = n_base
+        for part in self.chars_parts:
+            chars[off : off + len(part)] = part
+            off += len(part)
+        self.chars = jnp.asarray(chars)
+        self.agent_logs = logs  # per-agent, for distributed exchange
+        self.log = OpLog.concat(logs)
+
+    def stacked_logs(self) -> dict[str, np.ndarray]:
+        """Per-agent logs padded to a common batch-multiple length and
+        stacked to int32[A, N] — the sharded update-exchange layout
+        (parallel/mesh.py sharded_merge_and_converge)."""
+        n = _round_up(max(len(l) for l in self.agent_logs), self.batch)
+        fills = dict(lamport=0, agent=0, kind=PAD, elem=-1, origin=-2, ch=0)
+        out = {}
+        for f, fill in fills.items():
+            out[f] = np.stack(
+                [
+                    np.concatenate(
+                        [
+                            getattr(l, f),
+                            np.full(n - len(l), fill, np.int32),
+                        ]
+                    )
+                    for l in self.agent_logs
+                ]
+            )
+        return out
+
+    def _padded(self, log: OpLog) -> OpLog:
+        n = len(log)
+        n_pad = (-n) % self.batch if n else self.batch
+        if not n_pad:
+            return log
+        z = lambda fill: np.full(n_pad, fill, np.int32)
+        return OpLog(
+            lamport=np.concatenate([log.lamport, z(0)]),
+            agent=np.concatenate([log.agent, z(0)]),
+            kind=np.concatenate([log.kind, z(PAD)]),
+            elem=np.concatenate([log.elem, z(-1)]),
+            origin=np.concatenate([log.origin, z(-2)]),
+            ch=np.concatenate([log.ch, z(0)]),
+        )
+
+    def merge(self, log: OpLog | None = None) -> DownState:
+        """One replica integrates the (padded) union of op logs."""
+        log = self._padded(log if log is not None else self.log)
+        state = init_down_state(self.capacity, self.n_base)
+        return merge_oplogs(
+            state,
+            jnp.asarray(log.lamport),
+            jnp.asarray(log.agent),
+            jnp.asarray(log.kind),
+            jnp.asarray(log.elem),
+            jnp.asarray(log.origin),
+            jnp.asarray(log.ch),
+            batch=self.batch,
+        )
+
+    def decode(self, state: DownState) -> str:
+        return decode_to_str(state, self.chars)
+
+
+# ---- pure-Python merge oracle ---------------------------------------------
+
+
+def merge_oracle(log: OpLog, base: str, chars: np.ndarray) -> str:
+    """Sequential reference: sort ops by (lamport, agent), dedup, insert each
+    element directly after its origin in a Python list, tombstone deletes.
+    Ground truth for the batched kernel (SURVEY.md section 4 rebuild
+    implication: differential tests against a trivial oracle)."""
+    order = np.argsort(
+        log.lamport.astype(np.int64) * (int(log.agent.max(initial=0)) + 2)
+        + log.agent,
+        kind="stable",
+    )
+    seen: set[tuple[int, int]] = set()
+    doc: list[int] = list(range(len(base)))  # global slots
+    visible = {s: True for s in doc}
+    for i in order:
+        k = int(log.kind[i])
+        if k == PAD:
+            continue
+        key = (int(log.lamport[i]), int(log.agent[i]))
+        if key in seen:
+            continue
+        seen.add(key)
+        if k == INSERT:
+            org = int(log.origin[i])
+            at = doc.index(org) + 1 if org >= 0 else 0
+            doc.insert(at, int(log.elem[i]))
+            visible[int(log.elem[i])] = True
+        else:
+            visible[int(log.elem[i])] = False
+    return "".join(chr(int(chars[s])) for s in doc if visible[s])

@@ -60,6 +60,15 @@ def select_replica(state, replica: int, n_replicas: int):
     )
 
 
+def decode_to_str(state, chars) -> str:
+    """Materialize a single replica's visible document as a Python string.
+    Works for any state pytree with order/visible/length fields (DocState,
+    DownState)."""
+    codes, nvis = decode_state_jit(state, chars)
+    codes = np.asarray(codes)[: int(nvis)]
+    return "".join(map(chr, codes.tolist()))
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def replay_batches(state: DocState, kind_b, pos_b, slot_b) -> DocState:
     """Scan all op batches into the document state.  Shapes:
@@ -140,10 +149,9 @@ class ReplayEngine:
 
     def decode(self, state: DocState, replica: int = 0) -> str:
         """Materialize a replica's visible document as a Python string."""
-        st = select_replica(state, replica, self.n_replicas)
-        codes, nvis = decode_state_jit(st, self.chars)
-        codes = np.asarray(codes)[: int(nvis)]
-        return "".join(map(chr, codes.tolist()))
+        return decode_to_str(
+            select_replica(state, replica, self.n_replicas), self.chars
+        )
 
     def lengths(self, state: DocState) -> np.ndarray:
         """Per-replica visible char counts — the reference's length oracle

@@ -23,6 +23,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.8 top-level API (check_vma kwarg); fall back for older
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        kw.pop("check_rep", None)
+        return _shard_map(f, check_vma=False, **kw)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
 from ..ops.apply import DocState, apply_batch, init_state
 from ..ops.resolve import resolve_batch
 from ..utils.digest import doc_digest
@@ -72,8 +81,6 @@ def sharded_replay_and_digest(mesh: Mesh):
         converged = jnp.all(gmin == gmax)
         return state, digests, converged
 
-    from jax.experimental.shard_map import shard_map
-
     dummy = DocState(0, 0, 0, 0, 0)
     state_spec = jax.tree.map(lambda _: P(AXIS), dummy)
     step = shard_map(
@@ -87,6 +94,62 @@ def sharded_replay_and_digest(mesh: Mesh):
         lambda _: NamedSharding(mesh, P(AXIS)), dummy
     )
     return jax.jit(step), state_sharding
+
+
+def sharded_merge_and_converge(
+    mesh: Mesh, capacity: int, n_base: int, batch: int
+):
+    """Build the distributed update-exchange + merge step (the TPU-native
+    replacement for the reference's in-memory ``Vec<Update>`` "network",
+    SURVEY.md section 5): every replica's op log is exchanged with
+    ``all_gather`` over the replica mesh axis (riding ICI/DCN), then every
+    replica independently integrates the union via engine/merge.py's
+    sort + batched-integration kernel, and the mesh agrees on convergence by
+    comparing digests with pmin/pmax collectives.
+
+    Replicas rebuild from the shared base rather than patching their local
+    state: on accelerators recompute-from-sorted-union is one fused scan
+    pipeline, while incremental out-of-order integration would reintroduce
+    the sequential sibling-scan RGA does per op (see engine/merge.py).
+
+    Returns ``step(logs, chars) -> (states, digests, converged)`` where
+    ``logs`` is a dict of int32[R, N] arrays (lamport/agent/kind/elem/
+    origin/ch, R = total replicas, N a multiple of ``batch``), sharded over
+    the replica axis.  Every replica integrates the full union, so states
+    and digests are [R, ...] and converged is a replicated scalar bool.
+    """
+    from ..engine.downstream import init_down_state
+    from ..engine.merge import merge_oplogs
+
+    def body(lam, ag, kind, elem, orig, ch, chars):
+        # local shard (r_loc, N) -> exchange -> union (R*N,)
+        g = lambda x: jax.lax.all_gather(x, AXIS, tiled=True).reshape(-1)
+        union = tuple(map(g, (lam, ag, kind, elem, orig, ch)))
+
+        def integrate(_r):
+            st = init_down_state(capacity, n_base)
+            return merge_oplogs(st, *union, batch=batch)
+
+        states = jax.vmap(integrate)(jnp.arange(lam.shape[0]))
+        digests = jax.vmap(
+            lambda st: doc_digest(st.order, st.visible, st.length, chars)
+        )(states)
+        gmin = jax.lax.pmin(jnp.min(digests, axis=0), AXIS)
+        gmax = jax.lax.pmax(jnp.max(digests, axis=0), AXIS)
+        return states, digests, jnp.all(gmin == gmax)
+
+    log_spec = tuple(P(AXIS) for _ in range(6))
+    state_spec = jax.tree.map(
+        lambda _: P(AXIS), init_down_state(1, 0)
+    )
+    step = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=log_spec + (P(),),
+        out_specs=(state_spec, P(AXIS), P()),
+        check_rep=False,
+    )
+    return jax.jit(step)
 
 
 def make_sharded_state(

@@ -1,0 +1,172 @@
+"""Concurrent multi-agent merge: determinism, convergence, idempotence, and
+delivery-order independence (the CRDT properties the reference never tests —
+SURVEY.md section 4 — plus fault injection per section 5)."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.merge import (
+    MergeSimulation,
+    OpLog,
+    merge_oracle,
+)
+from crdt_benches_tpu.traces.tensorize import DELETE, INSERT
+
+from test_engine import tensorize_ops
+
+A = ord("a")
+
+
+def make_stream(rng, base: str, n_ops: int, batch: int = 8):
+    """A random local edit stream (unit ops) starting from ``base``."""
+    from crdt_benches_tpu.traces.synth import random_patches
+    from crdt_benches_tpu.traces.tensorize import tensorize
+    from crdt_benches_tpu.traces.loader import TestData, TestTxn
+
+    patches, _ = random_patches(rng, n_ops, len(base))
+    return tensorize(TestData(base, "", [TestTxn("", patches)]), batch=batch)
+
+
+def sim_for(seed: int, n_agents: int, n_ops: int, base: str = "base text",
+            batch: int = 16) -> MergeSimulation:
+    rng = np.random.default_rng(seed)
+    streams = [make_stream(rng, base, n_ops, batch=batch)
+               for _ in range(n_agents)]
+    return MergeSimulation(streams, base=base, batch=batch)
+
+
+def shuffled_log(log: OpLog, rng) -> OpLog:
+    perm = rng.permutation(len(log))
+    return OpLog(*(getattr(log, f)[perm] for f in
+                   ("lamport", "agent", "kind", "elem", "origin", "ch")))
+
+
+def test_single_agent_matches_local_replay():
+    """With one agent, merging its op log must reproduce its local edit."""
+    from crdt_benches_tpu.oracle import replay_unit_ops
+
+    base = "hello"
+    tt = tensorize_ops(
+        [INSERT, INSERT, DELETE, INSERT],
+        [5, 0, 2, 3],
+        [A, A + 1, 0, A + 2],
+        start=base,
+    )
+    want = replay_unit_ops(
+        tt.kind[: tt.n_ops], tt.pos[: tt.n_ops], tt.ch[: tt.n_ops], start=base
+    )
+    sim = MergeSimulation([tt], base=base, batch=8)
+    got = sim.decode(sim.merge())
+    assert got == want
+
+
+def test_two_agents_deterministic_vs_oracle():
+    sim = sim_for(seed=0, n_agents=2, n_ops=20)
+    state = sim.merge()
+    got = sim.decode(state)
+    want = merge_oracle(sim.log, "base text", np.asarray(sim.chars))
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_agents_vs_oracle(seed):
+    sim = sim_for(seed=seed, n_agents=3, n_ops=40)
+    got = sim.decode(sim.merge())
+    want = merge_oracle(sim.log, "base text", np.asarray(sim.chars))
+    assert got == want
+
+
+def test_delivery_order_independence():
+    """Fault injection: shuffled delivery must converge to the same doc."""
+    sim = sim_for(seed=1, n_agents=3, n_ops=30)
+    rng = np.random.default_rng(7)
+    want = sim.decode(sim.merge())
+    for _ in range(3):
+        got = sim.decode(sim.merge(shuffled_log(sim.log, rng)))
+        assert got == want
+
+
+def test_duplicated_delivery_idempotent():
+    """Fault injection: every update delivered twice -> same doc."""
+    sim = sim_for(seed=2, n_agents=2, n_ops=25)
+    want = sim.decode(sim.merge())
+    dup = OpLog.concat([sim.log, sim.log])
+    rng = np.random.default_rng(3)
+    got = sim.decode(sim.merge(shuffled_log(dup, rng)))
+    assert got == want
+
+
+def test_batch_size_independence():
+    """The same op set merged with different batch sizes must agree (batch
+    boundaries are an implementation detail, not semantics)."""
+    rng = np.random.default_rng(5)
+    base = "shared"
+    streams16 = [make_stream(rng, base, 30, batch=16) for _ in range(2)]
+    sim16 = MergeSimulation(streams16, base=base, batch=16)
+    sim4 = MergeSimulation(streams16, base=base, batch=4)
+    assert sim16.decode(sim16.merge()) == sim4.decode(sim4.merge())
+
+
+def test_empty_base_concurrent_typing():
+    """Two agents typing concurrently from an empty doc: both texts survive
+    in full, in a deterministic interleaving."""
+    t1 = tensorize_ops([INSERT] * 3, [0, 1, 2], [ord(c) for c in "abc"])
+    t2 = tensorize_ops([INSERT] * 3, [0, 1, 2], [ord(c) for c in "xyz"])
+    sim = MergeSimulation([t1, t2], base="", batch=8)
+    got = sim.decode(sim.merge())
+    assert sorted(got) == sorted("abcxyz")
+    # each agent's text must appear in order (RGA preserves intention)
+    def subseq(s, t):
+        it = iter(t)
+        return all(c in it for c in s)
+    assert subseq("abc", got) and subseq("xyz", got)
+    want = merge_oracle(sim.log, "", np.asarray(sim.chars))
+    assert got == want
+
+
+def test_concurrent_delete_same_element():
+    """Both agents delete the same base char: tombstone once (commutes)."""
+    base = "abcd"
+    t1 = tensorize_ops([DELETE], [1], [0], start=base)
+    t2 = tensorize_ops([DELETE, INSERT], [1, 2], [0, ord("Z")], start=base)
+    sim = MergeSimulation([t1, t2], base=base, batch=8)
+    got = sim.decode(sim.merge())
+    want = merge_oracle(sim.log, base, np.asarray(sim.chars))
+    assert got == want
+    assert "b" not in got and "Z" in got
+
+
+def test_sharded_merge_divergent_replicas_converge():
+    """8 divergent replicas (one agent each) sharded over the 8-device CPU
+    mesh: all_gather the op logs, every replica integrates the union, all
+    digests agree, and the content matches the single-device merge."""
+    import jax.numpy as jnp
+
+    from crdt_benches_tpu.parallel.mesh import (
+        replica_mesh,
+        sharded_merge_and_converge,
+    )
+
+    sim = sim_for(seed=9, n_agents=8, n_ops=12, base="mesh base", batch=16)
+    logs = sim.stacked_logs()
+    mesh = replica_mesh(8)
+    step = sharded_merge_and_converge(
+        mesh, sim.capacity, sim.n_base, batch=16
+    )
+    states, digests, converged = step(
+        jnp.asarray(logs["lamport"]),
+        jnp.asarray(logs["agent"]),
+        jnp.asarray(logs["kind"]),
+        jnp.asarray(logs["elem"]),
+        jnp.asarray(logs["origin"]),
+        jnp.asarray(logs["ch"]),
+        sim.chars,
+    )
+    assert bool(np.asarray(converged))
+    d = np.asarray(digests)
+    assert (d == d[0]).all()
+    # content identical to the one-replica merge of the same union
+    import jax
+
+    st0 = jax.tree.map(lambda x: x[0], states)
+    assert sim.decode(st0) == sim.decode(sim.merge())
