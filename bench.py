@@ -15,6 +15,8 @@ Environment knobs:
   CRDT_BENCH_REPLICAS  replica count (default auto: 256 on TPU, 8 on CPU)
   CRDT_BENCH_SAMPLES   timed samples (default 3)
   CRDT_BENCH_BATCH     op batch size (default 512)
+  CRDT_BENCH_PLATFORM  pin the JAX platform (e.g. "cpu"); if the accelerator
+                       backend errors out, bench falls back to CPU anyway
 """
 
 from __future__ import annotations
@@ -57,7 +59,21 @@ def main() -> int:
     # ---- JAX batched replay ----
     import jax
 
-    platform = jax.devices()[0].platform
+    if os.environ.get("CRDT_BENCH_PLATFORM"):
+        # explicit platform pin (e.g. cpu when the TPU tunnel is busy);
+        # config API because this env's sitecustomize overrides JAX_PLATFORMS
+        jax.config.update("jax_platforms", os.environ["CRDT_BENCH_PLATFORM"])
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError as e:  # accelerator tunnel down -> still produce
+        # the metric on CPU rather than failing the whole bench run
+        print(
+            f"warning: accelerator backend unavailable ({e}); "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
+        jax.config.update("jax_platforms", "cpu")
+        platform = jax.devices()[0].platform
     default_r = 256 if platform not in ("cpu",) else 8
     replicas = int(os.environ.get("CRDT_BENCH_REPLICAS", str(default_r)))
 

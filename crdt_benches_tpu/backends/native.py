@@ -93,6 +93,9 @@ def _check_fresh(so_path: str) -> None:
         subprocess.run(
             ["make", "-C", native_dir], check=True, capture_output=True
         )
+    except subprocess.CalledProcessError as e:
+        err = (e.stderr or b"").decode(errors="replace")[-2000:]
+        print(f"warning: native build failed ({e})\n{err}", file=sys.stderr)
     except Exception as e:  # a stale lib (if any) stays usable; tests tell
         print(f"warning: native build failed ({e})", file=sys.stderr)
 
@@ -167,6 +170,32 @@ class CppRope(Upstream):
             pa.ins_flat, pa.n_patches, out, len(out),
         )
         return "".join(map(chr, out[:n].tolist()))
+
+
+@register_upstream
+class CppRopeBytes(CppRope):
+    """Byte-addressed gap-buffer rope: the reference's byte-offset adapter
+    capability (cola/yrs set EDITS_USE_BYTE_OFFSETS, src/rope.rs:82,147).
+    Same native engine as CppRope but addressed and fed in UTF-8 byte
+    units via ``trace.chars_to_bytes()`` + ``patch_arrays(...,
+    bytes_mode=True)``; ``len`` is a byte count."""
+
+    NAME = "cpp-rope-bytes"
+    EDITS_USE_BYTE_OFFSETS = True
+
+    @classmethod
+    def from_str(cls, s: str) -> "CppRopeBytes":
+        b = np.frombuffer(s.encode("utf-8"), np.uint8).astype(np.int32)
+        return cls(lib().rope_new(np.ascontiguousarray(b), len(b)))
+
+    def insert(self, at: int, text: str) -> None:
+        b = np.frombuffer(text.encode("utf-8"), np.uint8).astype(np.int32)
+        lib().rope_insert(self._h, at, np.ascontiguousarray(b), len(b))
+
+    def content(self) -> str:
+        out = np.zeros(len(self), np.int32)
+        lib().rope_read(self._h, out)
+        return bytes(out.astype(np.uint8).tobytes()).decode("utf-8")
 
 
 @register_upstream

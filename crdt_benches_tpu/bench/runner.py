@@ -31,7 +31,8 @@ from .harness import (
 
 
 def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
-                 replicas: int, batch: int) -> BenchResult | None:
+                 replicas: int, batch: int,
+                 profile_dir: str | None = None) -> BenchResult | None:
     trace = load_testing_data(trace_name)
     elements = len(trace)
     native_names = _native_upstreams()
@@ -40,9 +41,14 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
 
         if not native_available():
             return None
-        pa = patch_arrays(trace)
         cls = native_names[backend]
-        end_len = len(trace.end_content)
+        if getattr(cls, "EDITS_USE_BYTE_OFFSETS", False):
+            # byte-addressed backend: rewrite offsets to UTF-8 byte units
+            # (reference src/main.rs:21-23)
+            pa = patch_arrays(trace.chars_to_bytes(), bytes_mode=True)
+        else:
+            pa = patch_arrays(trace)
+        end_len = pa.end_len
 
         def iter_fn():
             n = cls.replay_patches(pa)
@@ -68,6 +74,11 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
         b = JaxReplayBackend(n_replicas=replicas, batch=batch)
         b.prepare(trace)
         times = measure(b.replay_once, warmup=warmup, samples=samples)
+        if profile_dir:
+            import jax
+
+            with jax.profiler.trace(profile_dir):
+                b.replay_once()
         return BenchResult(
             "upstream", trace_name, b.NAME, elements, times, replicas=replicas
         )
@@ -130,6 +141,12 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--save-baseline", default=None)
     ap.add_argument("--baseline", default=None)
+    ap.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of one jax-backend iteration "
+             "into DIR (the tracing capability Criterion leaves to external "
+             "tools; view with TensorBoard/XProf)",
+    )
     args = ap.parse_args(argv)
 
     results: list[BenchResult] = []
@@ -137,7 +154,8 @@ def main(argv=None) -> int:
         for backend in args.backends.split(","):
             if not args.filter or args.filter in "upstream":
                 r = run_upstream(trace, backend, args.samples, args.warmup,
-                                 args.replicas, args.batch)
+                                 args.replicas, args.batch,
+                                 profile_dir=args.profile)
                 if r:
                     results.append(r)
                     print(

@@ -25,19 +25,29 @@ class PatchArrays:
     end_len: int
 
 
-def patch_arrays(trace: TestData) -> PatchArrays:
+def patch_arrays(trace: TestData, bytes_mode: bool = False) -> PatchArrays:
+    """``bytes_mode``: encode text as UTF-8 bytes (one int per byte) for
+    byte-addressed backends — the trace must already be in byte units
+    (``trace.chars_to_bytes()``), matching the reference's byte-offset
+    adapters (cola/yrs, src/rope.rs:82,147)."""
+    enc = (
+        (lambda s: list(s.encode("utf-8")))
+        if bytes_mode
+        else (lambda s: [ord(c) for c in s])
+    )
     pos, dels, lens, flat = [], [], [0], []
     for p, d, ins in trace.iter_patches():
         pos.append(p)
         dels.append(d)
-        lens.append(lens[-1] + len(ins))
-        flat.extend(ord(c) for c in ins)
+        chunk = enc(ins)
+        lens.append(lens[-1] + len(chunk))
+        flat.extend(chunk)
     return PatchArrays(
         pos=np.asarray(pos, np.int32),
         del_count=np.asarray(dels, np.int32),
         ins_off=np.asarray(lens, np.int32),
         ins_flat=np.asarray(flat, np.int32),
-        init=np.asarray([ord(c) for c in trace.start_content], np.int32),
+        init=np.asarray(enc(trace.start_content), np.int32),
         n_patches=len(pos),
-        end_len=len(trace.end_content),
+        end_len=len(enc(trace.end_content)),
     )

@@ -1,0 +1,42 @@
+"""Checkpoint / resume for replica document state.
+
+The reference has no checkpoint subsystem (SURVEY.md section 5); its closest
+analog is the update wire encoding (diamond-types ``encode_from``, reference
+src/rope.rs:214).  The rebuild makes persistence first-class: any engine
+state pytree (DocState, DownState, vmapped replica stacks) round-trips
+through a single ``.npz`` file, so a long replay can stop after any op batch
+and resume bit-exactly — tested in tests/test_checkpoint.py.
+
+Format: one array per state field plus a field-order manifest and the state
+class name; plain NumPy, no framework dependency on the read side.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.downstream import DownState
+from ..ops.apply import DocState
+
+_CLASSES = {"DocState": DocState, "DownState": DownState}
+
+
+def save_state(path: str, state) -> None:
+    """Persist a DocState/DownState pytree (device arrays are fetched)."""
+    cls = type(state).__name__
+    if cls not in _CLASSES:
+        raise TypeError(f"unsupported state type {cls}")
+    arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
+    np.savez_compressed(
+        path, __class__=np.asarray(cls), __fields__=np.asarray(state._fields),
+        **arrays,
+    )
+
+
+def load_state(path: str):
+    """Restore a state pytree saved by :func:`save_state` (host arrays;
+    device placement happens lazily on first use)."""
+    with np.load(path) as z:
+        cls = _CLASSES[str(z["__class__"])]
+        fields = [str(f) for f in z["__fields__"]]
+        return cls(**{f: z[f] for f in fields})

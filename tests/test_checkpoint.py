@@ -1,0 +1,48 @@
+"""Checkpoint/resume: stop a replay after any op batch, restore from disk,
+finish, and get a bit-identical document (the subsystem the reference lacks,
+SURVEY.md section 5)."""
+
+import numpy as np
+
+from crdt_benches_tpu.engine.replay import ReplayEngine
+from crdt_benches_tpu.traces.synth import synth_trace
+from crdt_benches_tpu.traces.tensorize import tensorize
+from crdt_benches_tpu.utils.checkpoint import load_state, save_state
+
+
+def test_checkpoint_resume_mid_replay(tmp_path):
+    tt = tensorize(synth_trace(seed=3, n_ops=200, base="checkpointed"),
+                   batch=16)
+    eng = ReplayEngine(tt)
+    want = eng.decode(eng.run_blocking())
+
+    # replay only the first half of the batches, checkpoint, restore, finish
+    half = tt.n_batches // 2
+    from crdt_benches_tpu.engine.replay import replay_batches
+
+    st = eng.fresh_state()
+    st = replay_batches(
+        st, eng.kind_b[:half], eng.pos_b[:half], eng.slot_b[:half]
+    )
+    path = str(tmp_path / "ck.npz")
+    save_state(path, st)
+
+    st2 = load_state(path)
+    assert type(st2).__name__ == "DocState"
+    st3 = replay_batches(
+        st2, eng.kind_b[half:], eng.pos_b[half:], eng.slot_b[half:]
+    )
+    assert eng.decode(st3) == want
+
+
+def test_checkpoint_roundtrip_downstream(tmp_path):
+    from crdt_benches_tpu.engine.downstream import JaxDownstreamEngine
+
+    tt = tensorize(synth_trace(seed=4, n_ops=100), batch=16)
+    eng = JaxDownstreamEngine(tt)
+    state = eng.run()
+    path = str(tmp_path / "down.npz")
+    save_state(path, state)
+    st2 = load_state(path)
+    for f in state._fields:
+        assert (np.asarray(getattr(state, f)) == getattr(st2, f)).all()

@@ -181,3 +181,27 @@ def test_downstream_nonempty_start_content():
     for u in updates:
         down2.apply_update(u)
     assert down2.content() == trace.end_content
+
+
+def test_byte_offset_rope_backend():
+    """Byte-addressed rope (EDITS_USE_BYTE_OFFSETS capability, reference
+    cola/yrs adapters): non-ASCII edits addressed in UTF-8 byte units."""
+    from crdt_benches_tpu.backends.native import CppRopeBytes
+
+    r = CppRopeBytes.from_str("héllo")  # é = 2 bytes -> 6 bytes total
+    assert len(r) == 6
+    r.insert(3, "X")  # after the 2-byte é
+    assert r.content() == "héXllo"
+    r.remove(1, 3)  # delete the é (bytes 1..2)
+    assert r.content() == "hXllo"
+
+
+def test_byte_offset_replay_rustcode(rustcode_trace):
+    """Full rustcode replay in byte units (the trace with mid-stream
+    non-ASCII chars, SURVEY.md section 3.4) through the runner's byte path."""
+    from crdt_benches_tpu.backends.native import CppRopeBytes
+    from crdt_benches_tpu.traces.patches import patch_arrays
+
+    pa = patch_arrays(rustcode_trace.chars_to_bytes(), bytes_mode=True)
+    n = CppRopeBytes.replay_patches(pa)
+    assert n == pa.end_len == len(rustcode_trace.end_content.encode("utf-8"))
