@@ -83,6 +83,44 @@ def replay_batches(state: DocState, kind_b, pos_b, slot_b) -> DocState:
     return state
 
 
+@partial(jax.jit, static_argnames=("resolver",), donate_argnums=(0,))
+def replay_batches_r(
+    state: DocState, kind_b, pos_b, slot_b, *, resolver: str = "scan"
+) -> DocState:
+    """Replica-batched replay: state leaves carry a leading replica axis R.
+
+    ``resolver`` picks the sequential-resolution implementation:
+    - ``"scan"``: the lax.scan token-list resolver (ops/resolve.py), vmapped
+      over replicas — portable, used on CPU.
+    - ``"pallas"``: the fused TPU kernel (ops/resolve_pallas.py) — one kernel
+      launch per op batch with replicas on the VPU sublane axis, avoiding the
+      per-op dispatch overhead that makes the scan resolver ~1000x slower
+      than its arithmetic on TPU.
+    Apply stays XLA either way (wide vectorized scatters, vmapped over R).
+    """
+    if resolver == "pallas":
+        from ..ops.resolve_pallas import resolve_batch_pallas
+
+        def resolve_r(kind, pos, nvis):
+            return resolve_batch_pallas(kind, pos, nvis)
+
+    else:
+
+        def resolve_r(kind, pos, nvis):
+            return jax.vmap(resolve_batch, in_axes=(None, None, 0))(
+                kind, pos, nvis
+            )
+
+    def step(st, batch):
+        kind, pos, slot = batch
+        resolved = resolve_r(kind, pos, st.nvis)
+        st = jax.vmap(apply_batch, in_axes=(0, 0, None))(st, resolved, slot)
+        return st, None
+
+    state, _ = jax.lax.scan(step, state, (kind_b, pos_b, slot_b))
+    return state
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def replay_batches_collect(state: DocState, kind_b, pos_b, slot_b):
     """Like :func:`replay_batches` but also stacks each op's tombstoned slot:
@@ -99,46 +137,90 @@ def replay_batches_collect(state: DocState, kind_b, pos_b, slot_b):
     return jax.lax.scan(step, state, (kind_b, pos_b, slot_b))
 
 
+def default_resolver() -> str:
+    """'pallas' on TPU, 'scan' elsewhere; override with CRDT_ENGINE_RESOLVER."""
+    import os
+
+    r = os.environ.get("CRDT_ENGINE_RESOLVER", "auto")
+    if r != "auto":
+        return r
+    return "pallas" if jax.default_backend() == "tpu" else "scan"
+
+
 class ReplayEngine:
     """Host-side driver for replaying one tensorized trace on-device.
 
-    ``n_replicas > 1`` vmaps the whole replay over a replica axis — every
+    ``n_replicas > 1`` batches the whole replay over a replica axis — every
     replica carries and computes its own full state (the honest equivalent of
     running the reference's single-threaded loop N times in parallel).  Use
     ``parallel/`` for sharding replicas across a device mesh.
+
+    The op stream is replayed in host-level chunks of ``chunk`` batches per
+    device call (donated state between calls) so a single device execution
+    stays bounded regardless of trace length.
     """
 
-    def __init__(self, tt: TensorizedTrace, n_replicas: int = 1, lane: int = 128):
+    def __init__(
+        self,
+        tt: TensorizedTrace,
+        n_replicas: int = 1,
+        lane: int = 128,
+        resolver: str | None = None,
+        chunk: int = 32,
+    ):
+        import os
+
         self.tt = tt
         self.n_replicas = n_replicas
         self.capacity = _round_up(max(tt.capacity, 1), lane)
         self.n_init = len(tt.init_chars)
+        self.resolver = resolver or default_resolver()
+        self.chunk = int(os.environ.get("CRDT_ENGINE_CHUNK", str(chunk)))
 
         kind_b, pos_b, _, slot_b = tt.batched()
+        # Pre-slice chunks once so the timed replay loop does no host-side
+        # array work — just one replay_batches_r dispatch per chunk.
+        self.chunks = [
+            (
+                jnp.asarray(kind_b[i : i + self.chunk]),
+                jnp.asarray(pos_b[i : i + self.chunk]),
+                jnp.asarray(slot_b[i : i + self.chunk]),
+            )
+            for i in range(0, tt.n_batches, self.chunk)
+        ]
         self.kind_b = jnp.asarray(kind_b)
         self.pos_b = jnp.asarray(pos_b)
         self.slot_b = jnp.asarray(slot_b)
 
         self.chars = jnp.asarray(slot_char_table(tt, self.capacity))
 
-        if n_replicas == 1:
-            self._replay = replay_batches
-        else:
-            self._replay = jax.jit(
-                jax.vmap(replay_batches, in_axes=(0, None, None, None)),
-                donate_argnums=(0,),
-            )
-
     def fresh_state(self) -> DocState:
         return broadcast_replicas(
             init_state(self.capacity, self.n_init), self.n_replicas
         )
 
+    def _fresh_r(self) -> DocState:
+        """R-leading state (leading axis present even for R=1)."""
+        st = init_state(self.capacity, self.n_init)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.n_replicas,) + jnp.shape(x)),
+            st,
+        )
+
     def run(self, state: DocState | None = None) -> DocState:
-        """Replay the full trace; returns final state (device)."""
+        """Replay the full trace; returns final state (device).  Input and
+        output follow the fresh_state convention (no leading axis at R=1)."""
         if state is None:
-            state = self.fresh_state()
-        return self._replay(state, self.kind_b, self.pos_b, self.slot_b)
+            st = self._fresh_r()
+        elif self.n_replicas == 1:
+            st = jax.tree.map(lambda x: x[None], state)
+        else:
+            st = state
+        for kind, pos, slot in self.chunks:
+            st = replay_batches_r(st, kind, pos, slot, resolver=self.resolver)
+        if self.n_replicas == 1:
+            st = jax.tree.map(lambda x: x[0], st)
+        return st
 
     def run_blocking(self) -> DocState:
         state = self.run()

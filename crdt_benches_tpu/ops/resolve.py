@@ -49,6 +49,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..traces.tensorize import DELETE, INSERT
 
@@ -57,7 +58,10 @@ FREE, RUN, TINS, TDEAD = 0, 1, 2, 3
 
 #: Origin codes >= ORIGIN_BATCH refer to batch op indices.
 ORIGIN_BATCH = 1 << 24
-_BIG = jnp.int32(1 << 30)
+#: Host-side constant on purpose: a module-scope *device* scalar (jnp.int32)
+#: would be captured by every jit as a committed buffer, which on the axon
+#: TPU tunnel forces a ~12ms slow dispatch path per executable launch.
+_BIG = np.int32(1 << 30)
 
 
 class ResolvedBatch(NamedTuple):
@@ -176,7 +180,22 @@ def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBat
         step, (ttype0, ta0, tlen0), ops
     )
 
-    # ---- post-scan extraction (vectorized over the token list) ----
+    ins_gvis, ins_seq, ins_alive = extract_from_tokens(ttype, ta, tlen, v0, B)
+    return ResolvedBatch(
+        del_rank=del_rank,
+        ins_gvis=ins_gvis,
+        ins_seq=ins_seq,
+        ins_alive=ins_alive,
+        origin=origin,
+        del_batch=del_batch,
+    )
+
+
+def extract_from_tokens(ttype, ta, tlen, v0, B: int):
+    """Post-scan extraction, vectorized over the final token list: per-insert
+    gap rank (``ins_gvis``), same-gap tie-break (``ins_seq``), and liveness
+    (``ins_alive``).  Shared by the lax.scan resolver above and the fused
+    Pallas resolver (ops/resolve_pallas.py)."""
     is_instok = (ttype == TINS) | (ttype == TDEAD)
     # First surviving pre-batch char after each token: suffix-min of run starts.
     run_start = jnp.where((ttype == RUN) & (tlen > 0), ta, _BIG)
@@ -207,12 +226,4 @@ def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBat
         .at[opidx]
         .set(ttype == TINS, mode="drop")
     )
-
-    return ResolvedBatch(
-        del_rank=del_rank,
-        ins_gvis=ins_gvis,
-        ins_seq=ins_seq,
-        ins_alive=ins_alive,
-        origin=origin,
-        del_batch=del_batch,
-    )
+    return ins_gvis, ins_seq, ins_alive

@@ -1,0 +1,308 @@
+"""Fused TPU resolver — the whole per-batch op loop as ONE Pallas kernel.
+
+Why this exists: the reference resolver (ops/resolve.py) runs the sequential
+per-op token-list update as a ``lax.scan`` whose body compiles to dozens of
+tiny HLO ops.  On TPU every scan iteration then pays dispatch/sequencer
+overhead for work that touches a few KB — measured ~240us per unit op, i.e.
+the hot loop of the reference (src/main.rs:30-34) re-created with a ~1000x
+constant factor.  This kernel keeps the *same algorithm* but runs the entire
+B-op loop inside one ``pl.pallas_call``: the token list lives in
+VMEM/registers as ``(Rt, T)`` tiles (replicas on sublanes, tokens on lanes),
+each op is a handful of VPU passes, and the only HBM traffic is the batch's
+inputs and outputs.
+
+Representation change vs the scan resolver: the token list is stored
+**cum-primary** — ``(ttype, ta, cum)`` where ``cum[i]`` is the inclusive
+prefix sum of token lengths — so no O(T·logT) cumsum is needed per op; the
+prefix array is maintained incrementally by the same shift/place update that
+maintains the token arrays (total document length changes by ±1 per op).
+``tlen`` is reconstructed once at the end for the shared post-extraction
+(ops/resolve.py ``extract_from_tokens``).
+
+The kernel is replica-batched: ``v0`` is int32[R] (one visible-length per
+replica), token state is (Rt, T) per grid step, and all per-op scalars become
+(Rt, 1) columns — every replica honestly performs its own full resolution
+(the batched equivalent of running the reference's loop R times), it just
+does so on the VPU's sublane axis instead of in R separate programs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..traces.tensorize import DELETE, INSERT
+from .resolve import (
+    FREE,
+    ORIGIN_BATCH,
+    RUN,
+    TDEAD,
+    TINS,
+    ResolvedBatch,
+    extract_from_tokens,
+)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _roll1(x):
+    """Shift right by 1 along lanes (wrap: lane 0 gets old last lane —
+    callers overwrite or mask every wrapped position)."""
+    return jnp.concatenate([x[:, -1:], x[:, :-1]], axis=1)
+
+
+def _kernel(kind_ref, pos_ref, v0_ref,
+            drank_ref, origin_ref, dbatch_ref,
+            opos_ref, ttype_ref, ta_ref, tlen_ref,
+            *, B: int, T: int, Rt: int):
+    lane_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, T), 1)
+    lane_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    kind_v = kind_ref[:]  # (1, B)
+    pos_v = pos_ref[:]
+    v0 = v0_ref[:]  # (Rt, 1)
+
+    drank_ref[:] = jnp.full((Rt, B), -1, jnp.int32)
+    origin_ref[:] = jnp.full((Rt, B), -2, jnp.int32)
+    dbatch_ref[:] = jnp.full((Rt, B), -1, jnp.int32)
+    # opos[r, j] = final token-list index of op j's token.  Tracked in-kernel
+    # so the host-side extraction can GATHER per-op results from token space
+    # instead of scattering token results into op space — TPU scatters
+    # serialize per row (~19ms/batch measured); gathers vectorize.
+    opos_ref[:] = jnp.zeros((Rt, B), jnp.int32)
+
+    # Initial token list: one RUN(0, v0) then FREE; cum is flat at v0.
+    ttype0 = jnp.where(lane_t == 0, RUN, FREE)
+    ta0 = jnp.zeros((Rt, T), jnp.int32)
+    cum0 = jnp.broadcast_to(v0, (Rt, T))
+    total0 = v0  # (Rt, 1)
+    nused0 = jnp.ones((Rt, 1), jnp.int32)
+
+    def body(j, carry):
+        ttype, ta, cum, total, nused = carry
+        jj = jnp.int32(j)
+        opmask = (lane_b == jj).astype(jnp.int32)
+        k = jnp.sum(kind_v * opmask, axis=1, keepdims=True)  # (1, 1)
+        p0 = jnp.sum(pos_v * opmask, axis=1, keepdims=True)
+
+        is_ins = k == INSERT
+        p = jnp.clip(p0, 0, total)  # (Rt, 1) — per replica
+        is_del = (k == DELETE) & (p < total)
+
+        # Token containing offset p: first index with cum > p, clamped to the
+        # first FREE slot for an at-end insert (cum is flat there).
+        t = jnp.sum((cum <= p).astype(jnp.int32), axis=1, keepdims=True)
+        t = jnp.minimum(t, nused)
+        m_t = lane_t == t
+        m_tm1 = lane_t == (t - 1)
+        c_t = jnp.sum(jnp.where(m_t, cum, 0), axis=1, keepdims=True)
+        pre = jnp.sum(jnp.where(m_tm1, cum, 0), axis=1, keepdims=True)
+        a = jnp.sum(jnp.where(m_t, ta, 0), axis=1, keepdims=True)
+        tt = jnp.sum(jnp.where(m_t, ttype, 0), axis=1, keepdims=True)
+        off = p - pre
+        hit_run = tt == RUN
+        split = is_ins & (off > 0)
+
+        # Replacement of token t by m in {1,2,3} tokens (see ops/resolve.py).
+        m = jnp.where(
+            is_ins,
+            jnp.where(split, 3, 2),
+            jnp.where(is_del, jnp.where(hit_run, 2, 1), 1),
+        )
+        delta = jnp.where(is_ins, 1, 0) - jnp.where(is_del, 1, 0)
+
+        n0t = jnp.where(
+            is_ins,
+            jnp.where(split, RUN, TINS),
+            jnp.where(is_del, jnp.where(hit_run, RUN, TDEAD), tt),
+        )
+        n0a = jnp.where(is_ins & ~split, jj, a)
+        n0c = jnp.where(
+            is_ins,
+            jnp.where(split, p, pre + 1),
+            jnp.where(is_del, jnp.where(hit_run, p, pre), c_t),
+        )
+        n1t = jnp.where(is_ins, jnp.where(split, TINS, tt), RUN)
+        n1a = jnp.where(is_ins, jnp.where(split, jj, a), a + off + 1)
+        n1c = jnp.where(is_ins, jnp.where(split, p + 1, c_t + 1), c_t - 1)
+        n2t, n2a, n2c = jnp.int32(RUN), a + off, c_t + 1
+
+        m2 = m >= 2
+        m3 = m == 3
+
+        def place(x, x0, x1, x2, dlt):
+            r1, r2 = _roll1(x), _roll1(_roll1(x))
+            sh = jnp.where(m == 1, x, jnp.where(m == 2, r1, r2)) + dlt
+            out = jnp.where(lane_t < t, x, sh)
+            out = jnp.where(lane_t == t, x0, out)
+            out = jnp.where(m2 & (lane_t == t + 1), x1, out)
+            out = jnp.where(m3 & (lane_t == t + 2), x2, out)
+            return out
+
+        ttype_n = place(ttype, n0t, n1t, n2t, 0)
+        ta_n = place(ta, n0a, n1a, n2a, 0)
+        cum_n = place(cum, n0c, n1c, n2c, delta)
+
+        # Per-op outputs (column j).
+        del_rank = jnp.where(is_del & hit_run, a + off, -1)
+        del_batch = jnp.where(is_del & (tt == TINS), a, -1)
+        # Origin: char at offset p-1 at op time (token tp contains it; tp is
+        # always a len>0 token — zero-len tokens share their predecessor's
+        # cum, so they can never be the first index with cum > p-1).
+        tp = jnp.sum((cum <= p - 1).astype(jnp.int32), axis=1, keepdims=True)
+        m_tp = lane_t == tp
+        pre_tp = jnp.sum(
+            jnp.where(lane_t == tp - 1, cum, 0), axis=1, keepdims=True
+        )
+        a_tp = jnp.sum(jnp.where(m_tp, ta, 0), axis=1, keepdims=True)
+        tt_tp = jnp.sum(jnp.where(m_tp, ttype, 0), axis=1, keepdims=True)
+        origin_char = jnp.where(
+            tt_tp == RUN, a_tp + (p - 1 - pre_tp), ORIGIN_BATCH + a_tp
+        )
+        origin = jnp.where(is_ins, jnp.where(p == 0, -1, origin_char), -2)
+
+        colm = lane_b == jj
+        drank_ref[:] = jnp.where(colm, del_rank, drank_ref[:])
+        origin_ref[:] = jnp.where(colm, origin, origin_ref[:])
+        dbatch_ref[:] = jnp.where(colm, del_batch, dbatch_ref[:])
+        # Track token positions of earlier ops through this op's shift, then
+        # record this op's own token position (split inserts land at t+1).
+        shifted_opos = opos_ref[:] + (opos_ref[:] >= t).astype(jnp.int32) * (
+            m - 1
+        )
+        opos_ref[:] = jnp.where(colm, jnp.where(split, t + 1, t), shifted_opos)
+
+        return ttype_n, ta_n, cum_n, total + delta, nused + (m - 1)
+
+    ttype, ta, cum, _, _ = jax.lax.fori_loop(
+        0, B, body, (ttype0, ta0, cum0, total0, nused0)
+    )
+    ttype_ref[:] = ttype
+    ta_ref[:] = ta
+    tlen_ref[:] = cum - jnp.where(lane_t == 0, 0, _roll1(cum))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("replica_tile", "interpret")
+)
+def resolve_batch_pallas(
+    kind: jax.Array,
+    pos: jax.Array,
+    v0: jax.Array,
+    *,
+    replica_tile: int = 8,
+    interpret: bool = False,
+) -> ResolvedBatch:
+    """Resolve one op batch for R replicas in one fused kernel.
+
+    ``kind``/``pos``: int32[B] (shared op stream); ``v0``: int32[R] per-replica
+    visible lengths.  Returns a ResolvedBatch whose leaves are (R, B).
+    """
+    B = kind.shape[0]
+    R = v0.shape[0]
+    Rt = replica_tile
+    while R % Rt:
+        Rt //= 2
+    T = _round_up(2 * B + 2, 128)
+
+    kernel = functools.partial(_kernel, B=B, T=T, Rt=Rt)
+    out = pl.pallas_call(
+        kernel,
+        grid=(R // Rt,),
+        in_specs=[
+            pl.BlockSpec((1, B), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((Rt, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, B), jnp.int32),  # del_rank
+            jax.ShapeDtypeStruct((R, B), jnp.int32),  # origin
+            jax.ShapeDtypeStruct((R, B), jnp.int32),  # del_batch
+            jax.ShapeDtypeStruct((R, B), jnp.int32),  # opos
+            jax.ShapeDtypeStruct((R, T), jnp.int32),  # ttype
+            jax.ShapeDtypeStruct((R, T), jnp.int32),  # ta
+            jax.ShapeDtypeStruct((R, T), jnp.int32),  # tlen
+        ],
+        interpret=interpret,
+    )(
+        kind.reshape(1, B).astype(jnp.int32),
+        pos.reshape(1, B).astype(jnp.int32),
+        v0.reshape(R, 1).astype(jnp.int32),
+    )
+    del_rank, origin, del_batch, opos, ttype, ta, tlen = out
+
+    ins_gvis, ins_seq, ins_alive = _extract_gather(
+        ttype, ta, tlen, v0, opos, origin
+    )
+    return ResolvedBatch(
+        del_rank=del_rank,
+        ins_gvis=ins_gvis,
+        ins_seq=ins_seq,
+        ins_alive=ins_alive,
+        origin=origin,
+        del_batch=del_batch,
+    )
+
+
+def _extract_gather(ttype, ta, tlen, v0, opos, origin):
+    """Scatter-free post-extraction: same results as
+    ``resolve.extract_from_tokens`` but per-op values are GATHERED from token
+    space at the kernel-tracked per-op token positions (TPU scatters
+    serialize per row; gathers vectorize).  All args replica-batched:
+    ttype/ta/tlen int32[R, T], v0 int32[R], opos/origin int32[R, B].
+    """
+    R, T = ttype.shape
+    big = np.int32(1 << 30)
+    is_instok = (ttype == TINS) | (ttype == TDEAD)
+    # Per token: rank of the first surviving pre-batch char to its right.
+    run_start = jnp.where((ttype == RUN) & (tlen > 0), ta, big)
+    suff = jax.lax.cummin(run_start, axis=1, reverse=True)
+    nxt = jnp.concatenate(
+        [suff[:, 1:], jnp.full((R, 1), big, jnp.int32)], axis=1
+    )
+    gvis_tok = jnp.where(nxt >= big, v0[:, None], nxt)
+
+    # Tie-break rank among instok tokens sharing a gap (same-gap instok
+    # tokens are contiguous up to zero-length RUN remnants, which cummax
+    # skips — see resolve.extract_from_tokens).
+    tpos = jax.lax.broadcasted_iota(jnp.int32, (R, T), 1)
+    ci = jnp.cumsum(is_instok.astype(jnp.int32), axis=1)
+    prev_ipos = jax.lax.cummax(jnp.where(is_instok, tpos, -1), axis=1)
+    prev_ipos = jnp.concatenate(
+        [jnp.full((R, 1), -1, jnp.int32), prev_ipos[:, :-1]], axis=1
+    )
+    prev_gvis = jnp.where(
+        prev_ipos >= 0,
+        jnp.take_along_axis(gvis_tok, jnp.clip(prev_ipos, 0), axis=1),
+        -1,
+    )
+    boundary = is_instok & ((prev_ipos < 0) | (prev_gvis != gvis_tok))
+    base = jnp.where(boundary, ci - 1, -1)
+    seq_tok = ci - 1 - jax.lax.cummax(base, axis=1)
+
+    # Per-op gathers at the tracked token positions.
+    is_ins_op = origin != -2  # origin is -2 exactly for non-insert ops
+    at = jnp.clip(opos, 0, T - 1)
+    g = jnp.take_along_axis(gvis_tok, at, axis=1)
+    s = jnp.take_along_axis(seq_tok, at, axis=1)
+    tt_at = jnp.take_along_axis(ttype, at, axis=1)
+    return (
+        jnp.where(is_ins_op, g, -1),
+        jnp.where(is_ins_op, s, 0),
+        is_ins_op & (tt_at == TINS),
+    )

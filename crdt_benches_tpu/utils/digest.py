@@ -15,8 +15,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_MIX = jnp.int32(-1640531527)  # 2654435761 as int32 (Knuth multiplicative)
+_MIX = np.int32(-1640531527)  # 2654435761 as int32 (Knuth multiplicative)
 
 
 def doc_digest(order: jax.Array, visible: jax.Array, length: jax.Array,
