@@ -121,6 +121,49 @@ def replay_batches_r(
     return state
 
 
+def _make_resolver(resolver: str):
+    if resolver == "pallas":
+        from ..ops.resolve_pallas import resolve_batch_pallas
+
+        return lambda kind, pos, nvis: resolve_batch_pallas(kind, pos, nvis)
+    return lambda kind, pos, nvis: jax.vmap(
+        resolve_batch, in_axes=(None, None, 0)
+    )(kind, pos, nvis)
+
+
+@partial(jax.jit, static_argnames=("resolver", "pack"), donate_argnums=(0,))
+def replay_batches_r2(
+    state, kind_b, pos_b, slot_b, *, resolver: str = "scan", pack: int = 4
+):
+    """Replay on the scatter-free doc-order state (ops/apply2.py).
+
+    ``pack`` batches are applied per scan step (python-unrolled) to amortize
+    the fixed per-scan-iteration cost (~1.8ms on the TPU runtime in use)
+    over more work.  NB must be a multiple of ``pack`` (pad with PAD
+    batches — they are no-ops end to end).
+    """
+    from ..ops.apply2 import apply_batch2
+
+    resolve_r = _make_resolver(resolver)
+    NB, B = kind_b.shape
+    K = min(pack, NB)
+    if NB % K:
+        raise ValueError(f"batch count {NB} not a multiple of pack {K}")
+    rs = lambda x: x.reshape(NB // K, K, B)
+
+    def step(st, batch):
+        k, p, sl = batch
+        for i in range(K):
+            resolved = resolve_r(k[i], p[i], st.nvis)
+            st = apply_batch2(st, resolved, sl[i])
+        return st, None
+
+    state, _ = jax.lax.scan(
+        step, state, (rs(kind_b), rs(pos_b), rs(slot_b))
+    )
+    return state
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def replay_batches_collect(state: DocState, kind_b, pos_b, slot_b):
     """Like :func:`replay_batches` but also stacks each op's tombstoned slot:
@@ -167,6 +210,8 @@ class ReplayEngine:
         lane: int = 128,
         resolver: str | None = None,
         chunk: int = 32,
+        engine: str | None = None,
+        pack: int = 4,
     ):
         import os
 
@@ -176,17 +221,32 @@ class ReplayEngine:
         self.n_init = len(tt.init_chars)
         self.resolver = resolver or default_resolver()
         self.chunk = int(os.environ.get("CRDT_ENGINE_CHUNK", str(chunk)))
+        #: 'v2' = scatter-free doc-order apply (ops/apply2.py, the fast
+        #: path); 'v1' = the original slot-indexed apply (ops/apply.py).
+        self.engine = engine or os.environ.get("CRDT_ENGINE_APPLY", "v2")
+        self.pack = int(os.environ.get("CRDT_ENGINE_PACK", str(pack)))
+        if self.chunk % self.pack:
+            self.chunk = _round_up(self.chunk, self.pack)
 
         kind_b, pos_b, _, slot_b = tt.batched()
+        if self.engine == "v2":
+            # Pad the batch count to a multiple of `pack` with PAD batches
+            # (no-ops end to end) so every scan step carries `pack` batches.
+            n_pad = (-tt.n_batches) % self.pack
+            if n_pad:
+                z = np.zeros((n_pad, tt.batch), np.int32)
+                kind_b = np.concatenate([kind_b, z])
+                pos_b = np.concatenate([pos_b, z])
+                slot_b = np.concatenate([slot_b, z - 1])
         # Pre-slice chunks once so the timed replay loop does no host-side
-        # array work — just one replay_batches_r dispatch per chunk.
+        # array work — just one replay dispatch per chunk.
         self.chunks = [
             (
                 jnp.asarray(kind_b[i : i + self.chunk]),
                 jnp.asarray(pos_b[i : i + self.chunk]),
                 jnp.asarray(slot_b[i : i + self.chunk]),
             )
-            for i in range(0, tt.n_batches, self.chunk)
+            for i in range(0, len(kind_b), self.chunk)
         ]
         self.kind_b = jnp.asarray(kind_b)
         self.pos_b = jnp.asarray(pos_b)
@@ -207,9 +267,27 @@ class ReplayEngine:
             st,
         )
 
-    def run(self, state: DocState | None = None) -> DocState:
-        """Replay the full trace; returns final state (device).  Input and
-        output follow the fresh_state convention (no leading axis at R=1)."""
+    def run(self, state=None):
+        """Replay the full trace; returns final state (device).
+
+        engine 'v2': returns a replica-batched ReplayState (leading R axis).
+        engine 'v1': DocState following the fresh_state convention (no
+        leading axis at R=1).
+        """
+        if self.engine == "v2":
+            from ..ops.apply2 import init_state2
+
+            st = (
+                init_state2(self.n_replicas, self.capacity, self.n_init)
+                if state is None
+                else state
+            )
+            for kind, pos, slot in self.chunks:
+                st = replay_batches_r2(
+                    st, kind, pos, slot,
+                    resolver=self.resolver, pack=self.pack,
+                )
+            return st
         if state is None:
             st = self._fresh_r()
         elif self.n_replicas == 1:
@@ -229,8 +307,15 @@ class ReplayEngine:
 
     # ---- decode / checks -------------------------------------------------
 
-    def decode(self, state: DocState, replica: int = 0) -> str:
+    def decode(self, state, replica: int = 0) -> str:
         """Materialize a replica's visible document as a Python string."""
+        from ..ops.apply2 import ReplayState, decode_state2
+
+        if isinstance(state, ReplayState):
+            codes, nvis = jax.jit(decode_state2, static_argnames=("replica",))(
+                state, self.chars, replica=replica
+            )
+            return "".join(map(chr, np.asarray(codes)[: int(nvis)].tolist()))
         return decode_to_str(
             select_replica(state, replica, self.n_replicas), self.chars
         )

@@ -196,7 +196,7 @@ def resolve_batch_pallas(
     pos: jax.Array,
     v0: jax.Array,
     *,
-    replica_tile: int = 8,
+    replica_tile: int = 32,
     interpret: bool = False,
 ) -> ResolvedBatch:
     """Resolve one op batch for R replicas in one fused kernel.
