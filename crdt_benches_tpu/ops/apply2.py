@@ -124,6 +124,9 @@ def apply_batch2(
     resolved leaves are (R, B); ``slots`` int32[B] preassigned slot ids for
     insert ops (shared across replicas).  Same semantics as
     ops/apply.py apply_batch, without slot-indexed state or big scatters.
+    All row-wise scatters are ADDs (scatter-set serializes per row on the
+    TPU runtime; add vectorizes): deletes subtract from a guaranteed-1 vis
+    bit, and insert fills add into holes the expansion zeroed.
     """
     R, C = state.order.shape
     B = slots.shape[0]
@@ -134,10 +137,14 @@ def apply_batch2(
     cumvis = jnp.cumsum(state.vis * valid, axis=1)
 
     # ---- deletes of pre-batch chars: rank -> doc position, clear vis ----
+    # Targets are distinct visible chars (the resolver tombstones each char
+    # at most once per batch), so add(-1) on a 1-bit is an exact clear.
     dr = resolved.del_rank
     has_del = dr >= 0
     dphys = rank_to_phys2(cumvis, jnp.where(has_del, dr, 0))
-    vis = _scatter_rows(state.vis, jnp.where(has_del, dphys, drop), 0, C)
+    vis = _scatter_rows(
+        state.vis, jnp.where(has_del, dphys, drop), -1, C, add=True
+    )
 
     # ---- insert destinations ----
     is_ins = resolved.ins_gvis >= 0
@@ -158,12 +165,22 @@ def apply_batch2(
     ind = _scatter_rows(jnp.zeros((R, C), jnp.int32), dest, 1, C, add=True)
     cnt = jnp.cumsum(ind, axis=1)  # r(d): monotone, 1-Lipschitz
     nbits = max(1, (B).bit_length())
-    order, vis = _expand([state.order, vis], cnt, nbits)
+    if jax.default_backend() == "tpu":
+        from .expand_pallas import expand_fill_zero
 
-    # ---- fill the holes with the batch inserts ----
+        order, vis = expand_fill_zero(state.order, vis, cnt, ind, nbits=nbits)
+    else:
+        order, vis = _expand([state.order, vis], cnt, nbits)
+        hole = ind != 0
+        order = jnp.where(hole, 0, order)
+        vis = jnp.where(hole, 0, vis)
+
+    # ---- fill the zeroed holes with the batch inserts (adds) ----
     slots_b = jnp.broadcast_to(slots[None, :], (R, B))
-    order = _scatter_rows(order, dest, slots_b, C)
-    vis = _scatter_rows(vis, dest, resolved.ins_alive.astype(jnp.int32), C)
+    order = _scatter_rows(order, dest, slots_b, C, add=True)
+    vis = _scatter_rows(
+        vis, dest, resolved.ins_alive.astype(jnp.int32), C, add=True
+    )
 
     n_ins = jnp.sum(is_ins.astype(jnp.int32), axis=1)
     n_live = jnp.sum((is_ins & resolved.ins_alive).astype(jnp.int32), axis=1)
@@ -191,6 +208,161 @@ def _scatter_rows(arr, idx, val, C, add: bool = False):
     return jax.vmap(lambda a, i, v: a.at[i].set(v, mode="drop"))(
         arr, idx, val
     )
+
+
+class PackedState(NamedTuple):
+    """Packed doc-order state: one int32 per position.
+
+    ``doc = ((order + 2) << 1) | vis`` — the slot id (order, -1 for unused)
+    and the visibility bit travel as a single array, halving HBM traffic and
+    VMEM footprint everywhere in the hot path.  The packing survives the two
+    mutation kinds directly: a delete is ``add(-1)`` (clears a guaranteed-1
+    vis bit), an insert fill is ``add(packed value)`` into a zeroed hole.
+    """
+
+    doc: jax.Array  # int32[R, C]
+    length: jax.Array  # int32[R]
+    nvis: jax.Array  # int32[R]
+
+
+def pack_doc(order, vis):
+    return jnp.left_shift(order + 2, 1) | vis
+
+
+def unpack_doc(doc):
+    return jnp.right_shift(doc, 1) - 2, jnp.bitwise_and(doc, 1)
+
+
+def init_state3(n_replicas: int, capacity: int, n_init: int = 0) -> PackedState:
+    s2 = init_state2(n_replicas, capacity, n_init)
+    return PackedState(
+        doc=pack_doc(s2.order, s2.vis), length=s2.length, nvis=s2.nvis
+    )
+
+
+def _mxu_spread(idx, vals_7bit_chunks, C: int):
+    """Batched scatter-add via one-hot MXU matmuls: returns, for each 7-bit
+    chunk array v in ``vals_7bit_chunks`` (each int32[R, B] with values in
+    [0, 127]), the dense int32[R, C] array with v[r, b] added at position
+    idx[r, b].  Indices must be distinct per row (out-of-range = dropped);
+    then every output cell receives at most one contribution, so the bf16
+    matmuls are exact.  On this TPU runtime a row-wise scatter-add costs
+    ~53ns/row (serialized); the matmul form runs on the MXU at
+    R*B*nt*128 MACs per chunk (~0.2ms at R=256, C=182k)."""
+    R, B = idx.shape
+    nt = C // LANE
+    tq = jnp.right_shift(idx, 7)  # idx // 128
+    lq = jnp.bitwise_and(idx, 127)
+    in_range = (idx >= 0) & (idx < C)
+    oh_tile = (
+        (jax.lax.broadcasted_iota(jnp.int32, (R, B, nt), 2) == tq[:, :, None])
+        & in_range[:, :, None]
+    ).astype(jnp.bfloat16)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, B, LANE), 2)
+    oh_lane = (lane_iota == lq[:, :, None]).astype(jnp.bfloat16)
+    outs = []
+    for v in vals_7bit_chunks:
+        vb = oh_lane * v[:, :, None].astype(jnp.bfloat16)
+        dense = jnp.einsum(
+            "rbt,rbl->rtl", oh_tile, vb, preferred_element_type=jnp.float32
+        )
+        outs.append(dense.astype(jnp.int32).reshape(R, C))
+    return outs
+
+
+def apply_batch3(
+    state: PackedState, resolved: ResolvedBatch, slots: jax.Array
+) -> PackedState:
+    """apply_batch2 on the packed representation (see PackedState).
+
+    All three B-row scatters of the v2 formulation are eliminated: delete
+    clears, the insert-destination indicator, and the insert fills are
+    spread to dense (R, C) arrays with exact one-hot MXU matmuls
+    (_mxu_spread) and combined with vector adds.
+    """
+    R, C = state.doc.shape
+    B = slots.shape[0]
+    drop = jnp.int32(C + 7)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    valid = col < state.length[:, None]
+
+    vis_bit = jnp.bitwise_and(state.doc, 1)
+    cumvis = jnp.cumsum(vis_bit * valid, axis=1)
+
+    dr = resolved.del_rank
+    has_del = dr >= 0
+    dphys = jnp.where(
+        has_del, rank_to_phys2(cumvis, jnp.where(has_del, dr, 0)), drop
+    )
+
+    is_ins = resolved.ins_gvis >= 0
+    gv = resolved.ins_gvis
+    g_phys = jnp.where(
+        gv >= state.nvis[:, None],
+        state.length[:, None],
+        rank_to_phys2(cumvis, jnp.where(is_ins, gv, 0)),
+    )
+    g_phys = jnp.where(is_ins, g_phys, drop)
+    smaller = (g_phys[:, :, None] > g_phys[:, None, :]) & is_ins[:, None, :]
+    n_before = jnp.sum(smaller.astype(jnp.int32), axis=2)
+    dest = jnp.where(is_ins, g_phys + n_before + resolved.ins_seq, drop)
+
+    # Deletes: subtract a 0/1 indicator (each target has vis bit 1).
+    (del_ind,) = _mxu_spread(dphys, [has_del.astype(jnp.int32)], C)
+    doc = state.doc - del_ind
+
+    # Insert destinations: indicator + packed fill values in 7-bit chunks,
+    # all from the same one-hot pair.
+    slots_b = jnp.broadcast_to(slots[None, :], (R, B))
+    fill = jnp.where(
+        is_ins, pack_doc(slots_b, resolved.ins_alive.astype(jnp.int32)), 0
+    )
+    chunks = [
+        is_ins.astype(jnp.int32),
+        jnp.bitwise_and(fill, 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 7), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 14), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 21), 127),
+    ]
+    ind, f0, f1, f2, f3 = _mxu_spread(dest, chunks, C)
+    fill_dense = (
+        f0
+        + jnp.left_shift(f1, 7)
+        + jnp.left_shift(f2, 14)
+        + jnp.left_shift(f3, 21)
+    )
+
+    cnt = jnp.cumsum(ind, axis=1)
+    nbits = max(1, (B).bit_length())
+    cntind = jnp.left_shift(cnt, 1) | ind
+    if jax.default_backend() == "tpu":
+        from .expand_pallas import expand_packed
+
+        doc = expand_packed(doc, cntind, nbits=nbits)
+    else:
+        (doc,) = _expand([doc], cnt, nbits)
+        doc = jnp.where(ind != 0, 0, doc)
+
+    doc = doc + fill_dense
+
+    n_ins = jnp.sum(is_ins.astype(jnp.int32), axis=1)
+    n_live = jnp.sum((is_ins & resolved.ins_alive).astype(jnp.int32), axis=1)
+    n_del = jnp.sum(has_del.astype(jnp.int32), axis=1)
+    length = state.length + n_ins
+    beyond = col >= length[:, None]
+    return PackedState(
+        doc=jnp.where(beyond, pack_doc(-1, 0), doc),
+        length=length,
+        nvis=state.nvis - n_del + n_live,
+    )
+
+
+def decode_state3(state: PackedState, chars: jax.Array, replica: int = 0):
+    order, vis = unpack_doc(state.doc)
+    s2 = ReplayState(
+        order=order, vis=vis, length=state.length, nvis=state.nvis
+    )
+    return decode_state2(s2, chars, replica)
 
 
 def decode_state2(state: ReplayState, chars: jax.Array, replica: int = 0):

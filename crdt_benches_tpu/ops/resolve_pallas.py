@@ -61,7 +61,7 @@ def _roll1(x):
 def _kernel(kind_ref, pos_ref, v0_ref,
             drank_ref, origin_ref, dbatch_ref,
             opos_ref, ttype_ref, ta_ref, tlen_ref,
-            *, B: int, T: int, Rt: int):
+            *, B: int, T: int, Rt: int, emit_origin: bool = True):
     lane_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, T), 1)
     lane_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
     kind_v = kind_ref[:]  # (1, B)
@@ -152,20 +152,30 @@ def _kernel(kind_ref, pos_ref, v0_ref,
         # Per-op outputs (column j).
         del_rank = jnp.where(is_del & hit_run, a + off, -1)
         del_batch = jnp.where(is_del & (tt == TINS), a, -1)
-        # Origin: char at offset p-1 at op time (token tp contains it; tp is
-        # always a len>0 token — zero-len tokens share their predecessor's
-        # cum, so they can never be the first index with cum > p-1).
-        tp = jnp.sum((cum <= p - 1).astype(jnp.int32), axis=1, keepdims=True)
-        m_tp = lane_t == tp
-        pre_tp = jnp.sum(
-            jnp.where(lane_t == tp - 1, cum, 0), axis=1, keepdims=True
-        )
-        a_tp = jnp.sum(jnp.where(m_tp, ta, 0), axis=1, keepdims=True)
-        tt_tp = jnp.sum(jnp.where(m_tp, ttype, 0), axis=1, keepdims=True)
-        origin_char = jnp.where(
-            tt_tp == RUN, a_tp + (p - 1 - pre_tp), ORIGIN_BATCH + a_tp
-        )
-        origin = jnp.where(is_ins, jnp.where(p == 0, -1, origin_char), -2)
+        if emit_origin:
+            # Origin: char at offset p-1 at op time (token tp contains it;
+            # tp is always a len>0 token — zero-len tokens share their
+            # predecessor's cum, so they can never be the first index with
+            # cum > p-1).
+            tp = jnp.sum(
+                (cum <= p - 1).astype(jnp.int32), axis=1, keepdims=True
+            )
+            m_tp = lane_t == tp
+            pre_tp = jnp.sum(
+                jnp.where(lane_t == tp - 1, cum, 0), axis=1, keepdims=True
+            )
+            a_tp = jnp.sum(jnp.where(m_tp, ta, 0), axis=1, keepdims=True)
+            tt_tp = jnp.sum(jnp.where(m_tp, ttype, 0), axis=1, keepdims=True)
+            origin_char = jnp.where(
+                tt_tp == RUN, a_tp + (p - 1 - pre_tp), ORIGIN_BATCH + a_tp
+            )
+            origin = jnp.where(
+                is_ins, jnp.where(p == 0, -1, origin_char), -2
+            )
+        else:
+            # Upstream replay needs only the insert/non-insert distinction
+            # downstream of the extraction (-2 = non-insert).
+            origin = jnp.where(is_ins, -1, -2)
 
         colm = lane_b == jj
         drank_ref[:] = jnp.where(colm, del_rank, drank_ref[:])
@@ -189,7 +199,7 @@ def _kernel(kind_ref, pos_ref, v0_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("replica_tile", "interpret")
+    jax.jit, static_argnames=("replica_tile", "interpret", "emit_origin")
 )
 def resolve_batch_pallas(
     kind: jax.Array,
@@ -198,6 +208,7 @@ def resolve_batch_pallas(
     *,
     replica_tile: int = 32,
     interpret: bool = False,
+    emit_origin: bool = True,
 ) -> ResolvedBatch:
     """Resolve one op batch for R replicas in one fused kernel.
 
@@ -211,7 +222,9 @@ def resolve_batch_pallas(
         Rt //= 2
     T = _round_up(2 * B + 2, 128)
 
-    kernel = functools.partial(_kernel, B=B, T=T, Rt=Rt)
+    kernel = functools.partial(
+        _kernel, B=B, T=T, Rt=Rt, emit_origin=emit_origin
+    )
     out = pl.pallas_call(
         kernel,
         grid=(R // Rt,),

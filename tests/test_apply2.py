@@ -96,3 +96,39 @@ def test_v2_pack_invariance():
         )
         outs.append(eng.decode(eng.run()))
     assert outs[0] == outs[1] == outs[2] == _oracle_replay(trace)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_expand_pallas_kernel_matches_xla(seed):
+    from crdt_benches_tpu.ops.expand_pallas import expand_fill_zero
+
+    rng = np.random.default_rng(seed)
+    R, C, B = 2, 384, 25
+    order = rng.integers(0, 1000, size=(R, C)).astype(np.int32)
+    vis = rng.integers(0, 2, size=(R, C)).astype(np.int32)
+    ind = np.zeros((R, C), np.int32)
+    for row in range(R):
+        ind[row, rng.choice(C, size=B, replace=False)] = 1
+    cnt = np.cumsum(ind, axis=1).astype(np.int32)
+    o1, v1 = expand_fill_zero(
+        jnp.asarray(order), jnp.asarray(vis), jnp.asarray(cnt),
+        jnp.asarray(ind), nbits=6, interpret=True,
+    )
+    o2, v2 = _expand([jnp.asarray(order), jnp.asarray(vis)],
+                     jnp.asarray(cnt), 6)
+    hole = ind != 0
+    np.testing.assert_array_equal(np.asarray(o1), np.where(hole, 0, np.asarray(o2)))
+    np.testing.assert_array_equal(np.asarray(v1), np.where(hole, 0, np.asarray(v2)))
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_v3_packed_matches_v2_and_oracle(seed):
+    trace = synth_trace(seed=seed, n_ops=350, base="packed state v3 ")
+    tt = tensorize(trace, batch=32)
+    e2 = ReplayEngine(tt, n_replicas=2, resolver="scan", engine="v2")
+    e3 = ReplayEngine(tt, n_replicas=2, resolver="scan", engine="v3")
+    want = _oracle_replay(trace)
+    assert e2.decode(e2.run()) == want
+    st3 = e3.run()
+    assert e3.decode(st3, replica=0) == want
+    assert e3.decode(st3, replica=1) == want
