@@ -20,7 +20,7 @@ from .base import BatchedReplay
 
 
 class JaxReplayBackend(BatchedReplay):
-    def __init__(self, n_replicas: int = 1, batch: int = 256):
+    def __init__(self, n_replicas: int = 1, batch: int = 512):
         self.n_replicas = n_replicas
         self.batch = batch
         self._eng: ReplayEngine | None = None

@@ -254,7 +254,7 @@ class ReplayEngine:
         self.chunk = int(os.environ.get("CRDT_ENGINE_CHUNK", str(chunk)))
         #: 'v2' = scatter-free doc-order apply (ops/apply2.py, the fast
         #: path); 'v1' = the original slot-indexed apply (ops/apply.py).
-        self.engine = engine or os.environ.get("CRDT_ENGINE_APPLY", "v2")
+        self.engine = engine or os.environ.get("CRDT_ENGINE_APPLY", "v3")
         self.pack = int(os.environ.get("CRDT_ENGINE_PACK", str(pack)))
         if self.chunk % self.pack:
             self.chunk = _round_up(self.chunk, self.pack)
