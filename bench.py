@@ -74,7 +74,7 @@ def main() -> int:
         )
         jax.config.update("jax_platforms", "cpu")
         platform = jax.devices()[0].platform
-    default_r = 64 if platform not in ("cpu",) else 8
+    default_r = 128 if platform not in ("cpu",) else 8
     replicas = int(os.environ.get("CRDT_BENCH_REPLICAS", str(default_r)))
 
     from crdt_benches_tpu.backends.jax_backend import JaxReplayBackend

@@ -80,11 +80,31 @@ def count_le_tiled(sorted_rc: jax.Array, q: jax.Array) -> jax.Array:
     B = q.shape[1]
     nt = C // LANE
     tiles = sorted_rc.reshape(R, nt, LANE)
-    tmax = tiles[:, :, -1]  # (R, nt)
-    # Full tiles entirely <= q.
-    nfull = jnp.sum(
-        (tmax[:, None, :] <= q[:, :, None]).astype(jnp.int32), axis=2
-    )  # (R, B)
+    tmax = tiles[:, :, -1]  # (R, nt) — nondecreasing
+    if nt <= 256:
+        # Single-level: compare against all tile maxima.
+        nfull = jnp.sum(
+            (tmax[:, None, :] <= q[:, :, None]).astype(jnp.int32), axis=2
+        )  # (R, B)
+    else:
+        # Two-level: narrow to a 128-tile super-block first, so the compare
+        # volume is B*(ns + 128) instead of B*nt — required for large B.
+        ns = -(-nt // LANE)
+        big = np.int32(2**31 - 1)
+        pad = ns * LANE - nt
+        tmax_p = jnp.concatenate(
+            [tmax, jnp.full((R, pad), big, jnp.int32)], axis=1
+        ) if pad else tmax
+        sup = tmax_p.reshape(R, ns, LANE)
+        smax = sup[:, :, -1]  # (R, ns)
+        nsf = jnp.sum(
+            (smax[:, None, :] <= q[:, :, None]).astype(jnp.int32), axis=2
+        )
+        sq = jnp.minimum(nsf, ns - 1)
+        srow = jnp.take_along_axis(sup, sq[:, :, None], axis=1, mode="clip")
+        nfull = sq * LANE + jnp.sum(
+            (srow <= q[:, :, None]).astype(jnp.int32), axis=2
+        )
     tq = jnp.minimum(nfull, nt - 1)
     # Fetch each query's crossing tile row.  Integer gather of B rows (exact;
     # an MXU one-hot matmul here silently rounds through bf16 passes and
@@ -251,22 +271,31 @@ def _mxu_spread(idx, vals_7bit_chunks, C: int):
     R*B*nt*128 MACs per chunk (~0.2ms at R=256, C=182k)."""
     R, B = idx.shape
     nt = C // LANE
-    tq = jnp.right_shift(idx, 7)  # idx // 128
-    lq = jnp.bitwise_and(idx, 127)
-    in_range = (idx >= 0) & (idx < C)
-    oh_tile = (
-        (jax.lax.broadcasted_iota(jnp.int32, (R, B, nt), 2) == tq[:, :, None])
-        & in_range[:, :, None]
-    ).astype(jnp.bfloat16)
-    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, B, LANE), 2)
-    oh_lane = (lane_iota == lq[:, :, None]).astype(jnp.bfloat16)
-    outs = []
-    for v in vals_7bit_chunks:
-        vb = oh_lane * v[:, :, None].astype(jnp.bfloat16)
-        dense = jnp.einsum(
-            "rbt,rbl->rtl", oh_tile, vb, preferred_element_type=jnp.float32
-        )
-        outs.append(dense.astype(jnp.int32).reshape(R, C))
+    outs = [jnp.zeros((R, C), jnp.int32) for _ in vals_7bit_chunks]
+    # Chunk the op axis so the one-hot materialization stays ~(R, 512, nt).
+    CB = 512 if B > 512 else B
+    for c0 in range(0, B, CB):
+        idx_c = jax.lax.slice_in_dim(idx, c0, c0 + CB, axis=1)
+        tq = jnp.right_shift(idx_c, 7)  # idx // 128
+        lq = jnp.bitwise_and(idx_c, 127)
+        in_range = (idx_c >= 0) & (idx_c < C)
+        oh_tile = (
+            (
+                jax.lax.broadcasted_iota(jnp.int32, (R, CB, nt), 2)
+                == tq[:, :, None]
+            )
+            & in_range[:, :, None]
+        ).astype(jnp.bfloat16)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, CB, LANE), 2)
+        oh_lane = (lane_iota == lq[:, :, None]).astype(jnp.bfloat16)
+        for i, v in enumerate(vals_7bit_chunks):
+            vc = jax.lax.slice_in_dim(v, c0, c0 + CB, axis=1)
+            vb = oh_lane * vc[:, :, None].astype(jnp.bfloat16)
+            dense = jnp.einsum(
+                "rbt,rbl->rtl", oh_tile, vb,
+                preferred_element_type=jnp.float32,
+            )
+            outs[i] = outs[i] + dense.astype(jnp.int32).reshape(R, C)
     return outs
 
 
@@ -303,9 +332,27 @@ def apply_batch3(
         rank_to_phys2(cumvis, jnp.where(is_ins, gv, 0)),
     )
     g_phys = jnp.where(is_ins, g_phys, drop)
-    smaller = (g_phys[:, :, None] > g_phys[:, None, :]) & is_ins[:, None, :]
-    n_before = jnp.sum(smaller.astype(jnp.int32), axis=2)
-    dest = jnp.where(is_ins, g_phys + n_before + resolved.ins_seq, drop)
+    if B <= 1024:
+        # #inserts at strictly smaller gaps via a B x B compare.
+        smaller = (
+            (g_phys[:, :, None] > g_phys[:, None, :]) & is_ins[:, None, :]
+        )
+        n_before = jnp.sum(smaller.astype(jnp.int32), axis=2)
+        dest = jnp.where(is_ins, g_phys + n_before + resolved.ins_seq, drop)
+    else:
+        # dest = g_phys + lexicographic rank of (g_phys, seq) among inserts
+        # (identical interleave, avoids the B^2 blowup).  rank = double
+        # argsort of a combined key; non-inserts key to the top and drop.
+        # key fits int32 while C*(B+1) < 2^31 (holds for all four traces at
+        # B=4096); non-inserts sort to the top and are dropped.
+        key = jnp.where(
+            is_ins,
+            g_phys * jnp.int32(B + 1) + resolved.ins_seq,
+            jnp.int32(2**31 - 1),
+        )
+        perm = jnp.argsort(key, axis=1, stable=True)
+        rank = jnp.argsort(perm, axis=1, stable=True).astype(jnp.int32)
+        dest = jnp.where(is_ins, g_phys + rank, drop)
 
     # Deletes: subtract a 0/1 indicator (each target has vis bit 1).
     (del_ind,) = _mxu_spread(dphys, [has_del.astype(jnp.int32)], C)

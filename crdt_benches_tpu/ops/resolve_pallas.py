@@ -217,10 +217,14 @@ def resolve_batch_pallas(
     """
     B = kind.shape[0]
     R = v0.shape[0]
-    Rt = replica_tile
+    T = _round_up(2 * B + 2, 128)
+    # Scoped-VMEM budget: ~10 live (Rt, T) + ~6 (Rt, B) int32 arrays
+    # (carries, roll temps, output blocks).  Power of two, >= 8 when R >= 8
+    # (sublane-dim block constraint), dividing R.
+    Rt = min(replica_tile, max(8, (12 * 2**20) // ((10 * T + 6 * B) * 4)))
+    Rt = 1 << (Rt.bit_length() - 1)
     while R % Rt:
         Rt //= 2
-    T = _round_up(2 * B + 2, 128)
 
     kernel = functools.partial(
         _kernel, B=B, T=T, Rt=Rt, emit_origin=emit_origin

@@ -132,3 +132,12 @@ def test_v3_packed_matches_v2_and_oracle(seed):
     st3 = e3.run()
     assert e3.decode(st3, replica=0) == want
     assert e3.decode(st3, replica=1) == want
+
+
+@pytest.mark.parametrize("batch", [2048])
+def test_v3_large_batch_sort_rank_path(batch):
+    # Exercises the argsort dest path (B > 1024) and hierarchical searchsorted.
+    trace = synth_trace(seed=21, n_ops=3000, base="large batch " * 4)
+    tt = tensorize(trace, batch=batch)
+    eng = ReplayEngine(tt, n_replicas=1, resolver="scan", engine="v3", pack=1)
+    assert eng.decode(eng.run()) == _oracle_replay(trace)
