@@ -100,7 +100,8 @@ def _native_upstreams() -> dict[str, type]:
 
 
 def run_downstream(trace_name: str, backend: str, samples: int,
-                   warmup: int) -> BenchResult | None:
+                   warmup: int, replicas: int = 1,
+                   batch: int = 256) -> BenchResult | None:
     trace = load_testing_data(trace_name)
     elements = len(trace)
     if backend == "cpp-crdt":
@@ -123,10 +124,13 @@ def run_downstream(trace_name: str, backend: str, samples: int,
             from ..engine.downstream import JaxDownstreamBackend
         except ImportError:
             return None
-        b = JaxDownstreamBackend()
+        b = JaxDownstreamBackend(n_replicas=replicas, batch=batch)
         b.prepare(trace)
         times = measure(b.replay_once, warmup=warmup, samples=samples)
-        return BenchResult("downstream", trace_name, b.NAME, elements, times)
+        return BenchResult(
+            "downstream", trace_name, b.NAME, elements, times,
+            replicas=replicas,
+        )
     return None
 
 
@@ -166,7 +170,8 @@ def main(argv=None) -> int:
             if backend in ("cpp-crdt", "jax") and (
                 not args.filter or args.filter in "downstream"
             ):
-                r = run_downstream(trace, backend, args.samples, args.warmup)
+                r = run_downstream(trace, backend, args.samples, args.warmup,
+                                   replicas=args.replicas, batch=args.batch)
                 if r:
                     results.append(r)
                     print(

@@ -87,6 +87,14 @@ class DownstreamUpdates:
     chars: np.ndarray  # int32[capacity] slot -> codepoint
     end_content: str
     n_patches: int
+    #: Positional form of the same updates, resolved against the receiver's
+    #: state at each batch's integration point (still encode-time work, like
+    #: the anchor/rank fields): ``ins_gap`` = physical position the insert
+    #: lands after in the pre-batch doc (0 = head), ``del_pos`` = physical
+    #: position of the delete target in the POST-batch doc.  These drive the
+    #: scatter-free packed apply (apply_updates3).
+    ins_gap: np.ndarray | None = None  # int32[n_batches, B]
+    del_pos: np.ndarray | None = None  # int32[n_batches, B]
 
     def nbytes(self) -> int:
         """Total wire size of the update tensors (the analog of the encoded
@@ -120,11 +128,14 @@ def generate_updates(tt: TensorizedTrace, lane: int = 128) -> DownstreamUpdates:
     kind_b, pos_b, _, slot_b = tt.batched()
     n_batches, B = kind_b.shape
 
+    from .replay import default_resolver
+
     state, dslot_b = replay_batches_collect(
         init_state(capacity, n_init),
         jnp.asarray(kind_b),
         jnp.asarray(pos_b),
         jnp.asarray(slot_b),
+        resolver=default_resolver(),
     )
     length = int(state.length)
     order = np.asarray(state.order)[:length]  # final doc order, incl. tombstones
@@ -169,6 +180,24 @@ def generate_updates(tt: TensorizedTrace, lane: int = 128) -> DownstreamUpdates:
     anchor[row, col] = a_slot
     rank[row, col] = r.astype(np.int32)
 
+    # Positional update form (encode-time resolution against the receiver's
+    # integration-point state; one O(length) pass per batch, untimed):
+    # physical position of final-order index q at time b (batches < b
+    # integrated) = #{p < q : arrb[p] < b}.
+    ins_gap = np.zeros((n_batches, B), np.int32)
+    del_pos = np.full((n_batches, B), -1, np.int32)
+    qd_all = np.where(dslot_b >= 0, pos_of_slot[np.clip(dslot_b, 0, None)], 0)
+    for b in range(n_batches):
+        ex_lt = np.concatenate([[0], np.cumsum(arrb < b)[:-1]])
+        ex_le = np.concatenate([[0], np.cumsum(arrb <= b)[:-1]])
+        sel = row == b
+        ap = a_pos[sel]
+        ins_gap[b, col[sel]] = np.where(
+            ap >= 0, ex_lt[np.clip(ap, 0, None)] + 1, 0
+        ).astype(np.int32)
+        hd = dslot_b[b] >= 0
+        del_pos[b, hd] = ex_le[qd_all[b, hd]].astype(np.int32)
+
     chars = slot_char_table(tt, capacity)
     return DownstreamUpdates(
         ins_slot=ins_slot,
@@ -180,6 +209,8 @@ def generate_updates(tt: TensorizedTrace, lane: int = 128) -> DownstreamUpdates:
         chars=chars,
         end_content=tt.end_content,
         n_patches=tt.n_patches,
+        ins_gap=ins_gap,
+        del_pos=del_pos,
     )
 
 
@@ -256,6 +287,102 @@ def apply_updates(state: DownState, ins_b, anchor_b, rank_b, dslot_b) -> DownSta
     return state
 
 
+def apply_update_batch3(state, ins, gap, rank, del_pos):
+    """Positional update integration on the packed doc-order state
+    (ops/apply2.py PackedState) — the scatter-free fast path: counting merge
+    via MXU one-hot spreads + the fused expansion kernel, deletes cleared at
+    post-batch positions.  Replica-batched: state leaves (R, ...), update
+    leaves (R, B) or broadcastable (B,) handled by the caller."""
+    from ..ops.apply2 import (
+        PackedState,
+        _expand,
+        _mxu_spread,
+        pack_doc,
+    )
+
+    R, C = state.doc.shape
+    B = ins.shape[1]
+    drop = jnp.int32(C + 7)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+
+    is_ins = ins >= 0
+    gap = jnp.where(is_ins, gap, drop)
+    smaller = (gap[:, :, None] > gap[:, None, :]) & is_ins[:, None, :]
+    n_before = jnp.sum(smaller.astype(jnp.int32), axis=2)
+    dest = jnp.where(is_ins, gap + n_before + rank, drop)
+
+    fill = jnp.where(is_ins, pack_doc(ins, jnp.ones_like(ins)), 0)
+    chunks = [
+        is_ins.astype(jnp.int32),
+        jnp.bitwise_and(fill, 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 7), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 14), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 21), 127),
+    ]
+    ind, f0, f1, f2, f3 = _mxu_spread(dest, chunks, C)
+    fill_dense = (
+        f0
+        + jnp.left_shift(f1, 7)
+        + jnp.left_shift(f2, 14)
+        + jnp.left_shift(f3, 21)
+    )
+
+    cnt = jnp.cumsum(ind, axis=1)
+    nbits = max(1, (B).bit_length())
+    cntind = jnp.left_shift(cnt, 1) | ind
+    if jax.default_backend() == "tpu":
+        from ..ops.expand_pallas import expand_packed
+
+        doc = expand_packed(state.doc, cntind, nbits=nbits)
+    else:
+        (doc,) = _expand([state.doc], cnt, nbits)
+        doc = jnp.where(ind != 0, 0, doc)
+    doc = doc + fill_dense
+
+    # Deletes at post-batch positions (each target currently visible).
+    has_del = del_pos >= 0
+    (del_ind,) = _mxu_spread(
+        jnp.where(has_del, del_pos, drop), [has_del.astype(jnp.int32)], C
+    )
+    doc = doc - del_ind
+
+    n_ins = jnp.sum(is_ins.astype(jnp.int32), axis=1)
+    n_del = jnp.sum(has_del.astype(jnp.int32), axis=1)
+    length = state.length + n_ins
+    beyond = col >= length[:, None]
+    return PackedState(
+        doc=jnp.where(beyond, pack_doc(-1, 0), doc),
+        length=length,
+        nvis=state.nvis + n_ins - n_del,
+    )
+
+
+@partial(jax.jit, static_argnames=("pack",), donate_argnums=(0,))
+def apply_updates3(state, ins_b, gap_b, rank_b, dpos_b, *, pack: int = 8):
+    """Scan all positional update batches into replica-batched packed state,
+    ``pack`` batches per scan step."""
+    NB, B = ins_b.shape
+    K = min(pack, NB)
+    while NB % K:
+        K -= 1
+    R = state.doc.shape[0]
+    bc = lambda x: jnp.broadcast_to(x[None], (R,) + x.shape)
+    rs = lambda x: x.reshape(NB // K, K, B)
+
+    def step(st, upd):
+        i, g, r, d = upd
+        for k in range(K):
+            st = apply_update_batch3(
+                st, bc(i[k]), bc(g[k]), bc(r[k]), bc(d[k])
+            )
+        return st, None
+
+    state, _ = jax.lax.scan(
+        step, state, (rs(ins_b), rs(gap_b), rs(rank_b), rs(dpos_b))
+    )
+    return state
+
+
 
 
 class JaxDownstreamEngine:
@@ -265,13 +392,19 @@ class JaxDownstreamEngine:
     integrates the same update stream — the batched-downstream analog of the
     upstream replica axis)."""
 
-    def __init__(self, tt: TensorizedTrace, n_replicas: int = 1):
+    def __init__(self, tt: TensorizedTrace, n_replicas: int = 1,
+                 engine: str | None = None):
+        import os
+
         self.upd = generate_updates(tt)
         self.n_replicas = n_replicas
+        self.engine = engine or os.environ.get("CRDT_ENGINE_APPLY", "v3")
         self.ins_b = jnp.asarray(self.upd.ins_slot)
         self.anchor_b = jnp.asarray(self.upd.anchor)
         self.rank_b = jnp.asarray(self.upd.rank)
         self.dslot_b = jnp.asarray(self.upd.dslot)
+        self.gap_b = jnp.asarray(self.upd.ins_gap)
+        self.dpos_b = jnp.asarray(self.upd.del_pos)
         self.chars = jnp.asarray(self.upd.chars)
         if n_replicas == 1:
             self._apply = apply_updates
@@ -287,13 +420,33 @@ class JaxDownstreamEngine:
             self.n_replicas,
         )
 
-    def run(self) -> DownState:
+    def run(self):
+        if self.engine == "v3":
+            from ..ops.apply2 import init_state3
+
+            st = init_state3(
+                self.n_replicas, self.upd.capacity, self.upd.n_init
+            )
+            return apply_updates3(
+                st, self.ins_b, self.gap_b, self.rank_b, self.dpos_b
+            )
         return self._apply(
             self.fresh_state(), self.ins_b, self.anchor_b, self.rank_b,
             self.dslot_b,
         )
 
-    def decode(self, state: DownState, replica: int = 0) -> str:
+    def decode(self, state, replica: int = 0) -> str:
+        from ..ops.apply2 import PackedState, decode_state3
+
+        if isinstance(state, PackedState):
+            codes, nvis = jax.jit(
+                decode_state3, static_argnames=("replica",)
+            )(state, self.chars, replica=replica)
+            import numpy as _np
+
+            return "".join(
+                map(chr, _np.asarray(codes)[: int(nvis)].tolist())
+            )
         return decode_to_str(
             select_replica(state, replica, self.n_replicas), self.chars
         )

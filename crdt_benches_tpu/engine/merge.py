@@ -100,11 +100,14 @@ def agent_oplog(
         raise ValueError("all agents must share the same base document")
     capacity = _round_up(max(tt.capacity, 1), 128)
     kind_b, pos_b, _, slot_b = tt.batched()
+    from .replay import default_resolver
+
     state, dslot_b = replay_batches_collect(
         init_state(capacity, n_base),
         jnp.asarray(kind_b),
         jnp.asarray(pos_b),
         jnp.asarray(slot_b),
+        resolver=default_resolver(),
     )
     origin_local = np.asarray(state.origin)
     dslot = np.asarray(dslot_b).reshape(-1)[: tt.n_ops]

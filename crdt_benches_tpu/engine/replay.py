@@ -195,8 +195,10 @@ def replay_batches_r3(
     return state
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def replay_batches_collect(state: DocState, kind_b, pos_b, slot_b):
+@partial(jax.jit, static_argnames=("resolver",), donate_argnums=(0,))
+def replay_batches_collect(
+    state: DocState, kind_b, pos_b, slot_b, *, resolver: str = "scan"
+):
     """Like :func:`replay_batches` but also stacks each op's tombstoned slot:
     returns (state, dslot_b int32[n_batches, B]).  Used by update generation
     (engine/downstream.py) — the untimed upstream replay that the reference's
@@ -204,7 +206,14 @@ def replay_batches_collect(state: DocState, kind_b, pos_b, slot_b):
 
     def step(st, batch):
         kind, pos, slot = batch
-        resolved = resolve_batch(kind, pos, st.nvis)
+        if resolver == "pallas":
+            from ..ops.resolve_pallas import resolve_batch_pallas
+
+            resolved = jax.tree.map(
+                lambda x: x[0], resolve_batch_pallas(kind, pos, st.nvis[None])
+            )
+        else:
+            resolved = resolve_batch(kind, pos, st.nvis)
         st, dslot = apply_batch_collect(st, resolved, slot)
         return st, dslot
 
@@ -242,7 +251,7 @@ class ReplayEngine:
         resolver: str | None = None,
         chunk: int = 32,
         engine: str | None = None,
-        pack: int = 4,
+        pack: int = 8,
     ):
         import os
 
