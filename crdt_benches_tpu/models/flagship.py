@@ -1,0 +1,58 @@
+"""Flagship model: the batched CRDT replay engine, as one configured object.
+
+The reference's "models" are its four CRDT adapters behind the Upstream /
+Downstream traits (reference src/rope.rs:6-33,185-191).  Here the analogous
+surface is a single TPU-native engine family parameterized by configuration
+rather than four separate implementations:
+
+- ``upstream(trace)``   — local-edit replay (Upstream capability)
+- ``downstream(trace)`` — remote-update apply (Downstream capability)
+- both batched over a replica axis and built from the same kernel stack
+  (fused Pallas resolver -> packed doc-order apply).
+
+``FlagshipConfig`` pins the tuned defaults the headline benchmark uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..engine.downstream import JaxDownstreamEngine
+from ..engine.replay import ReplayEngine, default_resolver
+from ..traces.loader import TestData, load_testing_data
+from ..traces.tensorize import tensorize
+
+
+@dataclass
+class FlagshipConfig:
+    n_replicas: int = 128  # replica-parallel width (the DP analog)
+    batch: int = 512  # ops per resolver kernel launch
+    pack: int = 8  # op batches per scan step
+    engine: str = "v3"  # packed doc-order apply
+    resolver: str | None = None  # None = auto (pallas on TPU)
+
+
+def upstream(trace: TestData | str, cfg: FlagshipConfig | None = None) -> ReplayEngine:
+    cfg = cfg or FlagshipConfig()
+    if isinstance(trace, str):
+        trace = load_testing_data(trace)
+    tt = tensorize(trace, batch=cfg.batch)
+    return ReplayEngine(
+        tt,
+        n_replicas=cfg.n_replicas,
+        resolver=cfg.resolver or default_resolver(),
+        engine=cfg.engine,
+        pack=cfg.pack,
+    )
+
+
+def downstream(
+    trace: TestData | str, cfg: FlagshipConfig | None = None
+) -> JaxDownstreamEngine:
+    cfg = cfg or FlagshipConfig()
+    if isinstance(trace, str):
+        trace = load_testing_data(trace)
+    tt = tensorize(trace, batch=cfg.batch)
+    return JaxDownstreamEngine(
+        tt, n_replicas=cfg.n_replicas, engine=cfg.engine
+    )

@@ -125,3 +125,14 @@ def test_vmap_replicas_agree(svelte_trace):
     assert (eng.lengths(state) == len(svelte_trace.end_content)).all()
     for r in (0, 3):
         assert eng.decode(state, replica=r) == svelte_trace.end_content
+
+
+def test_flagship_model_api(svelte_trace):
+    from crdt_benches_tpu.models.flagship import FlagshipConfig, upstream
+
+    cfg = FlagshipConfig(n_replicas=2, batch=256, resolver="scan")
+    eng = upstream(svelte_trace, cfg)
+    st = eng.run()
+    import numpy as np
+
+    assert (np.asarray(st.nvis) == len(svelte_trace.end_content)).all()
