@@ -36,8 +36,35 @@ class JaxReplayBackend(BatchedReplay):
         return self.n_replicas
 
     def prepare(self, trace: TestData) -> None:
-        self._tt = tensorize(trace, batch=self.batch)
-        self._eng = ReplayEngine(self._tt, n_replicas=self.n_replicas)
+        # Layout auto-selection (SURVEY.md section 7 hard-part 4): block-edit
+        # traces explode to many unit ops per patch — use the range engine
+        # when the explosion ratio is significant; keystroke traces stay on
+        # the exploded engine (lower per-op constants).
+        import os
+
+        unit_ops = sum(
+            d + len(ins) for _, d, ins in trace.iter_patches()
+        )
+        range_ops = sum(
+            (1 if d else 0) + (1 if ins else 0)
+            for _, d, ins in trace.iter_patches()
+        )
+        layout = os.environ.get("CRDT_ENGINE_LAYOUT", "auto")
+        use_range = (
+            layout == "range"
+            or (layout == "auto" and unit_ops >= 2 * range_ops)
+        )
+        if use_range:
+            from ..engine.replay_range import RangeReplayEngine
+            from ..traces.tensorize import tensorize_ranges
+
+            rt = tensorize_ranges(trace, batch=self.batch)
+            self._eng = RangeReplayEngine(
+                rt, n_replicas=self.n_replicas, pack=8
+            )
+        else:
+            self._tt = tensorize(trace, batch=self.batch)
+            self._eng = ReplayEngine(self._tt, n_replicas=self.n_replicas)
         self._end_len = len(trace.end_content)
 
     def replay_once(self) -> int:
@@ -51,5 +78,5 @@ class JaxReplayBackend(BatchedReplay):
         return n
 
     def final_content(self) -> str:
-        state = self._eng.run_blocking()
+        state = self._eng.run()
         return self._eng.decode(state)

@@ -275,21 +275,22 @@ def _mxu_spread(idx, vals_7bit_chunks, C: int):
     # Chunk the op axis so the one-hot materialization stays ~(R, 512, nt).
     CB = 512 if B > 512 else B
     for c0 in range(0, B, CB):
-        idx_c = jax.lax.slice_in_dim(idx, c0, c0 + CB, axis=1)
+        cb = min(CB, B - c0)
+        idx_c = jax.lax.slice_in_dim(idx, c0, c0 + cb, axis=1)
         tq = jnp.right_shift(idx_c, 7)  # idx // 128
         lq = jnp.bitwise_and(idx_c, 127)
         in_range = (idx_c >= 0) & (idx_c < C)
         oh_tile = (
             (
-                jax.lax.broadcasted_iota(jnp.int32, (R, CB, nt), 2)
+                jax.lax.broadcasted_iota(jnp.int32, (R, cb, nt), 2)
                 == tq[:, :, None]
             )
             & in_range[:, :, None]
         ).astype(jnp.bfloat16)
-        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, CB, LANE), 2)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, cb, LANE), 2)
         oh_lane = (lane_iota == lq[:, :, None]).astype(jnp.bfloat16)
         for i, v in enumerate(vals_7bit_chunks):
-            vc = jax.lax.slice_in_dim(v, c0, c0 + CB, axis=1)
+            vc = jax.lax.slice_in_dim(v, c0, c0 + cb, axis=1)
             vb = oh_lane * vc[:, :, None].astype(jnp.bfloat16)
             dense = jnp.einsum(
                 "rbt,rbl->rtl", oh_tile, vb,

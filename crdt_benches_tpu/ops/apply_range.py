@@ -1,0 +1,188 @@
+"""Batch application for RANGE-op resolution (ops/resolve_range_pallas.py)
+on the packed doc-order state (ops/apply2.py PackedState).
+
+Everything stays in the fast-primitive set: interval indicators built from
+start/stop one-hot MXU spreads + cumsum, the log-shift expansion kernel (the
+per-position insert indicator is 0/1 because destination positions are
+distinct, so the 1-Lipschitz correctness argument is unchanged), and the
+insert fill painted arithmetically: within a destination run the filled slot
+is ``position + delta`` with a per-run constant delta, and per-run constants
+materialize as a cumsum over spread delta-differences — no per-char work
+anywhere on the host or in scatters.
+
+Deletes arrive as per-op PRE-BATCH RANK intervals [lo, hi] (plus the exact
+covered count): visible chars with ranks in the interval are exactly the
+delete's targets (interior ranks missing from it were tombstoned earlier in
+the same batch and are already invisible), so clearing the whole physical
+interval [phys(lo), phys(hi)] is correct.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .apply2 import (
+    LANE,
+    PackedState,
+    _expand,
+    _mxu_spread,
+    rank_to_phys2,
+)
+from .resolve import RUN, TINS
+
+_BIG = np.int32(1 << 30)
+
+
+def extract_range_tokens(ttype, ta, tch, tlen, v0):
+    """Per-token placement info from the final token list (all int32[R, T]):
+    live mask (surviving insert runs), gap rank ``gvis`` (rank of the first
+    surviving pre-batch char to the token's right, v0 = document tail), and
+    ``cumlen`` (exclusive prefix sum of live lengths = chars inserted before
+    this token in (gap, order) interleave order, since token order is
+    document order and gaps are monotone along it)."""
+    R, T = ttype.shape
+    live = (ttype == TINS) & (tlen > 0)
+    run_start = jnp.where((ttype == RUN) & (tlen > 0), ta, _BIG)
+    suff = jax.lax.cummin(run_start, axis=1, reverse=True)
+    nxt = jnp.concatenate(
+        [suff[:, 1:], jnp.full((R, 1), _BIG, jnp.int32)], axis=1
+    )
+    gvis = jnp.where(nxt >= _BIG, v0[:, None], nxt)
+    llen = jnp.where(live, tlen, 0)
+    cumlen = jnp.cumsum(llen, axis=1) - llen
+    return live, gvis, cumlen
+
+
+def apply_range_batch(
+    state: PackedState,
+    tokens,  # (ttype, ta, tch, tlen) int32[R, T]
+    dints,  # (dlo, dhi, dcount) int32[R, B]
+    slot0_b: jax.Array,  # int32[B] first slot per op (-1 = not an insert)
+    nbits: int,
+) -> PackedState:
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    R, C = state.doc.shape
+    T = ttype.shape[1]
+    drop = jnp.int32(C + 7)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    valid = col < state.length[:, None]
+
+    vis_bit = jnp.bitwise_and(state.doc, 1)
+    cumvis = jnp.cumsum(vis_bit * valid, axis=1)
+
+    # ---- deletes: clear visible bits over physical rank intervals ----
+    has_del = dlo >= 0
+    lo_phys = rank_to_phys2(cumvis, jnp.where(has_del, dlo, 0))
+    hi_phys = rank_to_phys2(cumvis, jnp.where(has_del, dhi, 0))
+    starts, = _mxu_spread(
+        jnp.where(has_del, lo_phys, drop), [has_del.astype(jnp.int32)], C
+    )
+    stops, = _mxu_spread(
+        jnp.where(has_del, hi_phys + 1, drop), [has_del.astype(jnp.int32)], C
+    )
+    in_del = jnp.cumsum(starts - stops, axis=1) > 0
+    doc = state.doc - (vis_bit & in_del.astype(jnp.int32))
+
+    # ---- insert runs: destinations ----
+    live, gvis, cumlen = extract_range_tokens(ttype, ta, tch, tlen, v0=state.nvis)
+    at_end = gvis >= state.nvis[:, None]
+    g_phys = jnp.where(
+        at_end,
+        state.length[:, None],
+        rank_to_phys2(cumvis, jnp.where(live, gvis, 0)),
+    )
+    dest0 = jnp.where(live, g_phys + cumlen, drop)  # (R, T)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+
+    s1, = _mxu_spread(dest0, [live.astype(jnp.int32)], C)
+    s2, = _mxu_spread(dstop, [live.astype(jnp.int32)], C)
+    ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+    cnt = jnp.cumsum(ind, axis=1)
+
+    # ---- fill values: slot(d) = d + delta(run of d) ----
+    # slot of char k of token i = slot0[ta_i] + tch_i + k, at position
+    # dest0_i + k  ->  delta_i = slot0[ta_i] + tch_i - dest0_i.
+    slot0_t = jnp.where(
+        live,
+        jnp.take(
+            jnp.concatenate([slot0_b, jnp.zeros((1,), jnp.int32)]),
+            jnp.clip(ta, 0, slot0_b.shape[0]),
+        ),
+        0,
+    )
+    delta = jnp.where(live, slot0_t + tch - dest0, 0)
+    # Per-run constants as cumsum of differences painted at run starts.
+    prev_live_delta = _prev_value(delta, live)
+    ddelta = jnp.where(live, delta - prev_live_delta, 0)
+    dpos_ = jnp.where(live, dest0, drop)
+    pos_chunks = [
+        jnp.bitwise_and(v, 127)
+        for v in (
+            jnp.where(ddelta > 0, ddelta, 0),
+            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 7),
+            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 14),
+            jnp.where(ddelta < 0, -ddelta, 0),
+            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 7),
+            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 14),
+        )
+    ]
+    p0, p1, p2, n0, n1, n2 = _mxu_spread(dpos_, pos_chunks, C)
+    dd_dense = (
+        p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
+        - n0 - jnp.left_shift(n1, 7) - jnp.left_shift(n2, 14)
+    )
+    delta_cum = jnp.cumsum(dd_dense, axis=1)
+    fill_slot = col + delta_cum
+    fill_dense = jnp.where(
+        ind > 0, jnp.left_shift(fill_slot + 2, 1) | 1, 0
+    )
+
+    # ---- expansion + fill ----
+    cntind = jnp.left_shift(cnt, 1) | ind
+    if jax.default_backend() == "tpu":
+        from .expand_pallas import expand_packed
+
+        doc = expand_packed(doc, cntind, nbits=nbits)
+    else:
+        (doc,) = _expand([doc], cnt, nbits)
+        doc = jnp.where(ind != 0, 0, doc)
+    doc = doc + fill_dense
+
+    n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
+    n_del = jnp.sum(jnp.where(has_del, dcount, 0), axis=1)
+    length = state.length + n_ins
+    beyond = col >= length[:, None]
+    return PackedState(
+        doc=jnp.where(beyond, jnp.int32(2), doc),  # pack(-1, 0) == 2
+        length=length,
+        nvis=state.nvis + n_ins - n_del,
+    )
+
+
+def _prev_value(x, mask):
+    """Per row: for each masked position, the previous masked position's
+    value (0 if none).  O(T log T) log-shift forward-fill over the small
+    token axis."""
+    R, T = x.shape
+    carry_v = jnp.where(mask, x, 0)
+    carry_m = mask
+    steps = 1
+    while steps < T:
+        sv = jnp.concatenate(
+            [jnp.zeros((R, steps), x.dtype), carry_v[:, :-steps]], axis=1
+        )
+        sm = jnp.concatenate(
+            [jnp.zeros((R, steps), bool), carry_m[:, :-steps]], axis=1
+        )
+        carry_v = jnp.where(carry_m, carry_v, sv)
+        carry_m = carry_m | sm
+        steps *= 2
+    # carry_v now holds, at every position, the value of the nearest masked
+    # position at-or-before it.  Shift by one masked step: take the carry
+    # just BEFORE each masked position.
+    pv = jnp.concatenate([jnp.zeros((R, 1), x.dtype), carry_v[:, :-1]], axis=1)
+    pm = jnp.concatenate([jnp.zeros((R, 1), bool), carry_m[:, :-1]], axis=1)
+    return jnp.where(mask & pm, pv, 0)

@@ -80,15 +80,15 @@ def _expand_kernel(order_ref, vis_ref, cnt_ref, ind_ref,
 def _expand_packed_kernel(doc_ref, cntind_ref, out_ref,
                           *, nt: int, nbits: int, Rt: int):
     """Packed variant: doc = ((order+2)<<1)|vis moves as one array;
-    cntind = (cnt<<1)|ind carries both the shift map and the hole mask.
-    Bits above the block's max shift are skipped (small batches of inserts
-    rarely use the high bits)."""
+    cntind = (cnt<<1)|ind carries both the shift map and the hole mask (the
+    shift-bit test reads cntind directly — bit b of cnt is bit b+1 of
+    cntind — to keep VMEM live-array count down).  Bits above the block's
+    max shift are skipped (small insert batches rarely use the high bits)."""
     cntind = cntind_ref[:]
     tile = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 1)
     lane = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 2)
     col = tile * LANE + lane
-    cnt = jnp.right_shift(cntind, 1)
-    maxcnt = jnp.max(cnt)
+    maxcnt = jnp.max(jnp.right_shift(cntind, 1))
     out_ref[:] = doc_ref[:]
     for b in reversed(range(nbits)):
         step = 1 << b
@@ -96,7 +96,7 @@ def _expand_packed_kernel(doc_ref, cntind_ref, out_ref,
         @pl.when(maxcnt >= step)
         def _():
             doc = out_ref[:]
-            take = (jnp.bitwise_and(cnt, step) != 0) & (col >= step)
+            take = (jnp.bitwise_and(cntind, step << 1) != 0) & (col >= step)
             out_ref[:] = jnp.where(take, _flat_roll(doc, step), doc)
 
     hole = jnp.bitwise_and(cntind, 1) != 0
@@ -113,11 +113,22 @@ def expand_packed(doc, cntind, *, nbits: int, replica_tile: int = 0,
     auto (largest power of two whose VMEM footprint stays under budget)."""
     R, C = doc.shape
     nt = C // LANE
+    # Mosaic's stack peaks at ~8 live (Rt, C) int32 arrays (state + roll
+    # temps + iotas); stay under the 16MB scoped-vmem limit with margin.
+    per_replica = 8 * 4 * C
+    if per_replica > 14 * 2**20:
+        # Capacity too large for VMEM even at one replica per grid step:
+        # run the bit passes in XLA (HBM round trips, but correct).
+        col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+        out = doc
+        for b in reversed(range(nbits)):
+            step = 1 << b
+            take = (jnp.bitwise_and(cntind, step << 1) != 0) & (col >= step)
+            out = jnp.where(take, jnp.roll(out, step, axis=1), out)
+        return jnp.where(jnp.bitwise_and(cntind, 1) != 0, 0, out)
     Rt = replica_tile
     if Rt <= 0:
-        # Mosaic's stack peaks at ~6 live (Rt, C) int32 arrays (state + roll
-        # temps); stay under the 16MB scoped-vmem limit with margin.
-        Rt = max(1, (14 * 2**20) // (6 * 4 * C))
+        Rt = max(1, (14 * 2**20) // per_replica)
     Rt = min(Rt, R)
     while R % Rt:
         Rt -= 1

@@ -1,0 +1,256 @@
+"""Fused TPU resolver for RANGE ops: insert-runs and delete-ranges.
+
+Same architecture as ops/resolve_pallas.py (whole op batch in one Pallas
+kernel, token list in VMEM, cum-primary representation) but one op covers a
+run of chars, so resolver work scales with PATCHES instead of chars — the
+per-char explosion costs up to ~24x on block-edit traces (SURVEY.md §6).
+
+Token list: (ttype, ta, tch, cum) per token.
+- RUN(a):    surviving pre-batch chars with ranks a .. a+len-1
+- TINS(j, c): chars c .. c+len-1 of batch op j's inserted run (len > 0 means
+  surviving; zero-length means fully deleted within the batch — such chars
+  simply never materialize, no tombstone is needed for the upstream replay)
+- FREE: unused slot (cum stays flat)
+
+An INSERT(p, L) replaces the token containing p by up to 3 tokens (left
+piece, the new TINS run, right piece) exactly like the unit kernel but with
+lengths.  A DELETE(p, D) is *mostly a vector pass*: every token's cum is
+clamped by ``min(cum, p) + max(0, cum - p - D)`` and boundary starts advance
+by their consumed prefix; only a delete strictly inside one token needs a
+real split (left keep + right keep, one extra token).  Per delete op the
+kernel emits the covered surviving pre-batch chars as ONE rank interval
+[drank_lo, drank_hi] plus their count — correct because ranks inside the
+interval that are *not* covered were deleted earlier in the same batch and
+are already invisible, so the apply can clear the whole physical interval.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..traces.tensorize import DELETE, INSERT
+from .resolve import FREE, RUN, TINS
+
+_BIG = np.int32(1 << 30)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _roll1(x):
+    return jnp.concatenate([x[:, -1:], x[:, :-1]], axis=1)
+
+
+def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
+            dlo_ref, dhi_ref, dn_ref,
+            ttype_ref, ta_ref, tch_ref, tlen_ref,
+            *, B: int, T: int, Rt: int):
+    lane_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, T), 1)
+    lane_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    kind_v = kind_ref[:]
+    pos_v = pos_ref[:]
+    rlen_v = rlen_ref[:]
+    v0 = v0_ref[:]  # (Rt, 1)
+
+    dlo_ref[:] = jnp.full((Rt, B), -1, jnp.int32)
+    dhi_ref[:] = jnp.full((Rt, B), -1, jnp.int32)
+    dn_ref[:] = jnp.zeros((Rt, B), jnp.int32)
+
+    ttype0 = jnp.where(lane_t == 0, RUN, FREE)
+    ta0 = jnp.zeros((Rt, T), jnp.int32)
+    tch0 = jnp.zeros((Rt, T), jnp.int32)
+    cum0 = jnp.broadcast_to(v0, (Rt, T))
+    total0 = v0
+    nused0 = jnp.ones((Rt, 1), jnp.int32)
+
+    def body(j, carry):
+        ttype, ta, tch, cum, total, nused = carry
+        jj = jnp.int32(j)
+        opm = (lane_b == jj).astype(jnp.int32)
+        k = jnp.sum(kind_v * opm, axis=1, keepdims=True)
+        p0 = jnp.sum(pos_v * opm, axis=1, keepdims=True)
+        L0 = jnp.sum(rlen_v * opm, axis=1, keepdims=True)
+
+        is_ins = (k == INSERT) & (L0 > 0)
+        p = jnp.clip(p0, 0, total)
+        D = jnp.where(k == DELETE, jnp.clip(L0, 0, total - p), 0)
+        is_del = (k == DELETE) & (D > 0)
+        L = jnp.where(is_ins, L0, 0)
+
+        pre_all = jnp.where(lane_t == 0, 0, _roll1(cum))
+
+        # ---- delete rank-interval outputs (from pre-clamp state) ----
+        pD = p + D
+        ov_lo = jnp.maximum(pre_all, p)
+        ov_hi = jnp.minimum(cum, pD)
+        has_ov = is_del & (ttype == RUN) & (ov_hi > ov_lo)
+        r_lo = ta + (ov_lo - pre_all)
+        r_hi = ta + (ov_hi - pre_all) - 1
+        dlo = jnp.min(jnp.where(has_ov, r_lo, _BIG), axis=1, keepdims=True)
+        dhi = jnp.max(jnp.where(has_ov, r_hi, -1), axis=1, keepdims=True)
+        dcount = jnp.sum(
+            jnp.where(has_ov, ov_hi - ov_lo, 0), axis=1, keepdims=True
+        )
+        dlo = jnp.where(dlo >= _BIG, -1, dlo)
+
+        # ---- vector clamp (delete effect on every token) ----
+        consumed = jnp.maximum(
+            0, jnp.minimum(cum, pD) - jnp.maximum(pre_all, p)
+        )
+        adv = jnp.where(is_del & (cum > pD), consumed, 0)
+        cum_c = jnp.where(
+            is_del, jnp.minimum(cum, p) + jnp.maximum(0, cum - pD), cum
+        )
+        ta_c = jnp.where((ttype == RUN), ta + adv, ta)
+        tch_c = jnp.where((ttype == TINS), tch + adv, tch)
+
+        # ---- locate token containing p (pre-clamp coordinates) ----
+        t = jnp.sum((cum <= p).astype(jnp.int32), axis=1, keepdims=True)
+        t = jnp.minimum(t, nused)
+        m_t = lane_t == t
+        c_t = jnp.sum(jnp.where(m_t, cum, 0), axis=1, keepdims=True)
+        pre = jnp.sum(jnp.where(m_t, pre_all, 0), axis=1, keepdims=True)
+        a = jnp.sum(jnp.where(m_t, ta, 0), axis=1, keepdims=True)
+        ch = jnp.sum(jnp.where(m_t, tch, 0), axis=1, keepdims=True)
+        tt = jnp.sum(jnp.where(m_t, ttype, 0), axis=1, keepdims=True)
+        off = p - pre
+        is_run_t = tt == RUN
+
+        split_ins = is_ins & (off > 0)
+        split_del = is_del & (off > 0) & (pD < c_t)
+        m = jnp.where(
+            is_ins,
+            jnp.where(split_ins, 3, 2),
+            jnp.where(split_del, 2, 1),
+        )
+
+        # Replacement pieces.  For inserts: [left?, TINS(j,0,L), right].
+        # For an inside-delete: [left-keep, right-keep].  m == 1 writes the
+        # token's CLAMPED values back (identity for inserts/PAD; the
+        # delete's boundary adjustment for spanning deletes).
+        c_t_clamped = jnp.sum(jnp.where(m_t, cum_c, 0), axis=1, keepdims=True)
+        a_cl = jnp.sum(jnp.where(m_t, ta_c, 0), axis=1, keepdims=True)
+        ch_cl = jnp.sum(jnp.where(m_t, tch_c, 0), axis=1, keepdims=True)
+        a_right_del = jnp.where(is_run_t, a + (pD - pre), a)
+        ch_right_del = jnp.where(is_run_t, ch, ch + (pD - pre))
+        a_right_ins = jnp.where(is_run_t, a + off, a)
+        ch_right_ins = jnp.where(is_run_t, ch, ch + off)
+
+        n0t = jnp.where(is_ins & ~split_ins, TINS, tt)
+        n0a = jnp.where(
+            is_ins & ~split_ins, jj, jnp.where(split_del, a, a_cl)
+        )
+        n0c_ = jnp.where(
+            is_ins & ~split_ins, 0, jnp.where(split_del, ch, ch_cl)
+        )
+        n0cum = jnp.where(
+            is_ins,
+            jnp.where(split_ins, p, pre + L),
+            jnp.where(split_del, p, c_t_clamped),
+        )
+
+        n1t = jnp.where(is_ins, jnp.where(split_ins, TINS, tt), tt)
+        n1a = jnp.where(
+            is_ins, jnp.where(split_ins, jj, a), a_right_del
+        )
+        n1c_ = jnp.where(
+            is_ins, jnp.where(split_ins, 0, ch), ch_right_del
+        )
+        n1cum = jnp.where(
+            is_ins, jnp.where(split_ins, p + L, c_t + L), c_t - D
+        )
+
+        n2t, n2a, n2c_, n2cum = tt, a_right_ins, ch_right_ins, c_t + L
+
+        m2 = m >= 2
+        m3 = m == 3
+        delta = L  # tail cum shift beyond the placed pieces (deletes: 0,
+        #            their tail effect is already in the clamp)
+
+        def place(x, x0, x1, x2, dlt):
+            r1, r2 = _roll1(x), _roll1(_roll1(x))
+            sh = jnp.where(m == 1, x, jnp.where(m == 2, r1, r2)) + dlt
+            out = jnp.where(lane_t < t, x, sh)
+            out = jnp.where(lane_t == t, x0, out)
+            out = jnp.where(m2 & (lane_t == t + 1), x1, out)
+            out = jnp.where(m3 & (lane_t == t + 2), x2, out)
+            return out
+
+        ttype_n = place(ttype, n0t, n1t, n2t, 0)
+        ta_n = place(ta_c, n0a, n1a, n2a, 0)
+        tch_n = place(tch_c, n0c_, n1c_, n2c_, 0)
+        cum_n = place(cum_c, n0cum, n1cum, n2cum, delta)
+
+        colm = lane_b == jj
+        dlo_ref[:] = jnp.where(colm & is_del, dlo, dlo_ref[:])
+        dhi_ref[:] = jnp.where(colm & is_del, dhi, dhi_ref[:])
+        dn_ref[:] = jnp.where(colm & is_del, dcount, dn_ref[:])
+
+        return (
+            ttype_n, ta_n, tch_n, cum_n,
+            total + L - D,
+            nused + (m - 1),
+        )
+
+    ttype, ta, tch, cum, _, _ = jax.lax.fori_loop(
+        0, B, body, (ttype0, ta0, tch0, cum0, total0, nused0)
+    )
+    ttype_ref[:] = ttype
+    ta_ref[:] = ta
+    tch_ref[:] = tch
+    tlen_ref[:] = cum - jnp.where(lane_t == 0, 0, _roll1(cum))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("replica_tile", "interpret")
+)
+def resolve_range_pallas(
+    kind, pos, rlen, v0, *, replica_tile: int = 32, interpret: bool = False
+):
+    """Resolve one batch of range ops for R replicas.
+
+    kind/pos/rlen: int32[B]; v0: int32[R].  Returns
+    (ttype, ta, tch, tlen) int32[R, T] token arrays and
+    (drank_lo, drank_hi, dcount) int32[R, B] per-op delete intervals.
+    """
+    B = kind.shape[0]
+    R = v0.shape[0]
+    T = _round_up(2 * B + 2, 128)
+    Rt = min(replica_tile, max(8, (12 * 2**20) // ((12 * T + 6 * B) * 4)))
+    Rt = 1 << (Rt.bit_length() - 1)
+    while R % Rt:
+        Rt //= 2
+
+    kernel = functools.partial(_kernel, B=B, T=T, Rt=Rt)
+    bspec = lambda n: pl.BlockSpec(
+        (1, n), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    ospec = lambda n: pl.BlockSpec(
+        (Rt, n), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(R // Rt,),
+        in_specs=[bspec(B), bspec(B), bspec(B),
+                  pl.BlockSpec((Rt, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[ospec(B), ospec(B), ospec(B),
+                   ospec(T), ospec(T), ospec(T), ospec(T)],
+        out_shape=[jax.ShapeDtypeStruct((R, B), jnp.int32)] * 3
+        + [jax.ShapeDtypeStruct((R, T), jnp.int32)] * 4,
+        interpret=interpret,
+    )(
+        kind.reshape(1, B).astype(jnp.int32),
+        pos.reshape(1, B).astype(jnp.int32),
+        rlen.reshape(1, B).astype(jnp.int32),
+        v0.reshape(R, 1).astype(jnp.int32),
+    )
+    dlo, dhi, dn, ttype, ta, tch, tlen = out
+    return (ttype, ta, tch, tlen), (dlo, dhi, dn)

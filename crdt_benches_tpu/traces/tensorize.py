@@ -83,6 +83,99 @@ def explode_unit_ops(trace: TestData) -> tuple[np.ndarray, np.ndarray, np.ndarra
     )
 
 
+@dataclass
+class RangeTrace:
+    """A trace as padded RANGE-op tensors: one op per patch component
+    (delete-range and/or insert-run) instead of one per char.
+
+    The per-char explosion multiplies op counts up to ~24x on block-edit
+    traces (SURVEY.md section 6 'per-char-exploded unit ops'); the range
+    layout keeps op count ~= patch count, so the sequential resolver does
+    O(patches) work instead of O(chars) (SURVEY.md section 7 hard-part 4).
+    """
+
+    kind: np.ndarray  # int32[N_pad]  PAD / INSERT / DELETE
+    pos: np.ndarray  # int32[N_pad]  visible char position at op time
+    rlen: np.ndarray  # int32[N_pad]  run length (chars inserted / deleted)
+    slot0: np.ndarray  # int32[N_pad] first slot id for INSERT, -1 otherwise
+    init_chars: np.ndarray  # int32[S]
+    n_ops: int
+    n_patches: int
+    n_ins_chars: int  # total inserted chars
+    capacity: int  # S + n_ins_chars
+    batch: int
+    end_content: str
+    max_batch_ins: int  # max inserted chars in any one op batch
+    chars: np.ndarray  # int32[capacity] slot -> codepoint
+
+    @property
+    def n_batches(self) -> int:
+        return len(self.kind) // self.batch
+
+    def batched(self):
+        nb, b = self.n_batches, self.batch
+        return (
+            self.kind.reshape(nb, b),
+            self.pos.reshape(nb, b),
+            self.rlen.reshape(nb, b),
+            self.slot0.reshape(nb, b),
+        )
+
+
+def tensorize_ranges(trace: TestData, batch: int = 512) -> RangeTrace:
+    """Tensorize a trace as range ops (no per-char explosion)."""
+    kinds: list[int] = []
+    poss: list[int] = []
+    lens: list[int] = []
+    slot0s: list[int] = []
+    init_chars = np.asarray([ord(c) for c in trace.start_content], np.int32)
+    s = len(init_chars)
+    next_slot = s
+    chars: list[int] = []
+    for pos, del_count, ins in trace.iter_patches():
+        if del_count:
+            kinds.append(DELETE)
+            poss.append(pos)
+            lens.append(del_count)
+            slot0s.append(-1)
+        if ins:
+            kinds.append(INSERT)
+            poss.append(pos)
+            lens.append(len(ins))
+            slot0s.append(next_slot)
+            chars.extend(ord(c) for c in ins)
+            next_slot += len(ins)
+    n_ops = len(kinds)
+    n_pad = (-n_ops) % batch if n_ops else batch
+    kind = np.asarray(kinds + [PAD] * n_pad, np.int32)
+    pos = np.asarray(poss + [0] * n_pad, np.int32)
+    rlen = np.asarray(lens + [0] * n_pad, np.int32)
+    slot0 = np.asarray(slot0s + [-1] * n_pad, np.int32)
+    n_ins_chars = next_slot - s
+    char_table = np.zeros(s + n_ins_chars, np.int32)
+    char_table[:s] = init_chars
+    char_table[s:] = np.asarray(chars, np.int32)
+    nb = len(kind) // batch
+    ins_per_batch = (
+        np.where(kind == INSERT, rlen, 0).reshape(nb, batch).sum(axis=1)
+    )
+    return RangeTrace(
+        kind=kind,
+        pos=pos,
+        rlen=rlen,
+        slot0=slot0,
+        init_chars=init_chars,
+        n_ops=n_ops,
+        n_patches=len(trace),
+        n_ins_chars=int(n_ins_chars),
+        capacity=int(s + n_ins_chars),
+        batch=batch,
+        end_content=trace.end_content,
+        max_batch_ins=int(ins_per_batch.max(initial=0)),
+        chars=char_table,
+    )
+
+
 def tensorize(trace: TestData, batch: int = 256) -> TensorizedTrace:
     """Tensorize a trace with padding aligned to ``batch`` unit ops."""
     kind, pos, ch = explode_unit_ops(trace)

@@ -17,8 +17,14 @@ import numpy as np
 
 from ..engine.downstream import DownState
 from ..ops.apply import DocState
+from ..ops.apply2 import PackedState, ReplayState
 
-_CLASSES = {"DocState": DocState, "DownState": DownState}
+_CLASSES = {
+    "DocState": DocState,
+    "DownState": DownState,
+    "ReplayState": ReplayState,
+    "PackedState": PackedState,
+}
 
 
 def save_state(path: str, state) -> None:

@@ -1,0 +1,98 @@
+"""Range-op engine tests: tensorizer invariants, kernel (interpret mode on
+CPU) + apply vs the oracle, and equivalence with the exploded v3 engine."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.replay import ReplayEngine
+from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+from crdt_benches_tpu.oracle import OracleDocument
+from crdt_benches_tpu.traces.synth import synth_trace
+from crdt_benches_tpu.traces.tensorize import (
+    tensorize,
+    tensorize_ranges,
+)
+
+
+def _oracle(trace):
+    doc = OracleDocument.from_str(trace.start_content)
+    for p, d, ins in trace.iter_patches():
+        doc.replace(p, p + d, ins)
+    return doc.content()
+
+
+def test_tensorize_ranges_invariants(svelte_trace):
+    rt = tensorize_ranges(svelte_trace, batch=256)
+    tt = tensorize(svelte_trace, batch=256)
+    assert rt.capacity == tt.capacity  # same slot universe
+    assert rt.n_ins_chars == tt.n_inserts
+    assert rt.n_ops <= 2 * len(svelte_trace)
+    assert rt.n_ops < tt.n_ops  # the whole point
+    np.testing.assert_array_equal(
+        rt.chars, np.asarray(tt.ch[tt.slot >= 0])
+    ) if len(rt.init_chars) == 0 else None
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 5])
+@pytest.mark.parametrize("batch", [16, 64])
+def test_range_engine_vs_oracle_synth(seed, batch):
+    trace = synth_trace(seed=seed, n_ops=250, base="range engine test ")
+    rt = tensorize_ranges(trace, batch=batch)
+    eng = RangeReplayEngine(rt, n_replicas=2, interpret=True, chunk=4)
+    st = eng.run()
+    want = _oracle(trace)
+    assert eng.decode(st, replica=0) == want
+    assert eng.decode(st, replica=1) == want
+    assert (eng.lengths(st) == len(want)).all()
+
+
+def test_range_engine_block_edits():
+    # Big block inserts/deletes (the rustcode-style workload).
+    from crdt_benches_tpu.traces.loader import TestData, TestPatch, TestTxn
+
+    rng = np.random.default_rng(7)
+    txns = []
+    content = ""
+    for i in range(60):
+        r = rng.random()
+        pos = int(rng.integers(0, len(content) + 1))
+        if r < 0.6 or not content:
+            ins = "".join(
+                chr(97 + int(c)) for c in rng.integers(0, 26, int(rng.integers(1, 400)))
+            )
+            txns.append([[pos, 0, ins]])
+            content = content[:pos] + ins + content[pos:]
+        else:
+            d = int(rng.integers(1, min(300, len(content) - pos) + 1)) if pos < len(content) else 0
+            txns.append([[pos, d, ""]])
+            content = content[:pos] + content[pos + d:]
+    trace = TestData(
+        start_content="",
+        end_content=content,
+        txns=[
+            TestTxn(time="", patches=[TestPatch(*p) for p in t])
+            for t in txns
+        ],
+    )
+    rt = tensorize_ranges(trace, batch=16)
+    eng = RangeReplayEngine(rt, n_replicas=1, interpret=True, chunk=4)
+    st = eng.run()
+    assert eng.decode(st) == content
+
+
+def test_range_matches_exploded_v3(svelte_trace):
+    # Prefix of the real svelte trace through both engines.
+    import dataclasses
+
+    sub = dataclasses.replace(
+        svelte_trace, txns=svelte_trace.txns[:300]
+    )
+    # recompute end content via oracle for the truncated trace
+    want = _oracle(sub)
+    rt = tensorize_ranges(sub, batch=64)
+    e_r = RangeReplayEngine(rt, n_replicas=1, interpret=True, chunk=4)
+    assert e_r.decode(e_r.run()) == want
+    tt = tensorize(sub, batch=64)
+    e_v = ReplayEngine(tt, n_replicas=1, resolver="scan", engine="v3")
+    assert e_v.decode(e_v.run()) == want
