@@ -26,6 +26,11 @@ import os
 import sys
 
 
+from statistics import median as _median  # noqa: E402
+# Median sample time — matches the harness and recorded results (the
+# headline must not get the most favorable of 3 samples).
+
+
 def main() -> int:
     trace_name = os.environ.get("CRDT_BENCH_TRACE", "automerge-paper")
     samples = int(os.environ.get("CRDT_BENCH_SAMPLES", "3"))
@@ -52,7 +57,7 @@ def main() -> int:
                 assert CppCrdt.replay_patches(pa) == end_len
 
             times = measure(native_iter, warmup=1, samples=samples)
-            baseline_eps = elements / min(times)
+            baseline_eps = elements / _median(times)
     except Exception as e:  # baseline is advisory; the metric must still print
         print(f"native baseline failed: {e}", file=sys.stderr)
 
@@ -82,7 +87,7 @@ def main() -> int:
     backend = JaxReplayBackend(n_replicas=replicas, batch=batch)
     backend.prepare(trace)
     times = measure(backend.replay_once, warmup=1, samples=samples)
-    agg_eps = elements * replicas / min(times)
+    agg_eps = elements * replicas / _median(times)
 
     vs = agg_eps / baseline_eps if baseline_eps else 0.0
     print(

@@ -197,6 +197,16 @@ class CppRopeBytes(CppRope):
         lib().rope_read(self._h, out)
         return bytes(out.astype(np.uint8).tobytes()).decode("utf-8")
 
+    @staticmethod
+    def replay_patches_content(pa: PatchArrays) -> str:
+        out = np.zeros(max(pa.end_len * 2 + 16, 64), np.int32)
+        n = lib().rope_replay_read(
+            pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
+            pa.ins_flat, pa.n_patches, out, len(out),
+        )
+        # Elements are UTF-8 bytes, not codepoints.
+        return bytes(out[:n].astype(np.uint8).tobytes()).decode("utf-8")
+
 
 @register_upstream
 class CppCrdt(Upstream):
@@ -251,6 +261,33 @@ class CppCrdt(Upstream):
             pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
             pa.ins_flat, pa.n_patches,
         )
+
+
+@register_upstream
+class CppCrdtBytes(CppCrdt):
+    """Byte-addressed sequence CRDT: the yrs capability — a full CRDT whose
+    edit offsets and lengths are UTF-8 byte units (reference src/rope.rs:147
+    sets EDITS_USE_BYTE_OFFSETS for the yrs adapter; offsets are rewritten
+    via chars_to_bytes, src/main.rs:21-23).  Same native treap engine
+    (native/crdt.cpp) with each element holding one UTF-8 byte, so ``len``
+    is a byte count and positions address bytes."""
+
+    NAME = "cpp-crdt-bytes"
+    EDITS_USE_BYTE_OFFSETS = True
+
+    @classmethod
+    def from_str(cls, s: str, agent: int = 1) -> "CppCrdtBytes":
+        b = np.frombuffer(s.encode("utf-8"), np.uint8).astype(np.int32)
+        return cls(lib().crdt_new(np.ascontiguousarray(b), len(b), agent))
+
+    def insert(self, at: int, text: str) -> None:
+        b = np.frombuffer(text.encode("utf-8"), np.uint8).astype(np.int32)
+        lib().crdt_insert(self._h, at, np.ascontiguousarray(b), len(b))
+
+    def content(self) -> str:
+        out = np.zeros(len(self), np.int32)
+        lib().crdt_read(self._h, out)
+        return bytes(out.astype(np.uint8).tobytes()).decode("utf-8")
 
 
 @register_downstream

@@ -134,6 +134,92 @@ def run_downstream(trace_name: str, backend: str, samples: int,
     return None
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _oracle_content(trace_name: str) -> str:
+    """Oracle replay once per trace (it is a full per-op Python replay —
+    shared across the (group x backend) verify cells)."""
+    from ..oracle.text_oracle import replay_trace
+
+    trace = load_testing_data(trace_name)
+    want = replay_trace(trace)
+    assert want == trace.end_content, "oracle self-check failed"
+    return want
+
+
+def verify_upstream(trace_name: str, backend: str, replicas: int,
+                    batch: int) -> bool | None:
+    """Byte-identity check for one upstream cell: decode the backend's
+    final document and compare against the pure-Python oracle AND the
+    trace's endContent (upgrading the reference's length-only assert,
+    src/main.rs:35).  Returns None if the backend is unavailable."""
+    trace = load_testing_data(trace_name)
+    want = _oracle_content(trace_name)
+    native_names = _native_upstreams()
+    if backend in native_names:
+        from ..backends.native import native_available
+
+        if not native_available():
+            return None
+        cls = native_names[backend]
+        if getattr(cls, "EDITS_USE_BYTE_OFFSETS", False):
+            pa = patch_arrays(trace.chars_to_bytes(), bytes_mode=True)
+        else:
+            pa = patch_arrays(trace)
+        if hasattr(cls, "replay_patches_content"):
+            got = cls.replay_patches_content(pa)
+        else:
+            doc = cls.from_str(trace.start_content)
+            t = (
+                trace.chars_to_bytes()
+                if getattr(cls, "EDITS_USE_BYTE_OFFSETS", False)
+                else trace
+            )
+            for pos, d, ins in t.iter_patches():
+                if d:
+                    doc.remove(pos, pos + d)
+                if ins:
+                    doc.insert(pos, ins)
+            got = doc.content()
+        return got == want
+    if backend == "python-oracle":
+        return True  # the oracle is the reference point
+    if backend == "jax":
+        from ..backends.jax_backend import JaxReplayBackend
+
+        b = JaxReplayBackend(n_replicas=replicas, batch=batch)
+        b.prepare(trace)
+        b.replay_once()
+        return b.final_content() == want
+    return None
+
+
+def verify_downstream(trace_name: str, backend: str, replicas: int,
+                      batch: int) -> bool | None:
+    trace = load_testing_data(trace_name)
+    want = _oracle_content(trace_name)
+    if backend == "cpp-crdt":
+        from ..backends.native import CppCrdtDownstream, native_available
+
+        if not native_available():
+            return None
+        down, _ = CppCrdtDownstream.upstream_updates(trace)
+        down.apply_all_native()
+        return down.content() == want
+    if backend == "jax":
+        try:
+            from ..engine.downstream import JaxDownstreamBackend
+        except ImportError:
+            return None
+        b = JaxDownstreamBackend(n_replicas=replicas, batch=batch)
+        b.prepare(trace)
+        b.replay_once()
+        return b.final_content() == want
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--traces", default=",".join(TRACES))
@@ -151,7 +237,45 @@ def main(argv=None) -> int:
              "into DIR (the tracing capability Criterion leaves to external "
              "tools; view with TensorBoard/XProf)",
     )
+    ap.add_argument(
+        "--verify", action="store_true",
+        help="byte-compare every (group x trace x backend) cell's final "
+             "document against the pure-Python oracle (upgrades the "
+             "reference's length-only assert, src/main.rs:35,68); exits "
+             "nonzero on any mismatch",
+    )
+    ap.add_argument(
+        "--verify-only", action="store_true",
+        help="run --verify checks without timing anything",
+    )
     args = ap.parse_args(argv)
+
+    if args.verify or args.verify_only:
+        failures = []
+        for trace in args.traces.split(","):
+            for backend in args.backends.split(","):
+                for group, fn in (
+                    ("upstream", verify_upstream),
+                    ("downstream", verify_downstream),
+                ):
+                    if args.filter and args.filter not in group:
+                        continue
+                    ok = fn(trace, backend, args.replicas, args.batch)
+                    if ok is None:
+                        continue
+                    tag = "ok" if ok else "MISMATCH"
+                    print(
+                        f"verify {group}/{trace}/{backend}: {tag}",
+                        file=sys.stderr,
+                    )
+                    if not ok:
+                        failures.append((group, trace, backend))
+        if failures:
+            print(f"verify FAILED: {failures}", file=sys.stderr)
+            return 1
+        if args.verify_only:
+            print("verify: all cells byte-identical", file=sys.stderr)
+            return 0
 
     results: list[BenchResult] = []
     for trace in args.traces.split(","):

@@ -98,10 +98,13 @@ class DownstreamUpdates:
 
     def nbytes(self) -> int:
         """Total wire size of the update tensors (the analog of the encoded
-        update byte payloads the reference ships, src/rope.rs:199)."""
-        return sum(
-            a.nbytes for a in (self.ins_slot, self.anchor, self.rank, self.dslot)
-        )
+        update byte payloads the reference ships, src/rope.rs:199).
+        Includes the positional form (ins_gap/del_pos) when present — the
+        default timed apply path ships and consumes it, so the reported
+        payload matches what is actually integrated (ADVICE round 1)."""
+        arrays = [self.ins_slot, self.anchor, self.rank, self.dslot]
+        arrays += [a for a in (self.ins_gap, self.del_pos) if a is not None]
+        return sum(a.nbytes for a in arrays)
 
 
 def _prev_smaller(vals: np.ndarray) -> np.ndarray:

@@ -31,6 +31,45 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _stage_capacity(need: int, lane: int = 128) -> int:
+    """Smallest staged capacity >= need from a sqrt(2)-spaced grid
+    (lane * {8, 12, 16, 24, 32, ...}) — bounds wasted capacity at ~20%
+    average while keeping the number of distinct compiled shapes ~2 per
+    doubling."""
+    s = 8 * lane
+    while s < need:
+        s2 = s + s // 2
+        if s2 >= need:
+            return s2
+        s *= 2
+    return s
+
+
+def _grow_state4(state, new_cap: int):
+    """Pad a PackedState4's capacity axis to new_cap (doc pads with
+    pack_doc(-1, 0) == 2, prefix structures with zeros)."""
+    from ..ops.apply2 import LANE, PackedState4
+
+    R, C = state.doc.shape
+    if new_cap <= C:
+        return state
+    pad = new_cap - C
+    return PackedState4(
+        doc=jnp.concatenate(
+            [state.doc, jnp.full((R, pad), 2, jnp.int32)], axis=1
+        ),
+        cv_intile=jnp.concatenate(
+            [state.cv_intile, jnp.zeros((R, pad), state.cv_intile.dtype)],
+            axis=1,
+        ),
+        vis_tile=jnp.concatenate(
+            [state.vis_tile, jnp.zeros((R, pad // LANE), jnp.int32)], axis=1
+        ),
+        length=state.length,
+        nvis=state.nvis,
+    )
+
+
 #: Module-level jit so repeated decodes reuse one compilation per shape.
 decode_state_jit = jax.jit(decode_state)
 
@@ -121,12 +160,14 @@ def replay_batches_r(
     return state
 
 
-def _make_resolver(resolver: str, emit_origin: bool = True):
+def _make_resolver(
+    resolver: str, emit_origin: bool = True, token_cap: int | None = None
+):
     if resolver == "pallas":
         from ..ops.resolve_pallas import resolve_batch_pallas
 
         return lambda kind, pos, nvis: resolve_batch_pallas(
-            kind, pos, nvis, emit_origin=emit_origin
+            kind, pos, nvis, emit_origin=emit_origin, token_cap=token_cap
         )
     return lambda kind, pos, nvis: jax.vmap(
         resolve_batch, in_axes=(None, None, 0)
@@ -187,6 +228,39 @@ def replay_batches_r3(
         for i in range(K):
             resolved = resolve_r(k[i], p[i], st.nvis)
             st = apply_batch3(st, resolved, sl[i])
+        return st, None
+
+    state, _ = jax.lax.scan(
+        step, state, (rs(kind_b), rs(pos_b), rs(slot_b))
+    )
+    return state
+
+
+@partial(
+    jax.jit,
+    static_argnames=("resolver", "pack", "token_cap"),
+    donate_argnums=(0,),
+)
+def replay_batches_r4(
+    state, kind_b, pos_b, slot_b, *, resolver: str = "scan", pack: int = 4,
+    token_cap: int | None = None,
+):
+    """replay_batches_r3 on the cumvis-maintained state (apply_batch4 —
+    fused delete/expand/fill kernel, no per-batch capacity-sized cumsum)."""
+    from ..ops.apply2 import apply_batch4
+
+    resolve_r = _make_resolver(resolver, emit_origin=False, token_cap=token_cap)
+    NB, B = kind_b.shape
+    K = min(pack, NB)
+    if NB % K:
+        raise ValueError(f"batch count {NB} not a multiple of pack {K}")
+    rs = lambda x: x.reshape(NB // K, K, B)
+
+    def step(st, batch):
+        k, p, sl = batch
+        for i in range(K):
+            resolved = resolve_r(k[i], p[i], st.nvis)
+            st = apply_batch4(st, resolved, sl[i])
         return st, None
 
     state, _ = jax.lax.scan(
@@ -258,18 +332,29 @@ class ReplayEngine:
         self.tt = tt
         self.n_replicas = n_replicas
         self.capacity = _round_up(max(tt.capacity, 1), lane)
+        # Packed arithmetic preconditions (fail loudly, ADVICE round 1):
+        # tile_base/gvis travel as 3x7-bit bf16 chunks (< 2^21), packed
+        # fills shift slot ids by 2 bits (< 2^29), and the B>1024 dest sort
+        # key needs capacity * (B + 1) < 2^31.
+        if self.capacity >= 1 << 21:
+            raise ValueError(
+                f"capacity {self.capacity} >= 2^21 exceeds the packed"
+                " engine's chunked-arithmetic range"
+            )
+        if self.capacity * (tt.batch + 1) >= 1 << 31:
+            raise ValueError("capacity * (batch + 1) must fit int32")
         self.n_init = len(tt.init_chars)
         self.resolver = resolver or default_resolver()
         self.chunk = int(os.environ.get("CRDT_ENGINE_CHUNK", str(chunk)))
         #: 'v2' = scatter-free doc-order apply (ops/apply2.py, the fast
         #: path); 'v1' = the original slot-indexed apply (ops/apply.py).
-        self.engine = engine or os.environ.get("CRDT_ENGINE_APPLY", "v3")
+        self.engine = engine or os.environ.get("CRDT_ENGINE_APPLY", "v4")
         self.pack = int(os.environ.get("CRDT_ENGINE_PACK", str(pack)))
         if self.chunk % self.pack:
             self.chunk = _round_up(self.chunk, self.pack)
 
         kind_b, pos_b, _, slot_b = tt.batched()
-        if self.engine in ("v2", "v3"):
+        if self.engine in ("v2", "v3", "v4"):
             # Pad the batch count to a multiple of `pack` with PAD batches
             # (no-ops end to end) so every scan step carries `pack` batches.
             n_pad = (-tt.n_batches) % self.pack
@@ -291,6 +376,48 @@ class ReplayEngine:
         self.kind_b = jnp.asarray(kind_b)
         self.pos_b = jnp.asarray(pos_b)
         self.slot_b = jnp.asarray(slot_b)
+
+        # Capacity staging (live-prefix): every apply cost is proportional
+        # to the state capacity, but the document grows over the replay —
+        # early chunks run at a geometrically-staged capacity that covers
+        # their end-of-chunk used length (host-known: n_init + running
+        # insert count; slot ids are insertion-ordered so they always fit,
+        # traces/tensorize.py).  Each distinct stage is one extra compile.
+        self.stage_caps: list[int] = []
+        if self.engine == "v4":
+            from ..traces.tensorize import INSERT as _INS
+
+            ins_per_batch = (kind_b == _INS).sum(axis=1)
+            end_len = self.n_init + np.cumsum(ins_per_batch)
+            for i in range(0, len(kind_b), self.chunk):
+                need = int(end_len[min(i + self.chunk, len(end_len)) - 1])
+                self.stage_caps.append(
+                    min(self.capacity, _stage_capacity(need, lane))
+                )
+            # Capacities must be nondecreasing (state only ever grows).
+            for i in range(1, len(self.stage_caps)):
+                self.stage_caps[i] = max(
+                    self.stage_caps[i], self.stage_caps[i - 1]
+                )
+
+        # Per-chunk resolver token caps from the exact host simulation
+        # (ops/token_sim.py) — editing traces run near B+2 tokens, far
+        # below the 2B+2 worst case the kernel otherwise allocates.
+        self.token_caps: list[int | None] = [None] * len(self.chunks)
+        if (
+            self.engine == "v4"
+            and self.resolver == "pallas"
+            and os.environ.get("CRDT_ENGINE_TOKENSIM", "1") != "0"
+        ):
+            from ..ops.token_sim import simulate_token_counts
+
+            tc = simulate_token_counts(kind_b, pos_b, self.n_init)
+            # Round to the 128-lane grid HERE so chunks with the same
+            # rounded cap share one compiled executable.
+            self.token_caps = [
+                _round_up(int(tc[i : i + self.chunk].max()) + 8, 128)
+                for i in range(0, len(kind_b), self.chunk)
+            ]
 
         self.chars = jnp.asarray(slot_char_table(tt, self.capacity))
 
@@ -314,13 +441,33 @@ class ReplayEngine:
         engine 'v1': DocState following the fresh_state convention (no
         leading axis at R=1).
         """
-        if self.engine in ("v2", "v3"):
-            from ..ops.apply2 import init_state2, init_state3
+        if self.engine in ("v2", "v3", "v4"):
+            from ..ops.apply2 import init_state2, init_state3, init_state4
 
-            init = init_state3 if self.engine == "v3" else init_state2
-            fn = (
-                replay_batches_r3 if self.engine == "v3" else replay_batches_r2
-            )
+            init = {
+                "v2": init_state2, "v3": init_state3, "v4": init_state4
+            }[self.engine]
+            fn = {
+                "v2": replay_batches_r2,
+                "v3": replay_batches_r3,
+                "v4": replay_batches_r4,
+            }[self.engine]
+            if self.engine == "v4" and self.stage_caps:
+                st = (
+                    init(self.n_replicas, self.stage_caps[0], self.n_init)
+                    if state is None
+                    else state
+                )
+                for cap, tcap, (kind, pos, slot) in zip(
+                    self.stage_caps, self.token_caps, self.chunks
+                ):
+                    st = _grow_state4(st, cap)
+                    st = fn(
+                        st, kind, pos, slot,
+                        resolver=self.resolver, pack=self.pack,
+                        token_cap=tcap,
+                    )
+                return st
             st = (
                 init(self.n_replicas, self.capacity, self.n_init)
                 if state is None
@@ -355,13 +502,16 @@ class ReplayEngine:
         """Materialize a replica's visible document as a Python string."""
         from ..ops.apply2 import (
             PackedState,
+            PackedState4,
             ReplayState,
             decode_state2,
             decode_state3,
+            decode_state4,
         )
 
-        if isinstance(state, (ReplayState, PackedState)):
+        if isinstance(state, (ReplayState, PackedState, PackedState4)):
             dec = (
+                decode_state4 if isinstance(state, PackedState4) else
                 decode_state3 if isinstance(state, PackedState) else
                 decode_state2
             )

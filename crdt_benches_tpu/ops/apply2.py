@@ -269,35 +269,7 @@ def _mxu_spread(idx, vals_7bit_chunks, C: int):
     matmuls are exact.  On this TPU runtime a row-wise scatter-add costs
     ~53ns/row (serialized); the matmul form runs on the MXU at
     R*B*nt*128 MACs per chunk (~0.2ms at R=256, C=182k)."""
-    R, B = idx.shape
-    nt = C // LANE
-    outs = [jnp.zeros((R, C), jnp.int32) for _ in vals_7bit_chunks]
-    # Chunk the op axis so the one-hot materialization stays ~(R, 512, nt).
-    CB = 512 if B > 512 else B
-    for c0 in range(0, B, CB):
-        cb = min(CB, B - c0)
-        idx_c = jax.lax.slice_in_dim(idx, c0, c0 + cb, axis=1)
-        tq = jnp.right_shift(idx_c, 7)  # idx // 128
-        lq = jnp.bitwise_and(idx_c, 127)
-        in_range = (idx_c >= 0) & (idx_c < C)
-        oh_tile = (
-            (
-                jax.lax.broadcasted_iota(jnp.int32, (R, cb, nt), 2)
-                == tq[:, :, None]
-            )
-            & in_range[:, :, None]
-        ).astype(jnp.bfloat16)
-        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, cb, LANE), 2)
-        oh_lane = (lane_iota == lq[:, :, None]).astype(jnp.bfloat16)
-        for i, v in enumerate(vals_7bit_chunks):
-            vc = jax.lax.slice_in_dim(v, c0, c0 + cb, axis=1)
-            vb = oh_lane * vc[:, :, None].astype(jnp.bfloat16)
-            dense = jnp.einsum(
-                "rbt,rbl->rtl", oh_tile, vb,
-                preferred_element_type=jnp.float32,
-            )
-            outs[i] = outs[i] + dense.astype(jnp.int32).reshape(R, C)
-    return outs
+    return _mxu_spread_tc(idx, vals_7bit_chunks, C)[0]
 
 
 def apply_batch3(
@@ -403,6 +375,264 @@ def apply_batch3(
         length=length,
         nvis=state.nvis - n_del + n_live,
     )
+
+
+class PackedState4(NamedTuple):
+    """PackedState plus a *maintained* visibility-prefix structure.
+
+    ``cv_intile[r, c]`` is the inclusive cumsum of vis bits **within c's
+    128-lane tile** (stored bf16 — values are <= 128, exact, and the only
+    consumer is a one-hot bf16 einsum); ``vis_tile[r, t]`` is tile t's
+    total.  Together they give absolute cumvis without ever running a
+    capacity-sized cumsum in XLA: the fused apply kernel
+    (expand_pallas.apply_fused) re-emits both for the post-batch document
+    each batch.
+    """
+
+    doc: jax.Array  # int32[R, C] packed ((slot+2)<<1)|vis
+    cv_intile: jax.Array  # bfloat16[R, C]
+    vis_tile: jax.Array  # int32[R, C // LANE]
+    length: jax.Array  # int32[R]
+    nvis: jax.Array  # int32[R]
+
+
+def init_state4(n_replicas: int, capacity: int, n_init: int = 0) -> PackedState4:
+    s3 = init_state3(n_replicas, capacity, n_init)
+    R, C = s3.doc.shape
+    nt = C // LANE
+    vis = jnp.bitwise_and(s3.doc, 1).reshape(R, nt, LANE)
+    cv = jnp.cumsum(vis, axis=2)
+    return PackedState4(
+        doc=s3.doc,
+        cv_intile=cv.reshape(R, C).astype(jnp.bfloat16),
+        vis_tile=cv[:, :, LANE - 1],
+        length=s3.length,
+        nvis=s3.nvis,
+    )
+
+
+def count_le_two_level(cv_intile, tile_base, tmax_abs, q):
+    """#{i : cumvis_abs[r, i] <= q[r, b]} from the maintained two-level
+    structure: cv_intile int32[R, C] (within-tile inclusive cumsum),
+    tile_base int32[R, nt] (exclusive cross-tile prefix), tmax_abs
+    int32[R, nt] (= tile_base + tile total, nondecreasing).  Same result as
+    count_le_tiled(absolute_cumvis, q).
+
+    The crossing tile is found by a fused compare-reduce over tile maxima
+    (no materialized (R, B, nt) array); the crossing tile's row is fetched
+    with one bf16 one-hot einsum (cv_intile is stored bf16 — values
+    <= 128, exact); its cross-tile base is fetched by a FACTORED two-level
+    one-hot (tq = 128*sq + wq): contract the within-super axis first so
+    every intermediate is (R, B, ns) tiny.  take_along_axis here
+    serializes per row (~21ns each) and was the single largest XLA cost of
+    the apply step.
+    """
+    R, C = cv_intile.shape
+    B = q.shape[1]
+    nt = C // LANE
+    tiles = cv_intile.reshape(R, nt, LANE)
+    nfull = jnp.sum(
+        (tmax_abs[:, None, :] <= q[:, :, None]).astype(jnp.int32), axis=2
+    )
+    tq = jnp.minimum(nfull, nt - 1)
+    oh = (
+        jax.lax.broadcasted_iota(jnp.int32, (R, B, nt), 2) == tq[:, :, None]
+    ).astype(jnp.bfloat16)
+    rows = jnp.einsum(
+        "rbt,rtl->rbl", oh, tiles, preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+
+    ns = -(-nt // LANE)
+    pad = ns * LANE - nt
+    base_p = (
+        jnp.concatenate(
+            [tile_base, jnp.zeros((R, pad), jnp.int32)], axis=1
+        )
+        if pad
+        else tile_base
+    ).reshape(R, ns, LANE)
+    sq = jnp.right_shift(tq, 7)
+    wq = jnp.bitwise_and(tq, 127)
+    ohw = (
+        jax.lax.broadcasted_iota(jnp.int32, (R, B, LANE), 2)
+        == wq[:, :, None]
+    ).astype(jnp.bfloat16)
+    ssel = (
+        jax.lax.broadcasted_iota(jnp.int32, (R, B, ns), 2) == sq[:, :, None]
+    )
+    base = jnp.zeros((R, B), jnp.int32)
+    for k in range(3):  # tile_base < 2**21 (capacity bound)
+        chunk = jnp.bitwise_and(
+            jnp.right_shift(base_p, 7 * k), 127
+        ).astype(jnp.bfloat16)
+        tmp = jnp.einsum(
+            "rbw,rsw->rbs", ohw, chunk, preferred_element_type=jnp.float32
+        ).astype(jnp.int32)
+        base = base + jnp.left_shift(
+            jnp.sum(jnp.where(ssel, tmp, 0), axis=2), 7 * k
+        )
+    within = jnp.sum(
+        (rows + base[:, :, None] <= q[:, :, None]).astype(jnp.int32), axis=2
+    )
+    return jnp.where(nfull >= nt, C, nfull * LANE + within)
+
+
+def _excl_cumsum_small(x):
+    """Exclusive cumsum along axis=1 of a small (R, nt) array."""
+    inc = jnp.cumsum(x, axis=1)
+    return inc - x
+
+
+def _mxu_spread_tc(idx, vals_7bit_chunks, C: int):
+    """_mxu_spread that additionally returns the per-tile index counts
+    (int32[R, nt]) — reused by the fused kernel's cross-tile cnt base."""
+    R, B = idx.shape
+    nt = C // LANE
+    outs = [jnp.zeros((R, C), jnp.int32) for _ in vals_7bit_chunks]
+    tcount = jnp.zeros((R, nt), jnp.int32)
+    CB = 512 if B > 512 else B
+    for c0 in range(0, B, CB):
+        cb = min(CB, B - c0)
+        idx_c = jax.lax.slice_in_dim(idx, c0, c0 + cb, axis=1)
+        tq = jnp.right_shift(idx_c, 7)
+        lq = jnp.bitwise_and(idx_c, 127)
+        in_range = (idx_c >= 0) & (idx_c < C)
+        oh_tile = (
+            (
+                jax.lax.broadcasted_iota(jnp.int32, (R, cb, nt), 2)
+                == tq[:, :, None]
+            )
+            & in_range[:, :, None]
+        ).astype(jnp.bfloat16)
+        tcount = tcount + jnp.sum(oh_tile, axis=1).astype(jnp.int32)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (R, cb, LANE), 2)
+        oh_lane = (lane_iota == lq[:, :, None]).astype(jnp.bfloat16)
+        for i, v in enumerate(vals_7bit_chunks):
+            vc = jax.lax.slice_in_dim(v, c0, c0 + cb, axis=1)
+            vb = oh_lane * vc[:, :, None].astype(jnp.bfloat16)
+            dense = jnp.einsum(
+                "rbt,rbl->rtl", oh_tile, vb,
+                preferred_element_type=jnp.float32,
+            )
+            outs[i] = outs[i] + dense.astype(jnp.int32).reshape(R, C)
+    return outs, tcount
+
+
+def apply_batch4(
+    state: PackedState4, resolved: ResolvedBatch, slots: jax.Array
+) -> PackedState4:
+    """apply_batch3 with (a) cumvis read from the maintained two-level
+    structure instead of a per-batch (R, C) cumsum, and (b) delete-apply +
+    expansion + fill + next-batch cumvis emission fused into one Pallas
+    kernel (expand_pallas.apply_fused).  Falls back to plain XLA off-TPU.
+    """
+    R, C = state.doc.shape
+    B = slots.shape[0]
+    nt = C // LANE
+    drop = jnp.int32(C + 7)
+
+    tile_base = _excl_cumsum_small(state.vis_tile)
+    tmax_abs = tile_base + state.vis_tile
+
+    dr = resolved.del_rank
+    has_del = dr >= 0
+    dphys = jnp.where(
+        has_del,
+        count_le_two_level(
+            state.cv_intile, tile_base, tmax_abs, jnp.where(has_del, dr, 0)
+        ),
+        drop,
+    )
+
+    is_ins = resolved.ins_gvis >= 0
+    gv = resolved.ins_gvis
+    g_phys = jnp.where(
+        gv >= state.nvis[:, None],
+        state.length[:, None],
+        count_le_two_level(
+            state.cv_intile, tile_base, tmax_abs, jnp.where(is_ins, gv, 0)
+        ),
+    )
+    g_phys = jnp.where(is_ins, g_phys, drop)
+    if B <= 1024:
+        smaller = (
+            (g_phys[:, :, None] > g_phys[:, None, :]) & is_ins[:, None, :]
+        )
+        n_before = jnp.sum(smaller.astype(jnp.int32), axis=2)
+        dest = jnp.where(is_ins, g_phys + n_before + resolved.ins_seq, drop)
+    else:
+        key = jnp.where(
+            is_ins,
+            g_phys * jnp.int32(B + 1) + resolved.ins_seq,
+            jnp.int32(2**31 - 1),
+        )
+        perm = jnp.argsort(key, axis=1, stable=True)
+        rank = jnp.argsort(perm, axis=1, stable=True).astype(jnp.int32)
+        dest = jnp.where(is_ins, g_phys + rank, drop)
+
+    (del_ind,), _ = _mxu_spread_tc(dphys, [has_del.astype(jnp.int32)], C)
+    # XLA fuses this subtraction into the spread epilogue — one HBM write.
+    doc_predel = state.doc - del_ind
+
+    slots_b = jnp.broadcast_to(slots[None, :], (R, B))
+    fill = jnp.where(
+        is_ins, pack_doc(slots_b, resolved.ins_alive.astype(jnp.int32)), 0
+    )
+    # combo = (fill << 1) | ind as one dense array: the low bit is the
+    # insert-destination indicator, the rest the packed fill value.  The 4
+    # chunks below cover combo bits 0..27, i.e. fill < 2**27 — guaranteed
+    # by the capacity < 2**21 assertion at engine construction
+    # (fill = ((slot + 2) << 1) | vis < 4 * capacity).
+    chunks = [
+        jnp.bitwise_and(fill, 63) * 2 + 1,
+        jnp.bitwise_and(jnp.right_shift(fill, 6), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 13), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 20), 127),
+    ]
+    (c0, c1, c2, c3), ind_tcount = _mxu_spread_tc(dest, chunks, C)
+    combo = (
+        c0
+        + jnp.left_shift(c1, 7)
+        + jnp.left_shift(c2, 14)
+        + jnp.left_shift(c3, 21)
+    )
+    cnt_base = _excl_cumsum_small(ind_tcount)
+
+    n_ins = jnp.sum(is_ins.astype(jnp.int32), axis=1)
+    n_live = jnp.sum((is_ins & resolved.ins_alive).astype(jnp.int32), axis=1)
+    n_del = jnp.sum(has_del.astype(jnp.int32), axis=1)
+    length = state.length + n_ins
+
+    nbits = max(1, (B).bit_length())
+    from .expand_pallas import (
+        FUSED_STACK_BYTES_PER_POS,
+        apply_fused,
+        apply_fused_xla,
+    )
+
+    if (
+        jax.default_backend() == "tpu"
+        and FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20
+    ):
+        doc, cv, vt = apply_fused(
+            doc_predel, combo, cnt_base, length, nbits=nbits
+        )
+    else:
+        doc, cv, vt = apply_fused_xla(
+            doc_predel, combo, cnt_base, length, nbits=nbits
+        )
+    return PackedState4(
+        doc=doc,
+        cv_intile=cv,
+        vis_tile=vt,
+        length=length,
+        nvis=state.nvis - n_del + n_live,
+    )
+
+
+def decode_state4(state: PackedState4, chars: jax.Array, replica: int = 0):
+    s3 = PackedState(doc=state.doc, length=state.length, nvis=state.nvis)
+    return decode_state3(s3, chars, replica)
 
 
 def decode_state3(state: PackedState, chars: jax.Array, replica: int = 0):

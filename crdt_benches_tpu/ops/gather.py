@@ -1,0 +1,108 @@
+"""Exact MXU one-hot gathers.
+
+On this TPU runtime, arbitrary-index gathers (``take_along_axis``) lower to
+a per-row serialized loop (~21ns per gathered row — measured; see README
+environment notes), so a (R, B) gather costs R*B*21ns regardless of how
+little data moves.  A one-hot bf16 matmul performs the same gather on the
+MXU: the one-hot operand is exact in bf16, each output receives exactly one
+contribution (so accumulation order is irrelevant), and integer values are
+split into 7-bit chunks (<= 127, exact in bf16) and recombined.
+
+These helpers are the gather-side twins of apply2._mxu_spread (the
+scatter side), used by the resolver post-extraction and the two-level
+rank->position queries.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _n_chunks(max_value: int) -> int:
+    n = 1
+    while (1 << (7 * n)) <= max_value:
+        n += 1
+    return n
+
+
+def onehot_gather_vec(src, idx, *, max_value: int):
+    """out[r, b] = src[r, idx[r, b]] for int32 src in [0, max_value].
+
+    src: int32[R, N]; idx: int32[R, B] (out-of-range -> 0).
+    """
+    R, N = src.shape
+    B = idx.shape[1]
+    oh = (
+        (
+            jax.lax.broadcasted_iota(jnp.int32, (R, B, N), 2)
+            == idx[:, :, None]
+        )
+        & (idx >= 0)[:, :, None]
+        & (idx < N)[:, :, None]
+    ).astype(jnp.bfloat16)
+    out = jnp.zeros((R, B), jnp.int32)
+    for k in range(_n_chunks(max_value)):
+        chunk = jnp.bitwise_and(
+            jnp.right_shift(src, 7 * k), 127
+        ).astype(jnp.bfloat16)
+        part = jnp.einsum(
+            "rbn,rn->rb", oh, chunk, preferred_element_type=jnp.float32
+        ).astype(jnp.int32)
+        out = out + jnp.left_shift(part, 7 * k)
+    return out
+
+
+def onehot_gather_rows(tiles, tq, *, max_value: int):
+    """rows[r, b, :] = tiles[r, tq[r, b], :] for int32 tiles in
+    [0, max_value].  tiles: int32[R, nt, L]; tq: int32[R, B] (out-of-range
+    -> 0 rows)."""
+    R, nt, L = tiles.shape
+    B = tq.shape[1]
+    oh = (
+        (
+            jax.lax.broadcasted_iota(jnp.int32, (R, B, nt), 2)
+            == tq[:, :, None]
+        )
+        & (tq >= 0)[:, :, None]
+        & (tq < nt)[:, :, None]
+    ).astype(jnp.bfloat16)
+    out = jnp.zeros((R, B, L), jnp.int32)
+    for k in range(_n_chunks(max_value)):
+        chunk = jnp.bitwise_and(
+            jnp.right_shift(tiles, 7 * k), 127
+        ).astype(jnp.bfloat16)
+        part = jnp.einsum(
+            "rbt,rtl->rbl", oh, chunk, preferred_element_type=jnp.float32
+        ).astype(jnp.int32)
+        out = out + jnp.left_shift(part, 7 * k)
+    return out
+
+
+def onehot_gather_vec_multi(srcs_and_maxes, idx):
+    """Gather several (R, N) sources at the same indices, sharing one
+    one-hot operand.  srcs_and_maxes: list of (src, max_value)."""
+    R, N = srcs_and_maxes[0][0].shape
+    B = idx.shape[1]
+    oh = (
+        (
+            jax.lax.broadcasted_iota(jnp.int32, (R, B, N), 2)
+            == idx[:, :, None]
+        )
+        & (idx >= 0)[:, :, None]
+        & (idx < N)[:, :, None]
+    ).astype(jnp.bfloat16)
+    outs = []
+    for src, max_value in srcs_and_maxes:
+        out = jnp.zeros((R, B), jnp.int32)
+        for k in range(_n_chunks(max_value)):
+            chunk = jnp.bitwise_and(
+                jnp.right_shift(src, 7 * k), 127
+            ).astype(jnp.bfloat16)
+            part = jnp.einsum(
+                "rbn,rn->rb", oh, chunk,
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            out = out + jnp.left_shift(part, 7 * k)
+        outs.append(out)
+    return outs

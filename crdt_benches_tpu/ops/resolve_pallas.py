@@ -58,9 +58,48 @@ def _roll1(x):
     return jnp.concatenate([x[:, -1:], x[:, :-1]], axis=1)
 
 
+def _shiftl(x, s: int, fill):
+    """out[:, i] = x[:, i+s] (tail filled with `fill`)."""
+    pad = jnp.full((x.shape[0], s), fill, x.dtype)
+    return jnp.concatenate([x[:, s:], pad], axis=1)
+
+
+def _shiftr(x, s: int, fill):
+    """out[:, i] = x[:, i-s] (head filled with `fill`)."""
+    pad = jnp.full((x.shape[0], s), fill, x.dtype)
+    return jnp.concatenate([pad, x[:, : x.shape[1] - s]], axis=1)
+
+
+def _suffix_min(x, T: int, big):
+    for b in range(T.bit_length()):
+        s = 1 << b
+        if s >= T:
+            break
+        x = jnp.minimum(x, _shiftl(x, s, big))
+    return x
+
+
+def _cummax_incl(x, T: int, small):
+    for b in range(T.bit_length()):
+        s = 1 << b
+        if s >= T:
+            break
+        x = jnp.maximum(x, _shiftr(x, s, small))
+    return x
+
+
+def _cumsum_incl(x, T: int):
+    for b in range(T.bit_length()):
+        s = 1 << b
+        if s >= T:
+            break
+        x = x + _shiftr(x, s, 0)
+    return x
+
+
 def _kernel(kind_ref, pos_ref, v0_ref,
             drank_ref, origin_ref, dbatch_ref,
-            opos_ref, ttype_ref, ta_ref, tlen_ref,
+            opos_ref, gvis_ref, seq_ref,
             *, B: int, T: int, Rt: int, emit_origin: bool = True):
     lane_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, T), 1)
     lane_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
@@ -77,15 +116,17 @@ def _kernel(kind_ref, pos_ref, v0_ref,
     # serialize per row (~19ms/batch measured); gathers vectorize.
     opos_ref[:] = jnp.zeros((Rt, B), jnp.int32)
 
-    # Initial token list: one RUN(0, v0) then FREE; cum is flat at v0.
-    ttype0 = jnp.where(lane_t == 0, RUN, FREE)
-    ta0 = jnp.zeros((Rt, T), jnp.int32)
+    # The token type (2 bits) and token attribute `ta` travel PACKED as
+    # tta = (ta << 2) | ttype — one place() pass instead of two, and one
+    # masked-sum extraction instead of two.  Initial token list: one
+    # RUN(0, v0) then FREE; cum is flat at v0.
+    tta0 = jnp.where(lane_t == 0, RUN, FREE)  # ta = 0 everywhere
     cum0 = jnp.broadcast_to(v0, (Rt, T))
     total0 = v0  # (Rt, 1)
     nused0 = jnp.ones((Rt, 1), jnp.int32)
 
     def body(j, carry):
-        ttype, ta, cum, total, nused = carry
+        tta, cum, total, nused = carry
         jj = jnp.int32(j)
         opmask = (lane_b == jj).astype(jnp.int32)
         k = jnp.sum(kind_v * opmask, axis=1, keepdims=True)  # (1, 1)
@@ -100,11 +141,13 @@ def _kernel(kind_ref, pos_ref, v0_ref,
         t = jnp.sum((cum <= p).astype(jnp.int32), axis=1, keepdims=True)
         t = jnp.minimum(t, nused)
         m_t = lane_t == t
-        m_tm1 = lane_t == (t - 1)
         c_t = jnp.sum(jnp.where(m_t, cum, 0), axis=1, keepdims=True)
-        pre = jnp.sum(jnp.where(m_tm1, cum, 0), axis=1, keepdims=True)
-        a = jnp.sum(jnp.where(m_t, ta, 0), axis=1, keepdims=True)
-        tt = jnp.sum(jnp.where(m_t, ttype, 0), axis=1, keepdims=True)
+        pre = jnp.sum(
+            jnp.where(lane_t == (t - 1), cum, 0), axis=1, keepdims=True
+        )
+        tta_t = jnp.sum(jnp.where(m_t, tta, 0), axis=1, keepdims=True)
+        a = jnp.right_shift(tta_t, 2)
+        tt = jnp.bitwise_and(tta_t, 3)
         off = p - pre
         hit_run = tt == RUN
         split = is_ins & (off > 0)
@@ -117,21 +160,29 @@ def _kernel(kind_ref, pos_ref, v0_ref,
         )
         delta = jnp.where(is_ins, 1, 0) - jnp.where(is_del, 1, 0)
 
-        n0t = jnp.where(
+        jj4 = jj * 4
+        n0 = jnp.where(
             is_ins,
-            jnp.where(split, RUN, TINS),
-            jnp.where(is_del, jnp.where(hit_run, RUN, TDEAD), tt),
+            jnp.where(split, a * 4 + RUN, jj4 + TINS),
+            jnp.where(
+                is_del,
+                jnp.where(hit_run, a * 4 + RUN, a * 4 + TDEAD),
+                tta_t,
+            ),
         )
-        n0a = jnp.where(is_ins & ~split, jj, a)
         n0c = jnp.where(
             is_ins,
             jnp.where(split, p, pre + 1),
             jnp.where(is_del, jnp.where(hit_run, p, pre), c_t),
         )
-        n1t = jnp.where(is_ins, jnp.where(split, TINS, tt), RUN)
-        n1a = jnp.where(is_ins, jnp.where(split, jj, a), a + off + 1)
+        n1 = jnp.where(
+            is_ins,
+            jnp.where(split, jj4 + TINS, tta_t),
+            (a + off + 1) * 4 + RUN,
+        )
         n1c = jnp.where(is_ins, jnp.where(split, p + 1, c_t + 1), c_t - 1)
-        n2t, n2a, n2c = jnp.int32(RUN), a + off, c_t + 1
+        n2 = (a + off) * 4 + RUN
+        n2c = c_t + 1
 
         m2 = m >= 2
         m3 = m == 3
@@ -145,8 +196,7 @@ def _kernel(kind_ref, pos_ref, v0_ref,
             out = jnp.where(m3 & (lane_t == t + 2), x2, out)
             return out
 
-        ttype_n = place(ttype, n0t, n1t, n2t, 0)
-        ta_n = place(ta, n0a, n1a, n2a, 0)
+        tta_n = place(tta, n0, n1, n2, 0)
         cum_n = place(cum, n0c, n1c, n2c, delta)
 
         # Per-op outputs (column j).
@@ -164,10 +214,12 @@ def _kernel(kind_ref, pos_ref, v0_ref,
             pre_tp = jnp.sum(
                 jnp.where(lane_t == tp - 1, cum, 0), axis=1, keepdims=True
             )
-            a_tp = jnp.sum(jnp.where(m_tp, ta, 0), axis=1, keepdims=True)
-            tt_tp = jnp.sum(jnp.where(m_tp, ttype, 0), axis=1, keepdims=True)
+            tta_tp = jnp.sum(jnp.where(m_tp, tta, 0), axis=1, keepdims=True)
+            a_tp = jnp.right_shift(tta_tp, 2)
             origin_char = jnp.where(
-                tt_tp == RUN, a_tp + (p - 1 - pre_tp), ORIGIN_BATCH + a_tp
+                jnp.bitwise_and(tta_tp, 3) == RUN,
+                a_tp + (p - 1 - pre_tp),
+                ORIGIN_BATCH + a_tp,
             )
             origin = jnp.where(
                 is_ins, jnp.where(p == 0, -1, origin_char), -2
@@ -188,18 +240,45 @@ def _kernel(kind_ref, pos_ref, v0_ref,
         )
         opos_ref[:] = jnp.where(colm, jnp.where(split, t + 1, t), shifted_opos)
 
-        return ttype_n, ta_n, cum_n, total + delta, nused + (m - 1)
+        return tta_n, cum_n, total + delta, nused + (m - 1)
 
-    ttype, ta, cum, _, _ = jax.lax.fori_loop(
-        0, B, body, (ttype0, ta0, cum0, total0, nused0)
+    tta, cum, _, _ = jax.lax.fori_loop(
+        0, B, body, (tta0, cum0, total0, nused0)
     )
-    ttype_ref[:] = ttype
-    ta_ref[:] = ta
-    tlen_ref[:] = cum - jnp.where(lane_t == 0, 0, _roll1(cum))
+
+    # ---- token-space extraction, fused in-kernel (ops/resolve.py
+    # `extract_from_tokens` semantics; everything below is log-shift
+    # passes over the VMEM-resident (Rt, T) arrays, replacing XLA-level
+    # cummin/cummax/cumsum passes and their layout copies) ----
+    big = jnp.int32(1 << 30)
+    ttype = jnp.bitwise_and(tta, 3)
+    ta = jnp.right_shift(tta, 2)
+    tlen = cum - jnp.where(lane_t == 0, 0, _roll1(cum))
+    is_instok = (ttype == TINS) | (ttype == TDEAD)
+
+    # Per token: rank of the first surviving pre-batch char to its right.
+    run_start = jnp.where((ttype == RUN) & (tlen > 0), ta, big)
+    nxt = _suffix_min(_shiftl(run_start, 1, big), T, big)
+    gvis_tok = jnp.where(nxt >= big, v0, nxt)
+
+    # Tie-break rank among instok tokens sharing a gap.  gvis_tok is
+    # nondecreasing (suffix-min), so a masked cummax carries the previous
+    # instok token's gvis.
+    inst_i = is_instok.astype(jnp.int32)
+    ci = _cumsum_incl(inst_i, T)
+    pg = _cummax_incl(jnp.where(is_instok, gvis_tok, -1), T, -1)
+    prev_gvis = _shiftr(pg, 1, -1)
+    boundary = is_instok & (prev_gvis != gvis_tok)
+    base = jnp.where(boundary, ci - 1, -1)
+    seq_tok = ci - 1 - _cummax_incl(base, T, -1)
+
+    gvis_ref[:] = gvis_tok
+    seq_ref[:] = seq_tok
 
 
 @functools.partial(
-    jax.jit, static_argnames=("replica_tile", "interpret", "emit_origin")
+    jax.jit,
+    static_argnames=("replica_tile", "interpret", "emit_origin", "token_cap"),
 )
 def resolve_batch_pallas(
     kind: jax.Array,
@@ -209,15 +288,31 @@ def resolve_batch_pallas(
     replica_tile: int = 32,
     interpret: bool = False,
     emit_origin: bool = True,
+    token_cap: int | None = None,
 ) -> ResolvedBatch:
     """Resolve one op batch for R replicas in one fused kernel.
 
     ``kind``/``pos``: int32[B] (shared op stream); ``v0``: int32[R] per-replica
     visible lengths.  Returns a ResolvedBatch whose leaves are (R, B).
+
+    ``token_cap`` caps the VMEM token list below the 2B+2 worst case when
+    the caller KNOWS the batch's final token count (host-side exact
+    simulation, ops/token_sim.py — editing traces sit near B+2, typing
+    appends replace one token by two at off == 0).  Kernel cost is linear
+    in the list size, so this nearly halves resolver time.  An undersized
+    cap silently corrupts results — callers must use the simulation, and
+    verify modes byte-check against the oracle.
     """
     B = kind.shape[0]
     R = v0.shape[0]
-    T = _round_up(2 * B + 2, 128)
+    if R > 8 and R % 8:
+        # Mosaic blocks need a sublane dim that is a multiple of 8 (or the
+        # whole array); reject rather than silently miscompile (pad the
+        # replica axis at the caller).
+        raise ValueError(f"n_replicas must be a multiple of 8 (got {R})")
+    T = _round_up(
+        min(2 * B + 2, token_cap) if token_cap else 2 * B + 2, 128
+    )
     # Scoped-VMEM budget: ~10 live (Rt, T) + ~6 (Rt, B) int32 arrays
     # (carries, roll temps, output blocks).  Power of two, >= 8 when R >= 8
     # (sublane-dim block constraint), dividing R.
@@ -225,6 +320,7 @@ def resolve_batch_pallas(
     Rt = 1 << (Rt.bit_length() - 1)
     while R % Rt:
         Rt //= 2
+    Rt = max(Rt, min(R, 8))  # sublane-dim floor (R <= 8 uses the whole array)
 
     kernel = functools.partial(
         _kernel, B=B, T=T, Rt=Rt, emit_origin=emit_origin
@@ -244,16 +340,14 @@ def resolve_batch_pallas(
             pl.BlockSpec((Rt, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, B), jnp.int32),  # del_rank
             jax.ShapeDtypeStruct((R, B), jnp.int32),  # origin
             jax.ShapeDtypeStruct((R, B), jnp.int32),  # del_batch
             jax.ShapeDtypeStruct((R, B), jnp.int32),  # opos
-            jax.ShapeDtypeStruct((R, T), jnp.int32),  # ttype
-            jax.ShapeDtypeStruct((R, T), jnp.int32),  # ta
-            jax.ShapeDtypeStruct((R, T), jnp.int32),  # tlen
+            jax.ShapeDtypeStruct((R, T), jnp.int32),  # gvis_tok
+            jax.ShapeDtypeStruct((R, T), jnp.int32),  # seq_tok
         ],
         interpret=interpret,
     )(
@@ -261,10 +355,10 @@ def resolve_batch_pallas(
         pos.reshape(1, B).astype(jnp.int32),
         v0.reshape(R, 1).astype(jnp.int32),
     )
-    del_rank, origin, del_batch, opos, ttype, ta, tlen = out
+    del_rank, origin, del_batch, opos, gvis_tok, seq_tok = out
 
     ins_gvis, ins_seq, ins_alive = _extract_gather(
-        ttype, ta, tlen, v0, opos, origin
+        gvis_tok, seq_tok, opos, origin, del_batch
     )
     return ResolvedBatch(
         del_rank=del_rank,
@@ -276,50 +370,71 @@ def resolve_batch_pallas(
     )
 
 
-def _extract_gather(ttype, ta, tlen, v0, opos, origin):
-    """Scatter-free post-extraction: same results as
-    ``resolve.extract_from_tokens`` but per-op values are GATHERED from token
-    space at the kernel-tracked per-op token positions (TPU scatters
-    serialize per row; gathers vectorize).  All args replica-batched:
-    ttype/ta/tlen int32[R, T], v0 int32[R], opos/origin int32[R, B].
+def _gather_token_space(srcs_and_maxes, at):
+    """val[r, b] = src[r, at[r, b]] for (R, T) int32 sources, T a multiple
+    of 128.  Lane-first one-hot einsum: contract the lane axis with a shared
+    (R, B, 128) bf16 one-hot (tiny (R, B, T/128) outputs), then select the
+    tile elementwise.  Exact: each value is 7-bit-chunked (<= 127, exact in
+    bf16) and every output receives exactly one contribution.  ~25x cheaper
+    than take_along_axis, which serializes per gathered row on this TPU.
     """
-    R, T = ttype.shape
-    big = np.int32(1 << 30)
-    is_instok = (ttype == TINS) | (ttype == TDEAD)
-    # Per token: rank of the first surviving pre-batch char to its right.
-    run_start = jnp.where((ttype == RUN) & (tlen > 0), ta, big)
-    suff = jax.lax.cummin(run_start, axis=1, reverse=True)
-    nxt = jnp.concatenate(
-        [suff[:, 1:], jnp.full((R, 1), big, jnp.int32)], axis=1
+    R, T = srcs_and_maxes[0][0].shape
+    B = at.shape[1]
+    ntt = T // 128
+    tq = jnp.right_shift(at, 7)
+    lq = jnp.bitwise_and(at, 127)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, B, 128), 2)
+    ohl = (lane == lq[:, :, None]).astype(jnp.bfloat16)
+    tsel = (
+        jax.lax.broadcasted_iota(jnp.int32, (R, B, ntt), 2) == tq[:, :, None]
     )
-    gvis_tok = jnp.where(nxt >= big, v0[:, None], nxt)
+    outs = []
+    for src, max_value in srcs_and_maxes:
+        srcv = src.reshape(R, ntt, 128)
+        val = jnp.zeros((R, B), jnp.int32)
+        k = 0
+        while (1 << (7 * k)) <= max_value:
+            chunk = jnp.bitwise_and(
+                jnp.right_shift(srcv, 7 * k), 127
+            ).astype(jnp.bfloat16)
+            tmp = jnp.einsum(
+                "rbl,rtl->rbt", ohl, chunk,
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            part = jnp.sum(jnp.where(tsel, tmp, 0), axis=2)
+            val = val + jnp.left_shift(part, 7 * k)
+            k += 1
+        outs.append(val)
+    return outs
 
-    # Tie-break rank among instok tokens sharing a gap (same-gap instok
-    # tokens are contiguous up to zero-length RUN remnants, which cummax
-    # skips — see resolve.extract_from_tokens).
-    tpos = jax.lax.broadcasted_iota(jnp.int32, (R, T), 1)
-    ci = jnp.cumsum(is_instok.astype(jnp.int32), axis=1)
-    prev_ipos = jax.lax.cummax(jnp.where(is_instok, tpos, -1), axis=1)
-    prev_ipos = jnp.concatenate(
-        [jnp.full((R, 1), -1, jnp.int32), prev_ipos[:, :-1]], axis=1
-    )
-    prev_gvis = jnp.where(
-        prev_ipos >= 0,
-        jnp.take_along_axis(gvis_tok, jnp.clip(prev_ipos, 0), axis=1),
-        -1,
-    )
-    boundary = is_instok & ((prev_ipos < 0) | (prev_gvis != gvis_tok))
-    base = jnp.where(boundary, ci - 1, -1)
-    seq_tok = ci - 1 - jax.lax.cummax(base, axis=1)
 
+def _extract_gather(gvis_tok, seq_tok, opos, origin, del_batch):
+    """Per-op extraction from the kernel-emitted token-space values: gather
+    at the kernel-tracked per-op token positions via exact one-hot MXU
+    einsums (take_along_axis serializes per row on this TPU — measured
+    ~21ns/row, ~4ms/batch at R=128, B=512).  All args replica-batched:
+    gvis_tok/seq_tok int32[R, T], opos/origin/del_batch int32[R, B].
+    """
+    R, T = gvis_tok.shape
+    B = opos.shape[1]
     # Per-op gathers at the tracked token positions.
     is_ins_op = origin != -2  # origin is -2 exactly for non-insert ops
     at = jnp.clip(opos, 0, T - 1)
-    g = jnp.take_along_axis(gvis_tok, at, axis=1)
-    s = jnp.take_along_axis(seq_tok, at, axis=1)
-    tt_at = jnp.take_along_axis(ttype, at, axis=1)
+    g, s = _gather_token_space(
+        [(gvis_tok, 1 << 21), (seq_tok, max(B - 1, 1))], at
+    )
+    # An insert is alive unless a later same-batch delete killed it — the
+    # kernel names the killed batch index in del_batch (avoids gathering
+    # ttype at opos).
+    killed = jnp.sum(
+        (
+            del_batch[:, :, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (R, B, B), 2)
+        ).astype(jnp.int32),
+        axis=1,
+    ) > 0
     return (
         jnp.where(is_ins_op, g, -1),
         jnp.where(is_ins_op, s, 0),
-        is_ins_op & (tt_at == TINS),
+        is_ins_op & ~killed,
     )

@@ -1,0 +1,117 @@
+"""Host-side exact simulation of resolver token-list growth.
+
+The fused resolver kernel (ops/resolve_pallas.py) sizes its VMEM token list
+as T = 2B + 2 — the worst case (every op adds two tokens).  Real editing
+traces are far below that bound most of the time (typing bursts add 2
+tokens per op only when they split a run), and resolver cost is linear in
+T, so the engine picks T per chunk from this simulation.
+
+Token growth is replica-independent: it depends only on (kind, pos) and
+the batch-start visible length v0 — both host-known for an upstream replay
+(v0 per batch = n_init + running insert count minus deletes... tracked by
+the same simulation).  The growth rule replicated here is exactly the
+m-token replacement of ops/resolve.py `resolve_batch` (differentially
+tested against the Pallas kernel): the simulation carries (ttype, tlen)
+per token and counts tokens; `required_T[b]` = token count at the end of
+batch b, which dominates every in-batch write index (writes go to
+t + 2 <= nused + 2 and nused is nondecreasing).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..traces.tensorize import DELETE, INSERT
+from .resolve import FREE, RUN, TDEAD, TINS
+
+
+@functools.partial(jax.jit, static_argnames=("B",), backend="cpu")
+def _sim_batches(kind_b, pos_b, v0_b, *, B: int):
+    """kind_b/pos_b: int32[nb, B]; v0_b: int32[nb] batch-start visible
+    lengths.  Returns int32[nb] final token counts."""
+    T = 2 * B + 2
+
+    def batch_sim(kind, pos, v0):
+        ttype0 = jnp.zeros(T, jnp.int32).at[0].set(RUN)
+        tlen0 = jnp.zeros(T, jnp.int32).at[0].set(v0)
+        didx = jnp.arange(T, dtype=jnp.int32)
+
+        def step(carry, op):
+            ttype, tlen, nused = carry
+            k, p = op
+            is_ins = k == INSERT
+            cum = jnp.cumsum(tlen)
+            total = cum[-1]
+            p = jnp.clip(p, 0, total)
+            is_del = (k == DELETE) & (p < total)
+            t = jnp.searchsorted(cum, p, side="right").astype(jnp.int32)
+            t = jnp.minimum(t, nused)
+            off = p - (cum[t] - tlen[t])
+            tt = ttype[t]
+            ln = tlen[t]
+            hit_run = tt == RUN
+            split = is_ins & (off > 0)
+            m = jnp.where(
+                is_ins,
+                jnp.where(split, 3, 2),
+                jnp.where(is_del, jnp.where(hit_run, 2, 1), 1),
+            )
+            n0t = jnp.where(
+                is_ins,
+                jnp.where(split, RUN, TINS),
+                jnp.where(is_del, jnp.where(hit_run, RUN, TDEAD), tt),
+            )
+            n0l = jnp.where(
+                is_ins,
+                jnp.where(split, off, 1),
+                jnp.where(is_del, jnp.where(hit_run, off, 0), ln),
+            )
+            n1t = jnp.where(is_ins, jnp.where(split, TINS, tt), RUN)
+            n1l = jnp.where(is_ins, jnp.where(split, 1, ln), ln - off - 1)
+            n2t, n2l = RUN, ln - off
+
+            src = jnp.clip(didx - (m - 1), 0, T - 1)
+
+            def place(old, shifted, x0, x1, x2):
+                out = jnp.where(didx < t, old, shifted)
+                out = jnp.where(didx == t, x0, out)
+                out = jnp.where((m >= 2) & (didx == t + 1), x1, out)
+                out = jnp.where((m == 3) & (didx == t + 2), x2, out)
+                return out
+
+            ttype_n = place(ttype, ttype[src], n0t, n1t, n2t)
+            tlen_n = place(tlen, tlen[src], n0l, n1l, n2l)
+            return (ttype_n, tlen_n, nused + m - 1), None
+
+        (_, _, nused), _ = jax.lax.scan(
+            step, (ttype0, tlen0, jnp.int32(1)),
+            (kind, pos),
+        )
+        return nused
+
+    return jax.vmap(batch_sim)(kind_b, pos_b, v0_b)
+
+
+def simulate_token_counts(
+    kind_b: np.ndarray, pos_b: np.ndarray, n_init: int
+) -> np.ndarray:
+    """Final resolver token count per batch for an upstream replay starting
+    from ``n_init`` visible chars.  Host-side (CPU jit), prepare-time only.
+    """
+    nb, B = kind_b.shape
+    ins = (kind_b == INSERT).sum(axis=1)
+    # Visible length at batch start: inserts minus applied deletes.  The
+    # sim itself clamps out-of-range deletes, and v0 only matters through
+    # position clamping — use the oracle-consistent visible count (every
+    # in-range delete applies; traces are well-formed by construction).
+    dels = (kind_b == DELETE).sum(axis=1)
+    end_vis = n_init + np.cumsum(ins - dels)
+    v0 = np.concatenate([[n_init], end_vis[:-1]]).astype(np.int32)
+    out = _sim_batches(
+        jnp.asarray(kind_b), jnp.asarray(pos_b), jnp.asarray(v0), B=B
+    )
+    return np.asarray(out)

@@ -29,7 +29,7 @@ from dataclasses import dataclass, field
 from typing import Iterator, NamedTuple
 
 #: The four workloads, as in the reference's hardcoded trace table
-#: (src/main.rs:10-15).  Overridable via bench config (utils/config.py) —
+#: (src/main.rs:10-15).  Overridable via the bench runner's --traces flag —
 #: the rebuild replaces the hardcoded const with configuration.
 TRACES = (
     "automerge-paper",

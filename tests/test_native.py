@@ -205,3 +205,37 @@ def test_byte_offset_replay_rustcode(rustcode_trace):
     pa = patch_arrays(rustcode_trace.chars_to_bytes(), bytes_mode=True)
     n = CppRopeBytes.replay_patches(pa)
     assert n == pa.end_len == len(rustcode_trace.end_content.encode("utf-8"))
+
+
+def test_byte_offset_crdt_backend():
+    """Byte-addressed CRDT (the yrs capability: a full sequence CRDT with
+    UTF-8 byte offsets, reference src/rope.rs:139-183)."""
+    from crdt_benches_tpu.backends.native import CppCrdtBytes
+
+    r = CppCrdtBytes.from_str("héllo")
+    assert len(r) == 6
+    r.insert(3, "X")
+    assert r.content() == "héXllo"
+    r.remove(1, 3)
+    assert r.content() == "hXllo"
+
+
+def test_byte_offset_crdt_replay_rustcode(rustcode_trace):
+    """Full rustcode replay in byte units through the CRDT engine,
+    byte-identical to the oracle (stricter than the reference's
+    length-only assert, src/main.rs:35)."""
+    from crdt_benches_tpu.backends.native import CppCrdtBytes
+    from crdt_benches_tpu.traces.patches import patch_arrays
+
+    pa = patch_arrays(rustcode_trace.chars_to_bytes(), bytes_mode=True)
+    n = CppCrdtBytes.replay_patches(pa)
+    assert n == pa.end_len == len(rustcode_trace.end_content.encode("utf-8"))
+
+    doc = CppCrdtBytes.from_str(rustcode_trace.start_content)
+    t = rustcode_trace.chars_to_bytes()
+    for pos, d, ins in t.iter_patches():
+        if d:
+            doc.remove(pos, pos + d)
+        if ins:
+            doc.insert(pos, ins)
+    assert doc.content() == rustcode_trace.end_content

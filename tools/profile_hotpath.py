@@ -1,0 +1,205 @@
+"""Micro-profile of the replay hot path on the real chip.
+
+Every dispatch on this runtime costs ~25ms round trip, so each component is
+timed as K iterations inside ONE jitted lax.scan, subtracting a baseline
+no-op scan of the same length.  Sync is by value fetch.
+
+Usage: python tools/profile_hotpath.py [R] [B] [trace] [K]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize
+from crdt_benches_tpu.engine.replay import ReplayEngine
+from crdt_benches_tpu.ops.resolve_pallas import resolve_batch_pallas
+from crdt_benches_tpu.ops.apply2 import apply_batch3, init_state3
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=5, warmup=2):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 32
+
+    trace = load_testing_data(trace_name)
+    tt = tensorize(trace, batch=B)
+    eng = ReplayEngine(tt, n_replicas=R)
+    C = eng.capacity
+    n_ops = len(trace)
+    print(f"R={R} B={B} C={C} n_batches={tt.n_batches} trace={trace_name} K={K}")
+
+    mid = tt.n_batches // 2
+    kind_b, pos_b, _, slot_b = tt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    slot = jnp.asarray(slot_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+
+    def scan_k(body, init):
+        @jax.jit
+        def run(init):
+            return jax.lax.scan(body, init, None, length=K)[0]
+
+        return lambda: run(init)
+
+    # Baseline: trivial scan to subtract scan-step floor.
+    base = timeit(scan_k(lambda c, _: (c + 1, None), jnp.zeros((8, 128))))
+    print(f"no-op scan floor:      {base/K*1e3:8.3f} ms/iter")
+
+    # --- resolver alone: carry v0, resolve repeatedly ---
+    def res_body(carry, _):
+        r = resolve_batch_pallas(kind, pos, carry, emit_origin=False)
+        # fold outputs into the carry so nothing is dead-code eliminated
+        return carry + r.del_rank[:, 0] * 0 + r.ins_gvis[:, -1] * 0, None
+
+    t = (timeit(scan_k(res_body, v0)) - base) / K
+    print(
+        f"resolver+extract:      {t*1e3:8.3f} ms/batch"
+        f"  -> {t/B*1e9/R:8.1f} ns/op/replica"
+    )
+
+    # --- resolver kernel only (skip _extract_gather) ---
+    from crdt_benches_tpu.ops import resolve_pallas as rp
+
+    def kern_only(kind, pos, v0):
+        Bx = kind.shape[0]
+        Rx = v0.shape[0]
+        T = rp._round_up(2 * Bx + 2, 128)
+        Rt = min(32, max(8, (12 * 2**20) // ((10 * T + 6 * Bx) * 4)))
+        Rt = 1 << (Rt.bit_length() - 1)
+        while Rx % Rt:
+            Rt //= 2
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        kernel = functools.partial(
+            rp._kernel, B=Bx, T=T, Rt=Rt, emit_origin=False
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid=(Rx // Rt,),
+            in_specs=[
+                pl.BlockSpec((1, Bx), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, Bx), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((Rt, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((Rt, Bx), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            ] * 4
+            + [
+                pl.BlockSpec((Rt, T), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            ] * 3,
+            out_shape=[jax.ShapeDtypeStruct((Rx, Bx), jnp.int32)] * 4
+            + [jax.ShapeDtypeStruct((Rx, T), jnp.int32)] * 3,
+        )(
+            kind.reshape(1, Bx).astype(jnp.int32),
+            pos.reshape(1, Bx).astype(jnp.int32),
+            v0.reshape(Rx, 1).astype(jnp.int32),
+        )
+        return out
+
+    def kern_body(carry, _):
+        out = kern_only(kind, pos, carry)
+        return carry + out[0][:, 0] * 0, None
+
+    t = (timeit(scan_k(kern_body, v0)) - base) / K
+    print(
+        f"resolver kernel only:  {t*1e3:8.3f} ms/batch"
+        f"  -> {t/B*1e9/R:8.1f} ns/op/replica"
+    )
+
+    # --- apply alone ---
+    resolved = jax.tree.map(
+        jnp.asarray, resolve_batch_pallas(kind, pos, v0, emit_origin=False)
+    )
+    st0 = init_state3(R, C, 0)
+
+    def ap_body(st, _):
+        return apply_batch3(st, resolved, slot), None
+
+    t = (timeit(scan_k(ap_body, st0)) - base) / K
+    print(
+        f"apply_batch3:          {t*1e3:8.3f} ms/batch"
+        f"  -> {t/B*1e9/R:8.1f} ns/op/replica"
+    )
+
+    # --- apply sub-pieces ---
+    from crdt_benches_tpu.ops.apply2 import rank_to_phys2, _mxu_spread
+    from crdt_benches_tpu.ops.expand_pallas import expand_packed
+
+    cumvis = jnp.cumsum(jnp.bitwise_and(st0.doc, 1), axis=1)
+    q = jnp.clip(resolved.del_rank, 0, None)
+
+    def cv_body(carry, _):
+        c = jnp.cumsum(jnp.bitwise_and(carry, 1), axis=1)
+        return carry + (c[:, -1:] * 0), None
+
+    t = (timeit(scan_k(cv_body, st0.doc)) - base) / K
+    print(f"  cumsum (R,C):        {t*1e3:8.3f} ms")
+
+    def rp_body(carry, _):
+        r = rank_to_phys2(cumvis, q + carry[:, :1] * 0)
+        return carry + r[:, :1] * 0, None
+
+    t = (timeit(scan_k(rp_body, q)) - base) / K
+    print(f"  rank_to_phys2 x1:    {t*1e3:8.3f} ms")
+
+    def mx_body(carry, _):
+        (o,) = _mxu_spread(q, [carry[:, :1] * 0 + 1], C)
+        return carry + o[:, :1] * 0, None
+
+    t = (timeit(scan_k(mx_body, q)) - base) / K
+    print(f"  mxu_spread 1chunk:   {t*1e3:8.3f} ms")
+
+    cntind = jnp.cumsum(
+        jnp.zeros((R, C), jnp.int32).at[:, ::357].set(1), axis=1
+    )
+
+    def ex_body(carry, _):
+        o = expand_packed(carry, cntind, nbits=10)
+        return o, None
+
+    t = (timeit(scan_k(ex_body, st0.doc)) - base) / K
+    print(f"  expand_packed:       {t*1e3:8.3f} ms")
+
+    # --- full replay ---
+    def full():
+        s = eng.run()
+        return s.nvis
+
+    t = timeit(full, n=3, warmup=1)
+    eps = n_ops * R / t
+    print(
+        f"full replay:           {t:8.3f} s"
+        f"  -> {t/n_ops*1e9/R:8.1f} ns/op/replica"
+        f"  -> aggregate {eps/1e6:.2f}M el/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Capture a jax.profiler device trace of a few replay chunks and print the
+top device ops by total self time.
+
+Usage: python tools/profile_trace.py [R] [B] [trace] [n_chunks]
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize
+from crdt_benches_tpu.engine.replay import (
+    ReplayEngine,
+    replay_batches_r4,
+)
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    n_chunks = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+
+    trace = load_testing_data(trace_name)
+    tt = tensorize(trace, batch=B)
+    eng = ReplayEngine(tt, n_replicas=R)
+    print(f"R={R} B={B} C={eng.capacity} chunks={len(eng.chunks)}")
+
+    # Warm: run a couple of chunks to compile.
+    from crdt_benches_tpu.ops.apply2 import init_state4
+
+    st = init_state4(R, eng.capacity, eng.n_init)
+    for kind, pos, slot in eng.chunks[:2]:
+        st = replay_batches_r4(
+            st, kind, pos, slot, resolver=eng.resolver, pack=eng.pack
+        )
+    np.asarray(st.nvis)
+
+    logdir = "/tmp/jaxtrace"
+    os.system(f"rm -rf {logdir}")
+    jax.profiler.start_trace(logdir)
+    # Trace chunks 2..2+n (mid-trace, half-grown doc).
+    for kind, pos, slot in eng.chunks[2 : 2 + n_chunks]:
+        st = replay_batches_r4(
+            st, kind, pos, slot, resolver=eng.resolver, pack=eng.pack
+        )
+    np.asarray(st.nvis)
+    jax.profiler.stop_trace()
+
+    files = glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True)
+    print(files)
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    total = 0.0
+    for f in files:
+        with gzip.open(f, "rt") as fh:
+            data = json.load(fh)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            # device lanes only: pid names like "/device:TPU:0" appear in
+            # metadata; keep all complete events with args.long_name or a
+            # duration, filter host python by tid name heuristics
+            name = ev.get("name", "")
+            dur = ev.get("dur", 0) / 1e3  # ms
+            cat = ev.get("args", {}) or {}
+            if not name or dur <= 0:
+                continue
+            agg[name] += dur
+            cnt[name] += 1
+    items = sorted(agg.items(), key=lambda kv: -kv[1])
+    print(f"\ntop ops by total time (ms) over {n_chunks} chunks of "
+          f"{eng.chunk} batches:")
+    for name, ms in items[:40]:
+        print(f"  {ms:10.2f} ms  x{cnt[name]:5d}  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
