@@ -69,7 +69,10 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
         times = measure(iter_fn, warmup=0, samples=max(2, samples // 2))
         return BenchResult("upstream", trace_name, backend, elements, times)
     if backend == "jax":
-        from ..backends.jax_backend import JaxReplayBackend
+        try:
+            from ..backends.jax_backend import JaxReplayBackend
+        except ImportError:
+            return None
 
         b = JaxReplayBackend(n_replicas=replicas, batch=batch)
         b.prepare(trace)
@@ -82,6 +85,8 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
         return BenchResult(
             "upstream", trace_name, b.NAME, elements, times, replicas=replicas
         )
+    if backend == "jax-pos":
+        return None  # downstream-only variant
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -119,12 +124,15 @@ def run_downstream(trace_name: str, backend: str, samples: int,
         times = measure(iter_fn, warmup=warmup, samples=samples,
                         min_sample_time=0.05)
         return BenchResult("downstream", trace_name, backend, elements, times)
-    if backend == "jax":
+    if backend in ("jax", "jax-pos"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
         except ImportError:
             return None
-        b = JaxDownstreamBackend(n_replicas=replicas, batch=batch)
+        b = JaxDownstreamBackend(
+            n_replicas=replicas, batch=batch,
+            engine="v3" if backend == "jax-pos" else None,
+        )
         b.prepare(trace)
         times = measure(b.replay_once, warmup=warmup, samples=samples)
         return BenchResult(
@@ -187,11 +195,13 @@ def verify_upstream(trace_name: str, backend: str, replicas: int,
     if backend == "python-oracle":
         return True  # the oracle is the reference point
     if backend == "jax":
-        from ..backends.jax_backend import JaxReplayBackend
+        try:
+            from ..backends.jax_backend import JaxReplayBackend
+        except ImportError:
+            return None
 
         b = JaxReplayBackend(n_replicas=replicas, batch=batch)
         b.prepare(trace)
-        b.replay_once()
         return b.final_content() == want
     return None
 
@@ -208,14 +218,16 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
         down, _ = CppCrdtDownstream.upstream_updates(trace)
         down.apply_all_native()
         return down.content() == want
-    if backend == "jax":
+    if backend in ("jax", "jax-pos"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
         except ImportError:
             return None
-        b = JaxDownstreamBackend(n_replicas=replicas, batch=batch)
+        b = JaxDownstreamBackend(
+            n_replicas=replicas, batch=batch,
+            engine="v3" if backend == "jax-pos" else None,
+        )
         b.prepare(trace)
-        b.replay_once()
         return b.final_content() == want
     return None
 
@@ -291,7 +303,7 @@ def main(argv=None) -> int:
                         f"{r.median * 1e3:.2f}ms -> {r.elements_per_sec:,.0f} el/s",
                         file=sys.stderr,
                     )
-            if backend in ("cpp-crdt", "jax") and (
+            if backend in ("cpp-crdt", "jax", "jax-pos") and (
                 not args.filter or args.filter in "downstream"
             ):
                 r = run_downstream(trace, backend, args.samples, args.warmup,

@@ -518,6 +518,33 @@ def _mxu_spread_tc(idx, vals_7bit_chunks, C: int):
     return outs, tcount
 
 
+def spread_fill_combo(dest, fill, C: int):
+    """Spread packed insert fills to a dense combo array for the fused
+    apply kernels: returns (combo int32[R, C] = (fill << 1) | ind where ind
+    marks insert destinations, cnt_base int32[R, nt] exclusive cross-tile
+    prefix of destination counts).
+
+    The 4 chunks cover combo bits 0..27, i.e. fill < 2**27 — guaranteed by
+    the capacity < 2**21 assertion at engine construction
+    (fill = ((slot + 2) << 1) | vis < 4 * capacity).  ``fill`` must be 0
+    where ``dest`` is out of range.
+    """
+    chunks = [
+        jnp.bitwise_and(fill, 63) * 2 + 1,
+        jnp.bitwise_and(jnp.right_shift(fill, 6), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 13), 127),
+        jnp.bitwise_and(jnp.right_shift(fill, 20), 127),
+    ]
+    (c0, c1, c2, c3), ind_tcount = _mxu_spread_tc(dest, chunks, C)
+    combo = (
+        c0
+        + jnp.left_shift(c1, 7)
+        + jnp.left_shift(c2, 14)
+        + jnp.left_shift(c3, 21)
+    )
+    return combo, _excl_cumsum_small(ind_tcount)
+
+
 def apply_batch4(
     state: PackedState4, resolved: ResolvedBatch, slots: jax.Array
 ) -> PackedState4:
@@ -578,25 +605,7 @@ def apply_batch4(
     fill = jnp.where(
         is_ins, pack_doc(slots_b, resolved.ins_alive.astype(jnp.int32)), 0
     )
-    # combo = (fill << 1) | ind as one dense array: the low bit is the
-    # insert-destination indicator, the rest the packed fill value.  The 4
-    # chunks below cover combo bits 0..27, i.e. fill < 2**27 — guaranteed
-    # by the capacity < 2**21 assertion at engine construction
-    # (fill = ((slot + 2) << 1) | vis < 4 * capacity).
-    chunks = [
-        jnp.bitwise_and(fill, 63) * 2 + 1,
-        jnp.bitwise_and(jnp.right_shift(fill, 6), 127),
-        jnp.bitwise_and(jnp.right_shift(fill, 13), 127),
-        jnp.bitwise_and(jnp.right_shift(fill, 20), 127),
-    ]
-    (c0, c1, c2, c3), ind_tcount = _mxu_spread_tc(dest, chunks, C)
-    combo = (
-        c0
-        + jnp.left_shift(c1, 7)
-        + jnp.left_shift(c2, 14)
-        + jnp.left_shift(c3, 21)
-    )
-    cnt_base = _excl_cumsum_small(ind_tcount)
+    combo, cnt_base = spread_fill_combo(dest, fill, C)
 
     n_ins = jnp.sum(is_ins.astype(jnp.int32), axis=1)
     n_live = jnp.sum((is_ins & resolved.ins_alive).astype(jnp.int32), axis=1)

@@ -1,0 +1,110 @@
+"""Epoch-structured element-id -> physical-position resolution.
+
+The id-based integration paths (downstream update apply, concurrent merge)
+must answer, inside the TIMED region: *where is element ``s`` in my document
+right now?*  This is the work the reference's timed ``apply_update`` performs
+through each CRDT's internal index (diamond-types' order tree,
+reference src/rope.rs:222-224); skipping it by shipping encode-time-resolved
+positions would under-count the timed workload (round-1 advisor finding).
+
+A slot-indexed position array is the obvious structure, but keeping it exact
+every batch needs either a capacity-sized scatter (serializes: ~18ms at
+R=128, C=295k, measured by tools/micro_idpos.py) or a capacity-sized gather
+(worse).  The TPU-shaped answer is an **epoch structure**:
+
+- ``snap`` int32[R, C]: slot -> physical position, exact as of the last
+  epoch boundary.  Rebuilt by ONE scatter every ``K`` batches (amortized
+  ~18/K ms).
+- per batch inside the epoch, a **level**: the batch's insert destinations
+  in ``D_i - i`` form (sorted dests minus their index — the count_le array
+  that maps a pre-batch position to its post-batch shift) plus the
+  (slot, dest) pairs for same-epoch id matches.
+
+A query gathers the stale position from ``snap`` (a B-row ``take_along_axis``
+— ~0.9ms, the cheap direction) and walks the epoch's levels oldest->newest:
+add the level's shift (#{D_i - i <= p}, a B x B compare), then override with
+the exact destination if the id was inserted *at* that level (B x B equality
+on slot ids).  Every step is a fused VPU compare-reduce; nothing touches a
+capacity-sized scatter/gather until the next epoch boundary.
+
+Positions here are physical (tombstones included), so deletes never move
+anything — only insert destinations shift positions, which is what makes the
+level form exact.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.int32(2**31 - 1)
+
+
+class Level(NamedTuple):
+    """One batch's contribution to the epoch position map."""
+
+    sub: jax.Array  # int32[R, B] sorted (dest_i - i), invalid rows = BIG
+    slot: jax.Array  # int32[R, B] inserted slot ids (-1 = no insert)
+    dest: jax.Array  # int32[R, B] post-batch destination of slot
+
+
+def snap_rebuild(doc: jax.Array) -> jax.Array:
+    """slot -> physical position from the packed doc (one scatter; epoch
+    boundaries only).  Unused slots stay 0 — queries never ask for absent
+    ids (CRDT causality: an op's origin/target is always integrated)."""
+    R, C = doc.shape
+    slot = jnp.right_shift(doc, 1) - 2
+    idx = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    tgt = jnp.where(slot >= 0, slot, C)
+    return jax.vmap(
+        lambda t, i: jnp.zeros(C, jnp.int32).at[t].set(i, mode="drop")
+    )(tgt, idx)
+
+
+def snap_init(n_replicas: int, capacity: int) -> jax.Array:
+    """Epoch snapshot for a fresh document (slots 0..n_init-1 laid out in
+    order; identity covers every present slot)."""
+    return jnp.broadcast_to(
+        jnp.arange(capacity, dtype=jnp.int32), (n_replicas, capacity)
+    )
+
+
+def make_level(dest: jax.Array, is_ins: jax.Array, slot: jax.Array) -> Level:
+    """Build a level from a batch's insert destinations.
+
+    dest: int32[R, B] post-batch destinations (garbage where ``~is_ins``);
+    slot: int32[R, B] inserted slot ids.  The count_le form: with dests
+    sorted ascending (pads at the end as BIG), the i-th smallest dest has
+    exactly ``D_i - i`` old elements before it, so an old element at
+    pre-batch position p gains ``#{i : D_i - i <= p}`` new left neighbors.
+    """
+    d = jnp.sort(jnp.where(is_ins, dest, BIG), axis=1)
+    i = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    sub = jnp.where(d < BIG, d - i, BIG)
+    return Level(
+        sub=sub,
+        slot=jnp.where(is_ins, slot, -1),
+        dest=dest,
+    )
+
+
+def query(
+    snap: jax.Array, levels: list[Level], ids: jax.Array
+) -> jax.Array:
+    """Current physical positions of ``ids`` (int32[R, B]; rows with
+    ids < 0 return garbage — mask at the call site).  ``levels`` are the
+    epoch's batches oldest-first; each is applied as shift-then-override."""
+    R, C = snap.shape
+    p = jnp.take_along_axis(snap, jnp.clip(ids, 0, C - 1), axis=1)
+    for lv in levels:
+        shift = jnp.sum(
+            (lv.sub[:, None, :] <= p[:, :, None]).astype(jnp.int32), axis=2
+        )
+        p = p + shift
+        eq = ids[:, :, None] == lv.slot[:, None, :]
+        found = jnp.any(eq, axis=2)
+        pd = jnp.sum(jnp.where(eq, lv.dest[:, None, :], 0), axis=2)
+        p = jnp.where(found, pd, p)
+    return p

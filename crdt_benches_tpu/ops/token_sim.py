@@ -11,7 +11,8 @@ the batch-start visible length v0 — both host-known for an upstream replay
 (v0 per batch = n_init + running insert count minus deletes... tracked by
 the same simulation).  The growth rule replicated here is exactly the
 m-token replacement of ops/resolve.py `resolve_batch` (differentially
-tested against the Pallas kernel): the simulation carries (ttype, tlen)
+tested against the Pallas kernel in tests/test_token_sim.py: capped and
+uncapped resolver outputs must match): the simulation carries (ttype, tlen)
 per token and counts tokens; `required_T[b]` = token count at the end of
 batch b, which dominates every in-batch write index (writes go to
 t + 2 <= nused + 2 and nused is nondecreasing).

@@ -100,12 +100,43 @@ def test_random_ops_vs_oracle(seed):
     check_downstream(kinds, poss, chs, batch=32, start="base")
 
 
-def test_svelte_trace_byte_identical(svelte_trace):
+@pytest.mark.parametrize("engine", ["v5", "v3", "v1"])
+def test_svelte_trace_byte_identical(svelte_trace, engine):
     tt = tensorize(svelte_trace, batch=512)
-    eng = JaxDownstreamEngine(tt)
+    eng = JaxDownstreamEngine(tt, engine=engine)
     state = eng.run()
-    assert int(np.asarray(state.nvis)) == len(svelte_trace.end_content)
+    assert int(np.asarray(state.nvis).reshape(-1)[0]) == len(
+        svelte_trace.end_content
+    )
     assert eng.decode(state) == svelte_trace.end_content
+
+
+@pytest.mark.parametrize("engine", ["v3", "v1"])
+@pytest.mark.parametrize("seed", [3, 11])
+def test_random_ops_all_engines(seed, engine):
+    """The non-default engines (positional v3, scatter v1) integrate the
+    same random streams byte-identically."""
+    rng = np.random.default_rng(seed)
+    kinds, poss, chs = [], [], []
+    doc_len = 4
+    for _ in range(300):
+        if doc_len == 0 or rng.random() < 0.6:
+            kinds.append(INSERT)
+            poss.append(int(rng.integers(0, doc_len + 1)))
+            chs.append(int(rng.integers(97, 123)))
+            doc_len += 1
+        else:
+            kinds.append(DELETE)
+            poss.append(int(rng.integers(0, doc_len)))
+            chs.append(0)
+            doc_len -= 1
+    tt = tensorize_ops(kinds, poss, chs, batch=32, start="base")
+    want = replay_unit_ops(
+        tt.kind[: tt.n_ops], tt.pos[: tt.n_ops], tt.ch[: tt.n_ops],
+        start="base",
+    )
+    eng = JaxDownstreamEngine(tt, engine=engine)
+    assert eng.decode(eng.run()) == want
 
 
 def test_update_wire_size_reported(svelte_trace):

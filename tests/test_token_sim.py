@@ -1,0 +1,76 @@
+"""Differential coverage for the resolver token-count simulation
+(ops/token_sim.py): a token cap taken from the simulation must leave the
+Pallas resolver's outputs identical to the uncapped (2B+2 worst-case)
+kernel.  Runs the kernel in interpret mode so the TPU-only fast path is
+exercised on CPU CI (an undersized cap silently corrupts results — this is
+the test the round-1 kernel shipped without)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from crdt_benches_tpu.ops.resolve_pallas import resolve_batch_pallas
+from crdt_benches_tpu.ops.token_sim import simulate_token_counts
+from crdt_benches_tpu.traces.tensorize import DELETE, INSERT, tensorize
+
+
+def _random_stream(rng, n_ops, start_len):
+    kinds, poss = [], []
+    doc_len = start_len
+    for _ in range(n_ops):
+        if doc_len == 0 or rng.random() < 0.6:
+            kinds.append(INSERT)
+            poss.append(int(rng.integers(0, doc_len + 1)))
+            doc_len += 1
+        else:
+            kinds.append(DELETE)
+            poss.append(int(rng.integers(0, doc_len)))
+            doc_len -= 1
+    return np.asarray(kinds, np.int32), np.asarray(poss, np.int32)
+
+
+def _compare_capped(kind_b, pos_b, n_init):
+    caps = simulate_token_counts(kind_b, pos_b, n_init)
+    v0 = jnp.full((8,), n_init, jnp.int32)
+    nb, B = kind_b.shape
+    v = v0
+    for b in range(nb):
+        kind = jnp.asarray(kind_b[b])
+        pos = jnp.asarray(pos_b[b])
+        full = resolve_batch_pallas(kind, pos, v, interpret=True)
+        capped = resolve_batch_pallas(
+            kind, pos, v, interpret=True, token_cap=int(caps[b]) + 8
+        )
+        for f, c in zip(full, capped):
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(c))
+        n_ins = int((kind_b[b] == INSERT).sum())
+        n_del = int(
+            ((kind_b[b] == DELETE) & (pos_b[b] >= 0)).sum()
+        )
+        v = v + n_ins - n_del
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_random_streams_capped_equals_uncapped(seed):
+    rng = np.random.default_rng(seed)
+    B = 64
+    kinds, poss = _random_stream(rng, 4 * B, start_len=16)
+    _compare_capped(
+        kinds.reshape(4, B), poss.reshape(4, B), n_init=16
+    )
+
+
+def test_svelte_chunk_capped_equals_uncapped(svelte_trace):
+    tt = tensorize(svelte_trace, batch=128)
+    kind_b, pos_b, _, _ = tt.batched()
+    _compare_capped(kind_b[:4], pos_b[:4], n_init=len(tt.init_chars))
+
+
+def test_simulated_counts_bounded(svelte_trace):
+    """Sim never exceeds the kernel's worst case and covers the typing
+    regime (~B+2 tokens) the engine relies on."""
+    tt = tensorize(svelte_trace, batch=512)
+    kind_b, pos_b, _, _ = tt.batched()
+    caps = simulate_token_counts(kind_b, pos_b, len(tt.init_chars))
+    assert (caps <= 2 * 512 + 2).all()
+    assert caps.min() >= 1

@@ -1,0 +1,127 @@
+"""Microbenchmark candidate primitives for id -> position resolution.
+
+The merge/downstream integration needs: given B element ids per replica,
+find their current physical positions in the packed doc (R, C).  Candidate
+building blocks measured here on the real chip (same one-scan-K-iters
+methodology as profile_hotpath.py):
+
+  a) snapshot rebuild, scatter form:   pos_by_slot[doc[p]] = p   (R, C)
+  b) snapshot rebuild, argsort form:   argsort of slot keys      (R, C)
+  c) stale-position gather (MXU one-hot): pos0 = snap[ids]       (R, B, C)
+  d) correction pass: count_le of B queries against a sorted B-dest list
+     (B x B compare), K_ring of them
+  e) take_along_axis gather (R, B) from (R, C) — the serializing baseline
+
+Usage: python tools/micro_idpos.py [R] [B] [C] [K]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.ops.gather import onehot_gather_vec
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    C = int(sys.argv[3]) if len(sys.argv) > 3 else 294912
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+    print(f"R={R} B={B} C={C} K={K}")
+
+    rng = np.random.default_rng(0)
+    perm = np.stack([rng.permutation(C) for _ in range(R)]).astype(np.int32)
+    doc = jnp.asarray(perm)  # doc[p] = slot
+    snap = jnp.asarray(np.argsort(perm, axis=1).astype(np.int32))
+    ids = jnp.asarray(rng.integers(0, C, (R, B)), dtype=jnp.int32)
+    vals = jnp.asarray(np.arange(C, dtype=np.int32)[None].repeat(R, 0))
+    dests = jnp.asarray(
+        np.sort(rng.integers(0, C, (R, K, B)), axis=2).astype(np.int32)
+    )
+
+    def scan_k(body, init, *args, k=K):
+        @jax.jit
+        def run(init, *args):
+            return jax.lax.scan(
+                lambda c, _: (body(c, *args), None), init, None, length=k
+            )[0]
+
+        return lambda: run(init, *args)
+
+    base = timeit(scan_k(lambda c: c + 1, jnp.zeros((8, 128))))
+    print(f"no-op scan floor:        {base/K*1e3:9.3f} ms/iter")
+
+    # (a) scatter rebuild
+    def scat_body(carry, doc, vals):
+        snap2 = jax.vmap(
+            lambda d, v: jnp.zeros(C, jnp.int32).at[d].set(v)
+        )(doc + carry[0, 0].astype(jnp.int32) * 0, vals)
+        return carry + snap2[:, :128].astype(jnp.float32) * 0 + 1
+
+    t = (timeit(scan_k(scat_body, jnp.zeros((R, 128)), doc, vals)) - base) / K
+    print(f"(a) scatter rebuild:     {t*1e3:9.3f} ms")
+
+    # (b) argsort rebuild
+    def sort_body(carry, doc):
+        snap2 = jnp.argsort(doc + carry[0, 0].astype(jnp.int32) * 0, axis=1)
+        return carry + snap2[:, :128].astype(jnp.float32) * 0 + 1
+
+    t = (timeit(scan_k(sort_body, jnp.zeros((R, 128)), doc)) - base) / K
+    print(f"(b) argsort rebuild:     {t*1e3:9.3f} ms")
+
+    # (c) one-hot stale gather (B ids from C)
+    def oh_body(carry, snap, ids):
+        q = ids + carry[:, :B].astype(jnp.int32) * 0
+        p0 = onehot_gather_vec(snap, q, max_value=C)
+        return carry + p0.astype(jnp.float32) * 0 + 1
+
+    t = (timeit(scan_k(oh_body, jnp.zeros((R, B)), snap, ids)) - base) / K
+    print(f"(c) one-hot gather BxC:  {t*1e3:9.3f} ms")
+
+    # (d) ring correction: K count_le passes of B queries vs sorted B dests
+    def ring_body(carry, ids, dests):
+        p = ids + carry[:, :B].astype(jnp.int32) * 0
+        for k in range(K):
+            d = dests[:, k]
+            le = (d[:, None, :] <= p[:, :, None]).astype(jnp.int32)
+            p = p + jnp.sum(le, axis=2)
+        return carry + p.astype(jnp.float32) * 0 + 1
+
+    t = (
+        timeit(scan_k(ring_body, jnp.zeros((R, B)), ids, dests, k=4)) - base
+    ) / 4
+    print(f"(d) {K}-deep ring corr:  {t*1e3:9.3f} ms")
+
+    # (e) take_along_axis gather
+    def taa_body(carry, snap, ids):
+        q = ids + carry[:, :B].astype(jnp.int32) * 0
+        p0 = jnp.take_along_axis(snap, q, axis=1)
+        return carry + p0.astype(jnp.float32) * 0 + 1
+
+    t = (timeit(scan_k(taa_body, jnp.zeros((R, B)), snap, ids)) - base) / K
+    print(f"(e) take_along_axis:     {t*1e3:9.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
