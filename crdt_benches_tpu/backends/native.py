@@ -33,6 +33,7 @@ _LIB_PATHS = (
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
 _i64 = ctypes.c_int64
 _vp = ctypes.c_void_p
 
@@ -69,6 +70,8 @@ def _load_lib():
     sig(lib.crdt_apply_updates, _i64, [_vp, _u8p, _i64p, _i64])
     sig(lib.crdt_replay, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64])
     sig(lib.crdt_gen_updates, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _u8p, _i64, _i64p])
+    sig(lib.crdt_integrate_ops, _i64, [_vp, _i64, _u8p, _u32p, _u32p, _u32p, _u32p, _i32p])
+    sig(lib.crdt_replay_dump, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _i32p, _i64, _u8p, _i32p, _i64])
     return lib
 
 
@@ -348,3 +351,45 @@ class CppCrdtDownstream(Downstream):
 
     def content(self) -> str:
         return self._doc.content()
+
+
+class NativeMerge:
+    """Independent native RGA oracle/baseline for concurrent merge
+    (native/crdt.cpp crdt_integrate_ops): an order-statistic treap with the
+    same (lamport, agent) id order and insert-after-origin intention rule
+    as engine/merge.py, in an entirely separate implementation.  Used to
+    cross-validate the JAX merge kernels at scales where the pure-Python
+    oracle is infeasible, and as the merge bench's single-core baseline.
+    """
+
+    def __init__(self, base: str, base_agent: int = 1_000_000):
+        self.base = base
+        self.base_agent = base_agent
+        self._h = lib().crdt_new(_codes(base), len(base), base_agent)
+
+    def integrate(self, type_, id_agent, id_seq, org_agent, org_seq, ch) -> int:
+        """Integrate struct-of-array ops (already (lamport, agent)-sorted;
+        ids per NativeMerge id convention).  Returns visible length."""
+        n = len(type_)
+        return lib().crdt_integrate_ops(
+            self._h, n,
+            np.ascontiguousarray(type_, np.uint8),
+            np.ascontiguousarray(id_agent, np.uint32),
+            np.ascontiguousarray(id_seq, np.uint32),
+            np.ascontiguousarray(org_agent, np.uint32),
+            np.ascontiguousarray(org_seq, np.uint32),
+            np.ascontiguousarray(ch, np.int32),
+        )
+
+    def __len__(self) -> int:
+        return lib().crdt_len(self._h)
+
+    def content(self) -> str:
+        out = np.zeros(len(self), np.int32)
+        lib().crdt_read(self._h, out)
+        return "".join(map(chr, out.tolist()))
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            lib().crdt_free(self._h)
+            self._h = None

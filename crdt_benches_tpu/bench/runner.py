@@ -85,8 +85,8 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
         return BenchResult(
             "upstream", trace_name, b.NAME, elements, times, replicas=replicas
         )
-    if backend == "jax-pos":
-        return None  # downstream-only variant
+    if backend in ("jax-pos", "jax-range"):
+        return None  # downstream-only variants
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -124,15 +124,23 @@ def run_downstream(trace_name: str, backend: str, samples: int,
         times = measure(iter_fn, warmup=warmup, samples=samples,
                         min_sample_time=0.05)
         return BenchResult("downstream", trace_name, backend, elements, times)
-    if backend in ("jax", "jax-pos"):
+    if backend in ("jax", "jax-pos", "jax-range"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
+            from ..engine.downstream_range import JaxRangeDownstreamBackend
         except ImportError:
             return None
-        b = JaxDownstreamBackend(
-            n_replicas=replicas, batch=batch,
-            engine="v3" if backend == "jax-pos" else None,
-        )
+        if backend == "jax-range":
+            from ..backends.native import native_available
+
+            if not native_available():
+                return None  # range generation anchors on the native dump
+            b = JaxRangeDownstreamBackend(n_replicas=replicas)
+        else:
+            b = JaxDownstreamBackend(
+                n_replicas=replicas, batch=batch,
+                engine="v3" if backend == "jax-pos" else None,
+            )
         b.prepare(trace)
         times = measure(b.replay_once, warmup=warmup, samples=samples)
         return BenchResult(
@@ -143,6 +151,133 @@ def run_downstream(trace_name: str, backend: str, samples: int,
 
 
 import functools
+
+
+@functools.lru_cache(maxsize=4)
+def _merge_sim(config: str, merge_ops: int, batch: int):
+    """Build a MergeSimulation for a merge bench config (UNTIMED, like the
+    reference's update generation):
+
+    - ``traces``: two agents editing concurrently from an empty shared base
+      — one replays rustcode, the other seph-blog1 (BASELINE.md config 4).
+    - ``synthetic``: 16 agents of random interleaved edits totalling
+      ~``merge_ops`` ops (config 5's adversarial-interleaving workload).
+    """
+    import numpy as np
+
+    from ..engine.merge import MergeSimulation
+    from ..traces.tensorize import tensorize
+
+    if config == "traces":
+        streams = [
+            tensorize(load_testing_data("rustcode"), batch=batch),
+            tensorize(load_testing_data("seph-blog1"), batch=batch),
+        ]
+        return MergeSimulation(streams, base="", batch=batch)
+    if config == "synthetic":
+        from ..traces.loader import TestData, TestTxn
+        from ..traces.synth import random_patches
+
+        n_agents = 16
+        rng = np.random.default_rng(1234)
+        base = "the quick brown fox jumps over the lazy dog " * 4
+        streams = []
+        for _ in range(n_agents):
+            patches, _ = random_patches(
+                rng, merge_ops // n_agents, len(base)
+            )
+            streams.append(
+                tensorize(
+                    TestData(base, "", [TestTxn("", patches)]), batch=batch
+                )
+            )
+        return MergeSimulation(streams, base=base, batch=batch)
+    raise ValueError(f"unknown merge config {config!r}")
+
+
+def run_merge(config: str, backend: str, samples: int, warmup: int,
+              replicas: int, batch: int, merge_ops: int,
+              epoch: int = 8) -> BenchResult | None:
+    """Concurrent-merge throughput: timed region = integrate the full
+    (shuffle-independent) union of divergent op logs into a fresh replica
+    AND confirm convergence (digest agreement across replicas).  Element =
+    one op in the union.  The reference's merge capability is
+    ``decode_and_add``/``doc.merge`` (src/rope.rs:222-235); it publishes no
+    merge benchmark — these cells are the BASELINE.md config 4-5 targets."""
+    import numpy as np
+
+    sim = _merge_sim(config, merge_ops, batch)
+    elements = len(sim.log)
+    if backend == "cpp-crdt":
+        from ..backends.native import NativeMerge, native_available
+        from ..engine.merge import to_native_ops
+
+        if not native_available():
+            return None
+        ops = to_native_ops(sim)  # untimed translation, like encode
+        base = "".join(
+            chr(int(c)) for c in np.asarray(sim.chars)[: sim.n_base]
+        )
+        nm0 = NativeMerge(base)
+        expect_len = nm0.integrate(*ops)
+        del nm0
+
+        def iter_fn():
+            nm = NativeMerge(base)
+            assert nm.integrate(*ops) == expect_len
+
+        times = measure(iter_fn, warmup=warmup, samples=samples,
+                        min_sample_time=0.05)
+        return BenchResult("merge", config, backend, elements, times)
+    if backend == "jax":
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine.downstream import DownPacked
+        from ..engine.merge import merge_oplogs_packed
+        from ..ops.apply2 import init_state3
+        from ..ops.idpos import snap_init
+        from ..utils.digest import doc_digest_packed
+
+        # Pad + upload the union log ONCE (the cpp baseline's translation
+        # is likewise untimed); the timed region is fresh-replica init +
+        # on-device sort/dedup/integrate + convergence check.
+        log = sim._padded(sim.log, multiple=sim.batch * epoch)
+        dev = [
+            jnp.asarray(getattr(log, f))
+            for f in ("lamport", "agent", "kind", "elem", "origin", "ch")
+        ]
+        digest_r = jax.jit(
+            jax.vmap(doc_digest_packed, in_axes=(0, 0, None))
+        )
+
+        def iter_fn():
+            s3 = init_state3(replicas, sim.capacity, sim.n_base)
+            state = merge_oplogs_packed(
+                DownPacked(
+                    doc=s3.doc,
+                    snap=snap_init(replicas, sim.capacity),
+                    length=s3.length,
+                    nvis=s3.nvis,
+                ),
+                *dev,
+                batch=sim.batch,
+                epoch=epoch,
+            )
+            d = digest_r(state.doc, state.length, sim.chars)
+            converged = bool(
+                np.asarray(jnp.all(jnp.min(d, 0) == jnp.max(d, 0)))
+            )
+            assert converged, "replicas diverged"
+
+        times = measure(iter_fn, warmup=warmup, samples=samples)
+        plat = jax.devices()[0].platform
+        tag = f"-r{replicas}" if replicas > 1 else ""
+        return BenchResult(
+            "merge", config, f"jax-{plat}{tag}", elements, times,
+            replicas=replicas,
+        )
+    return None
 
 
 @functools.lru_cache(maxsize=8)
@@ -218,18 +353,43 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
         down, _ = CppCrdtDownstream.upstream_updates(trace)
         down.apply_all_native()
         return down.content() == want
-    if backend in ("jax", "jax-pos"):
+    if backend in ("jax", "jax-pos", "jax-range"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
+            from ..engine.downstream_range import JaxRangeDownstreamBackend
         except ImportError:
             return None
-        b = JaxDownstreamBackend(
-            n_replicas=replicas, batch=batch,
-            engine="v3" if backend == "jax-pos" else None,
-        )
+        if backend == "jax-range":
+            from ..backends.native import native_available
+
+            if not native_available():
+                return None
+            b = JaxRangeDownstreamBackend(n_replicas=replicas)
+        else:
+            b = JaxDownstreamBackend(
+                n_replicas=replicas, batch=batch,
+                engine="v3" if backend == "jax-pos" else None,
+            )
         b.prepare(trace)
         return b.final_content() == want
     return None
+
+
+def verify_merge(config: str, merge_ops: int, batch: int,
+                 replicas: int, epoch: int = 8) -> bool | None:
+    """Byte-identity for a merge cell: the packed JAX merge's decoded
+    document must equal the independent native treap's (engine/merge.py
+    native_merge_content), at the same epoch schedule the timed cell
+    uses."""
+    from ..backends.native import native_available
+    from ..engine.merge import native_merge_content
+
+    if not native_available():
+        return None
+    sim = _merge_sim(config, merge_ops, batch)
+    want = native_merge_content(sim)
+    state = sim.merge_packed(n_replicas=replicas, epoch=epoch)
+    return sim.decode(state) == want
 
 
 def main(argv=None) -> int:
@@ -241,6 +401,15 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument(
+        "--merge-configs", default="traces,synthetic",
+        help="merge-group workloads (run with --filter merge): 'traces' = "
+             "rustcode+seph-blog1 concurrent agents, 'synthetic' = 16-agent "
+             "random interleaving of ~--merge-ops ops",
+    )
+    ap.add_argument("--merge-ops", type=int, default=1_000_000)
+    ap.add_argument("--epoch", type=int, default=8,
+                    help="id->position snapshot rebuild period (batches)")
     ap.add_argument("--save-baseline", default=None)
     ap.add_argument("--baseline", default=None)
     ap.add_argument(
@@ -282,6 +451,18 @@ def main(argv=None) -> int:
                     )
                     if not ok:
                         failures.append((group, trace, backend))
+        if not args.filter or args.filter in "merge":
+            for config in args.merge_configs.split(","):
+                ok = verify_merge(
+                    config, args.merge_ops, args.batch, args.replicas,
+                    args.epoch,
+                )
+                if ok is None:
+                    continue
+                tag = "ok" if ok else "MISMATCH"
+                print(f"verify merge/{config}/jax: {tag}", file=sys.stderr)
+                if not ok:
+                    failures.append(("merge", config, "jax"))
         if failures:
             print(f"verify FAILED: {failures}", file=sys.stderr)
             return 1
@@ -303,7 +484,7 @@ def main(argv=None) -> int:
                         f"{r.median * 1e3:.2f}ms -> {r.elements_per_sec:,.0f} el/s",
                         file=sys.stderr,
                     )
-            if backend in ("cpp-crdt", "jax", "jax-pos") and (
+            if backend in ("cpp-crdt", "jax", "jax-pos", "jax-range") and (
                 not args.filter or args.filter in "downstream"
             ):
                 r = run_downstream(trace, backend, args.samples, args.warmup,
@@ -313,6 +494,21 @@ def main(argv=None) -> int:
                     print(
                         f"downstream/{trace}/{r.backend}: median "
                         f"{r.median * 1e3:.2f}ms -> {r.elements_per_sec:,.0f} el/s",
+                        file=sys.stderr,
+                    )
+
+    if args.filter and args.filter in "merge":
+        for config in args.merge_configs.split(","):
+            for backend in args.backends.split(","):
+                r = run_merge(config, backend, args.samples, args.warmup,
+                              args.replicas, args.batch, args.merge_ops,
+                              epoch=args.epoch)
+                if r:
+                    results.append(r)
+                    print(
+                        f"merge/{config}/{r.backend}: median "
+                        f"{r.median * 1e3:.2f}ms -> "
+                        f"{r.elements_per_sec:,.0f} el/s",
                         file=sys.stderr,
                     )
 

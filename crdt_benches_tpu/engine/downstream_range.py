@@ -1,0 +1,595 @@
+"""Range-layout downstream: run-granular update generation + timed apply.
+
+The unit-op downstream (engine/downstream.py) explodes block edits into
+per-char ops — up to 24x op inflation on rustcode (SURVEY.md section 6) —
+so its timed apply does O(chars) sequential-batch work.  This module keeps
+updates at the reference's own granularity (diamond-types run-length-encodes
+sequential-insert runs into its binary updates, reference src/rope.rs:214):
+one wire op per contiguous insert RUN or delete INTERVAL, so batch count
+scales with patches, not characters.
+
+Wire form (generated UNTIMED, like reference ``upstream_updates``):
+
+- insert run: (anchor, rank, slot0, rlen, alive) — the run's ``rlen``
+  consecutive slots integrate directly after ``anchor`` (an element the
+  receiver has already integrated; -1 = document head), ordered among
+  same-anchor runs by ``rank``; ``alive=0`` runs are inserted already
+  tombstoned (every char is deleted later in the SAME batch — generation
+  splits runs at kill boundaries so aliveness is uniform per wire run).
+- delete interval: (dfirst, dlast, dcount) — element ids of the first and
+  last earlier-batch targets; at apply time every *visible* element in the
+  physical interval [pos(dfirst), pos(dlast)] is a target (tombstones in
+  between were deleted earlier; same-batch targets are not in the pre-batch
+  doc at all — they arrive dead via ``alive=0`` runs).
+
+The TIMED apply resolves anchor/dfirst/dlast ids to current physical
+positions per RUN inside the timed region (ops/idpos.py epoch structure —
+the like-for-like CRDT integration work, see engine/downstream.py), then
+integrates whole batches with interval spreads, two capacity cumsums, the
+arithmetic run fill (delta painting, like ops/apply_range.py), and the
+fused expansion kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..traces.loader import TestData
+from ..traces.tensorize import tensorize
+from .downstream import DownPacked
+from .replay import _round_up
+
+
+@dataclass
+class RangeUpdates:
+    """One trace's range-granular updates as batched tensors (rows = wire
+    batches, width = max wire ops per batch; -1/0 padding)."""
+
+    anchor: np.ndarray  # int32[nb, W] insert-run anchor (-1 head, -2 pad)
+    rank: np.ndarray  # int32[nb, W]
+    slot0: np.ndarray  # int32[nb, W] first slot (-1 = not an insert)
+    rlen: np.ndarray  # int32[nb, W]
+    alive: np.ndarray  # int32[nb, W] 0/1
+    dfirst: np.ndarray  # int32[nb, W] delete-interval first id (-1 = none)
+    dlast: np.ndarray  # int32[nb, W]
+    dcount: np.ndarray  # int32[nb, W]
+    capacity: int
+    n_init: int
+    chars: np.ndarray
+    end_content: str
+    n_patches: int
+
+    def nbytes(self) -> int:
+        return sum(
+            a.nbytes
+            for a in (
+                self.anchor, self.rank, self.slot0, self.rlen, self.alive,
+                self.dfirst, self.dlast, self.dcount,
+            )
+        )
+
+
+def generate_range_updates(
+    trace: TestData, batch_ops: int = 256, lane: int = 128
+) -> RangeUpdates:
+    """UNTIMED generation: one unit-op upstream replay (device) for the
+    final order + delete targets, then host-side run extraction.
+
+    Wire batches are ``batch_ops`` consecutive original range ops (one per
+    patch component); anchors always reference elements from EARLIER wire
+    batches (or init content), ranks order same-anchor runs, and runs are
+    split wherever a same-batch delete kills part of them.
+    """
+    tt = tensorize(trace, batch=512)
+    capacity = _round_up(max(tt.capacity, 1), lane)
+    n_init = len(tt.init_chars)
+
+    # Insertion-faithful replay via the native treap (local inserts splice
+    # DIRECTLY after their origin): the JAX engine's final order is only
+    # content-equivalent — tombstone-relative placement can differ, which
+    # is invisible in content but breaks the delete-interval contiguity
+    # this wire form relies on.  The native dump's order is the exact
+    # order the receiver's anchor-directly-after rule reproduces.
+    from ..backends.native import lib
+    from ..traces.patches import patch_arrays
+
+    pa = patch_arrays(trace)
+    n_del_total = int(pa.del_count.sum())
+    order_buf = np.zeros(capacity, np.int32)
+    vis_buf = np.zeros(capacity, np.uint8)
+    dtgt_buf = np.zeros(max(n_del_total, 1), np.int32)
+    length = int(
+        lib().crdt_replay_dump(
+            pa.init, len(pa.init), pa.pos, pa.del_count, pa.ins_off,
+            pa.ins_flat, pa.n_patches,
+            order_buf, capacity, vis_buf, dtgt_buf, len(dtgt_buf),
+        )
+    )
+    assert length > 0, "native replay dump failed (buffer too small?)"
+    order = order_buf[:length]
+
+    # delete-target slots per unit delete op, in unit-op order: the native
+    # oplog records deletes patch-interleaved exactly like the unit
+    # explosion (del_count deletes then the insert chars per patch).
+    dslot_unit = np.full(tt.n_ops, -1, np.int32)
+    u = 0
+    t = 0
+    for pos, dcount, ins in trace.iter_patches():
+        if dcount:
+            dslot_unit[u : u + dcount] = dtgt_buf[t : t + dcount]
+            u += dcount
+            t += dcount
+        u += len(ins)
+    assert t == n_del_total
+
+    # ---- range ops (one per patch component), in unit-op order ----
+    # unit ops were emitted per patch as del_count DELETEs then the insert
+    # chars (traces/tensorize.py explode_unit_ops); walk patches to segment
+    # the unit streams into range ops and assign wire batches.
+    r_kind: list[int] = []  # INSERT=1 / DELETE=2
+    r_a: list[int] = []  # unit-op start index
+    r_len: list[int] = []
+    u = 0
+    for pos, dcount, ins in trace.iter_patches():
+        if dcount:
+            r_kind.append(2)
+            r_a.append(u)
+            r_len.append(dcount)
+            u += dcount
+        if ins:
+            r_kind.append(1)
+            r_a.append(u)
+            r_len.append(len(ins))
+            u += len(ins)
+    assert u == tt.n_ops
+    n_rops = len(r_kind)
+    r_kind_a = np.asarray(r_kind, np.int32)
+    r_a_a = np.asarray(r_a, np.int64)
+    r_len_a = np.asarray(r_len, np.int64)
+    rbatch = np.arange(n_rops, dtype=np.int64) // batch_ops
+    nb = int(rbatch[-1]) + 1 if n_rops else 1
+
+    # wire-batch index of every slot (insert unit ops) / -1 for init
+    batch_of_slot = np.full(capacity, -1, np.int64)
+    is_rins = r_kind_a == 1
+    for i in np.nonzero(is_rins)[0]:
+        s0 = tt.slot[r_a_a[i]]
+        batch_of_slot[s0 : s0 + r_len_a[i]] = rbatch[i]
+
+    pos_of_slot = np.full(capacity, -1, np.int64)
+    pos_of_slot[order] = np.arange(length)
+    arrb = batch_of_slot[order]
+
+    from .downstream import _prev_smaller
+
+    a_pos_all = _prev_smaller(arrb)
+
+    # killed[slot]: deleted by a delete op in the SAME wire batch
+    killed = np.zeros(capacity, bool)
+    del_batch = np.full(capacity, -1, np.int64)  # wire batch that deletes it
+    for i in np.nonzero(r_kind_a == 2)[0]:
+        tgt = dslot_unit[r_a_a[i] : r_a_a[i] + r_len_a[i]]
+        del_batch[tgt] = rbatch[i]
+    killed = (del_batch >= 0) & (del_batch == batch_of_slot)
+
+    # per-batch sorted final positions of that batch's slots — used to
+    # split runs wherever a SAME-batch later op inserted inside them (the
+    # wire form requires runs contiguous at end-of-own-batch; later-batch
+    # interposers don't matter, they integrate afterwards).
+    pos_by_batch: dict[int, np.ndarray] = {}
+    for b in range(nb):
+        sl = np.nonzero(batch_of_slot == b)[0]
+        pos_by_batch[b] = np.sort(pos_of_slot[sl])
+
+    # ---- build wire ops per batch ----
+    rows: list[list[tuple]] = [[] for _ in range(nb)]
+    for i in range(n_rops):
+        b = int(rbatch[i])
+        if r_kind_a[i] == 2:
+            tgt = dslot_unit[r_a_a[i] : r_a_a[i] + r_len_a[i]]
+            prev = tgt[~killed[tgt]]  # earlier-batch targets, doc order
+            if len(prev):
+                rows[b].append(
+                    ("D", int(prev[0]), int(prev[-1]), len(prev))
+                )
+        else:
+            s0 = int(tt.slot[r_a_a[i]])
+            L = int(r_len_a[i])
+            k = killed[s0 : s0 + L]
+            q = pos_of_slot[s0 : s0 + L]
+            # split at kill-uniformity changes and at same-batch
+            # interpositions (consecutive chars not adjacent among this
+            # batch's positions)
+            idx_pb = np.searchsorted(pos_by_batch[b], q)
+            cut = (np.diff(k.astype(np.int8)) != 0) | (
+                np.diff(idx_pb) > 1
+            )
+            cuts = np.nonzero(cut)[0] + 1
+            seg0 = np.concatenate([[0], cuts])
+            seg1 = np.concatenate([cuts, [L]])
+            for a0, a1 in zip(seg0, seg1):
+                rows[b].append(
+                    ("I", s0 + int(a0), int(a1 - a0), 0 if k[a0] else 1)
+                )
+
+    # anchors/ranks for every insert segment, from the final order
+    seg_batch, seg_slot0, seg_len, seg_alive = [], [], [], []
+    seg_row_idx = []  # (batch, index within batch rows)
+    for b, ops in enumerate(rows):
+        for j, op in enumerate(ops):
+            if op[0] == "I":
+                seg_batch.append(b)
+                seg_slot0.append(op[1])
+                seg_len.append(op[2])
+                seg_alive.append(op[3])
+                seg_row_idx.append((b, j))
+    if seg_slot0:
+        q0 = pos_of_slot[np.asarray(seg_slot0, np.int64)]
+        a_pos = a_pos_all[q0]
+        a_slot = np.where(a_pos >= 0, order[np.clip(a_pos, 0, None)], -1)
+        sb = np.asarray(seg_batch, np.int64)
+        srt = np.lexsort((q0, a_pos, sb))
+        kb, ka = sb[srt], a_pos[srt]
+        grp = np.concatenate(
+            [[True], (kb[1:] != kb[:-1]) | (ka[1:] != ka[:-1])]
+        )
+        idx = np.arange(len(srt))
+        r_sorted = idx - np.maximum.accumulate(np.where(grp, idx, 0))
+        rank = np.empty_like(r_sorted)
+        rank[srt] = r_sorted
+    else:
+        a_slot = rank = np.zeros(0, np.int64)
+
+    W = max((len(ops) for ops in rows), default=1)
+    W = max(W, 1)
+    anchor = np.full((nb, W), -2, np.int32)
+    rank_a = np.zeros((nb, W), np.int32)
+    slot0_a = np.full((nb, W), -1, np.int32)
+    rlen_a = np.zeros((nb, W), np.int32)
+    alive_a = np.zeros((nb, W), np.int32)
+    dfirst = np.full((nb, W), -1, np.int32)
+    dlast = np.full((nb, W), -1, np.int32)
+    dcount = np.zeros((nb, W), np.int32)
+    si = 0
+    for b, ops in enumerate(rows):
+        for j, op in enumerate(ops):
+            if op[0] == "I":
+                anchor[b, j] = a_slot[si]
+                rank_a[b, j] = rank[si]
+                slot0_a[b, j] = op[1]
+                rlen_a[b, j] = op[2]
+                alive_a[b, j] = op[3]
+                si += 1
+            else:
+                dfirst[b, j] = op[1]
+                dlast[b, j] = op[2]
+                dcount[b, j] = op[3]
+
+    from .replay import slot_char_table
+
+    return RangeUpdates(
+        anchor=anchor, rank=rank_a, slot0=slot0_a, rlen=rlen_a,
+        alive=alive_a, dfirst=dfirst, dlast=dlast, dcount=dcount,
+        capacity=capacity, n_init=n_init,
+        chars=slot_char_table(tt, capacity),
+        end_content=tt.end_content, n_patches=tt.n_patches,
+    )
+
+
+def _apply_range_update_batch5(
+    doc, length, nvis, snap, levels,
+    anchor, rank, slot0, rlen, alive, dfirst, dlast, dcount,
+    *, nbits: int,
+):
+    """Integrate one range wire batch with id->position resolution inside
+    the timed region.  Wire rows are shared across replicas (shape (W,))."""
+    from ..ops.apply2 import _mxu_spread, _excl_cumsum_small, LANE
+    from ..ops.idpos import make_level_runs, query
+
+    R, C = doc.shape
+    W = anchor.shape[0]
+    drop = jnp.int32(C + 7)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    is_ins = slot0 >= 0
+    has_del = dfirst >= 0
+    bc = lambda x: jnp.broadcast_to(x[None], (R, W))
+
+    # ---- resolve ids: anchors + delete interval endpoints (one combined
+    # query keeps the per-level passes shared) ----
+    a_phys = query(snap, levels, bc(anchor))
+    lo_phys = query(snap, levels, bc(dfirst))
+    hi_phys = query(snap, levels, bc(dlast))
+    gap = jnp.where(
+        bc(is_ins), jnp.where(bc(anchor) >= 0, a_phys + 1, 0), drop
+    )
+
+    # ---- deletes: clear visible bits over [lo, hi] (guarded) ----
+    lo_phys = jnp.where(bc(has_del), lo_phys, drop)
+    hi_phys = jnp.where(bc(has_del), hi_phys, drop - 7)
+    (starts,) = _mxu_spread(
+        lo_phys, [jnp.ones((R, W), jnp.int32)], C
+    )
+    (stops,) = _mxu_spread(
+        hi_phys + 1, [jnp.ones((R, W), jnp.int32)], C
+    )
+    in_del = jnp.cumsum(starts - stops, axis=1) > 0
+    vis_bit = jnp.bitwise_and(doc, 1)
+    sub = vis_bit * in_del.astype(jnp.int32)
+    doc_predel = doc - sub
+    n_del_eff = jnp.sum(sub, axis=1)
+
+    # ---- run destinations: gap + chars of runs ordered before me ----
+    # lexicographic (gap, rank) weighted prefix, per replica
+    L = jnp.where(is_ins, rlen, 0)
+    g = gap
+    r_ = bc(rank)
+    earlier = (
+        (g[:, None, :] < g[:, :, None])
+        | ((g[:, None, :] == g[:, :, None]) & (r_[:, None, :] < r_[:, :, None]))
+    ) & bc(is_ins)[:, None, :]
+    chars_before = jnp.sum(
+        jnp.where(earlier, bc(L)[:, None, :], 0), axis=2
+    )
+    dest0 = jnp.where(bc(is_ins), g + chars_before, drop)
+    dstop = jnp.where(bc(is_ins), dest0 + bc(rlen), drop - 7)
+
+    # ---- insert indicator + expansion count base ----
+    (s1,) = _mxu_spread(dest0, [jnp.ones((R, W), jnp.int32)], C)
+    (s2,) = _mxu_spread(dstop, [jnp.ones((R, W), jnp.int32)], C)
+    ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+    nt = C // LANE
+    cnt_base = _excl_cumsum_small(
+        jnp.sum(ind.reshape(R, nt, LANE), axis=2)
+    )
+
+    # ---- arithmetic fill: slot(d) = d + delta(run), vis per run ----
+    # per-run delta = slot0 - dest0, painted as cumsum of differences at
+    # run starts (runs processed in dest order).
+    ordk = jnp.where(bc(is_ins), dest0, drop)
+    perm = jnp.argsort(ordk, axis=1)
+    d_sorted = jnp.take_along_axis(dest0, perm, axis=1)
+    s_sorted = jnp.take_along_axis(bc(slot0), perm, axis=1)
+    v_sorted = jnp.take_along_axis(bc(alive), perm, axis=1)
+    live_sorted = jnp.take_along_axis(bc(is_ins), perm, axis=1)
+    delta = jnp.where(live_sorted, s_sorted - d_sorted, 0)
+    pd = jnp.concatenate(
+        [jnp.zeros((R, 1), jnp.int32), delta[:, :-1]], axis=1
+    )
+    pl = jnp.concatenate(
+        [jnp.zeros((R, 1), bool), live_sorted[:, :-1]], axis=1
+    )
+    ddelta = jnp.where(live_sorted, delta - jnp.where(pl, pd, 0), 0)
+    dvis = jnp.where(
+        live_sorted,
+        v_sorted - jnp.where(
+            pl,
+            jnp.concatenate(
+                [jnp.zeros((R, 1), jnp.int32), v_sorted[:, :-1]], axis=1
+            ),
+            0,
+        ),
+        0,
+    )
+    dpos_ = jnp.where(live_sorted, d_sorted, drop)
+    chunks = [
+        jnp.bitwise_and(jnp.where(ddelta > 0, ddelta, 0), 127),
+        jnp.bitwise_and(
+            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 7), 127
+        ),
+        jnp.bitwise_and(
+            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 14), 127
+        ),
+        jnp.bitwise_and(jnp.where(ddelta < 0, -ddelta, 0), 127),
+        jnp.bitwise_and(
+            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 7), 127
+        ),
+        jnp.bitwise_and(
+            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 14), 127
+        ),
+        jnp.where(dvis > 0, dvis, 0),
+        jnp.where(dvis < 0, -dvis, 0),
+    ]
+    p0, p1, p2, n0, n1, n2, vp, vn = _mxu_spread(dpos_, chunks, C)
+    dd_dense = (
+        p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
+        - n0 - jnp.left_shift(n1, 7) - jnp.left_shift(n2, 14)
+    )
+    delta_cum = jnp.cumsum(dd_dense, axis=1)
+    vis_run = jnp.cumsum(vp - vn, axis=1)
+    fill_slot = col + delta_cum
+    combo = jnp.where(
+        ind > 0,
+        jnp.left_shift(
+            (jnp.left_shift(fill_slot + 2, 1) | vis_run), 1
+        )
+        | 1,
+        0,
+    )
+
+    n_ins = jnp.sum(jnp.where(is_ins, rlen, 0))
+    n_live = jnp.sum(jnp.where(is_ins, rlen * alive, 0))
+    length2 = length + n_ins
+
+    from ..ops.expand_pallas import (
+        FUSED_STACK_BYTES_PER_POS,
+        apply_fused_nocv,
+        apply_fused_nocv_xla,
+    )
+
+    if (
+        jax.default_backend() == "tpu"
+        and FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20
+    ):
+        doc2 = apply_fused_nocv(
+            doc_predel, combo, cnt_base, length2, nbits=nbits
+        )
+    else:
+        doc2 = apply_fused_nocv_xla(
+            doc_predel, combo, cnt_base, length2, nbits=nbits
+        )
+    level = make_level_runs(dest0, bc(rlen), bc(slot0), bc(is_ins))
+    return doc2, length2, nvis + n_live - n_del_eff, level
+
+
+@partial(jax.jit, static_argnames=("nbits", "epoch"), donate_argnums=(0,))
+def apply_range_updates5(
+    state: DownPacked,
+    anchor_b, rank_b, slot0_b, rlen_b, alive_b, dfirst_b, dlast_b, dcount_b,
+    *, nbits: int, epoch: int = 8,
+) -> DownPacked:
+    """Scan all range wire batches; snapshot epoch structure as in
+    engine/downstream.py apply_updates5."""
+    from ..ops.idpos import snap_rebuild
+
+    NB, W = anchor_b.shape
+    K = min(epoch, NB)
+    if NB % K:
+        raise ValueError(f"batch count {NB} not a multiple of epoch {K}")
+    rs = lambda x: x.reshape(NB // K, K, W)
+
+    def step(st, upd):
+        a, r, s0, ln, al, df, dl, dc = upd
+        doc, snap, length, nvis = st
+        levels: list = []
+        for k in range(K):
+            doc, length, nvis, lv = _apply_range_update_batch5(
+                doc, length, nvis, snap, levels,
+                a[k], r[k], s0[k], ln[k], al[k], df[k], dl[k], dc[k],
+                nbits=nbits,
+            )
+            levels.append(lv)
+        return DownPacked(doc, snap_rebuild(doc), length, nvis), None
+
+    state, _ = jax.lax.scan(
+        step, state,
+        tuple(
+            rs(x)
+            for x in (
+                anchor_b, rank_b, slot0_b, rlen_b, alive_b,
+                dfirst_b, dlast_b, dcount_b,
+            )
+        ),
+    )
+    return state
+
+
+class JaxRangeDownstreamEngine:
+    """Host-side driver: untimed range-update generation, timed apply."""
+
+    def __init__(self, trace: TestData, n_replicas: int = 1,
+                 batch_ops: int = 256, epoch: int | None = None):
+        import os
+
+        self.upd = generate_range_updates(trace, batch_ops=batch_ops)
+        # |ddelta| < 2C must fit the 3x7-bit run-delta chunks (fail loudly,
+        # ADVICE round 1): capacity < 2^20.
+        if self.upd.capacity >= 1 << 20:
+            raise ValueError(
+                f"capacity {self.upd.capacity} >= 2^20 exceeds the"
+                " run-delta chunked-arithmetic range"
+            )
+        self.n_replicas = n_replicas
+        self.epoch = (
+            epoch
+            if epoch is not None
+            else int(os.environ.get("CRDT_DOWN_EPOCH", "8"))
+        )
+        pad = (-self.upd.anchor.shape[0]) % self.epoch
+        f = lambda a, fill: jnp.asarray(
+            np.concatenate(
+                [a, np.full((pad, a.shape[1]), fill, np.int32)]
+            )
+            if pad
+            else a
+        )
+        self.anchor_b = f(self.upd.anchor, -2)
+        self.rank_b = f(self.upd.rank, 0)
+        self.slot0_b = f(self.upd.slot0, -1)
+        self.rlen_b = f(self.upd.rlen, 0)
+        self.alive_b = f(self.upd.alive, 0)
+        self.dfirst_b = f(self.upd.dfirst, -1)
+        self.dlast_b = f(self.upd.dlast, -1)
+        self.dcount_b = f(self.upd.dcount, 0)
+        self.chars = jnp.asarray(self.upd.chars)
+        self.nbits = max(
+            1, int(self.upd.rlen.sum(axis=1).max(initial=1)).bit_length()
+        )
+
+    def run(self) -> DownPacked:
+        from ..ops.apply2 import init_state3
+        from ..ops.idpos import snap_init
+
+        s3 = init_state3(
+            self.n_replicas, self.upd.capacity, self.upd.n_init
+        )
+        st = DownPacked(
+            doc=s3.doc,
+            snap=snap_init(self.n_replicas, self.upd.capacity),
+            length=s3.length,
+            nvis=s3.nvis,
+        )
+        return apply_range_updates5(
+            st, self.anchor_b, self.rank_b, self.slot0_b, self.rlen_b,
+            self.alive_b, self.dfirst_b, self.dlast_b, self.dcount_b,
+            nbits=self.nbits, epoch=self.epoch,
+        )
+
+    def decode(self, state: DownPacked, replica: int = 0) -> str:
+        from ..ops.apply2 import PackedState, decode_state3
+
+        codes, nvis = jax.jit(
+            decode_state3, static_argnames=("replica",)
+        )(
+            PackedState(
+                doc=state.doc, length=state.length, nvis=state.nvis
+            ),
+            self.chars,
+            replica=replica,
+        )
+        return "".join(map(chr, np.asarray(codes)[: int(nvis)].tolist()))
+
+
+class JaxRangeDownstreamBackend:
+    """Downstream bench backend on range-granular updates (bench column
+    ``jax-*-range``): timed region = fresh replica + full apply + length
+    fetch (reference src/main.rs:62-69 semantics; element = patch)."""
+
+    def __init__(self, n_replicas: int = 1, batch_ops: int = 2048):
+        # Big op batches win here: per-batch O(C) vector passes dominate,
+        # and the W x W interleave compares stay cheap (measured on
+        # rustcode: batch_ops 256 -> 2048 is ~4x aggregate throughput).
+        self.n_replicas = n_replicas
+        self.batch_ops = batch_ops
+        self._eng: JaxRangeDownstreamEngine | None = None
+
+    @property
+    def NAME(self) -> str:
+        plat = jax.devices()[0].platform
+        tag = f"-r{self.n_replicas}" if self.n_replicas > 1 else ""
+        return f"jax-{plat}{tag}-range"
+
+    @property
+    def replicas(self) -> int:
+        return self.n_replicas
+
+    def prepare(self, trace: TestData) -> None:
+        self._eng = JaxRangeDownstreamEngine(
+            trace, n_replicas=self.n_replicas, batch_ops=self.batch_ops
+        )
+        self._end_len = len(trace.end_content)
+
+    def replay_once(self) -> int:
+        state = self._eng.run()
+        lengths = np.asarray(state.nvis)
+        assert (lengths == self._end_len).all(), (
+            f"length mismatch: {lengths} != {self._end_len}"
+        )
+        return int(lengths.reshape(-1)[0])
+
+    def final_content(self) -> str:
+        return self._eng.decode(self._eng.run())

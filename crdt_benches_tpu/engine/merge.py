@@ -315,6 +315,162 @@ def merge_oplogs(
     return state
 
 
+# ---- packed fast path (TPU) ------------------------------------------------
+
+
+def _chain_structure(kind, elem, origin):
+    """Per-batch RGA chain structure, computed in parallel (no sequential
+    splice scan — each XLA loop iteration costs ~ms on this runtime).
+
+    The batch's inserts form a forest: an insert whose origin was inserted
+    in this same batch points at that op (its parent); the rest are roots
+    grouped by external origin.  Integrating in ascending id order places
+    each insert directly after its origin, so children of a node end up in
+    DESCENDING op order — the final in-batch sequence under one external
+    anchor is the DFS of that group's trees, roots in descending order.
+    Both outputs of the old splice+pointer-double pipeline are therefore
+    order statistics of this forest:
+
+      rank(x) = depth(x) + sum over ancestors-or-self x' of
+                (total subtree size of x''s larger-index siblings)
+
+    with sibling = same parent, or same external origin among roots.  The
+    ancestor closure is log2(B) boolean B x B matrix squarings (exact in
+    bf16 matmuls — sums of <= B ones), everything else is B x B compares:
+    all VPU/MXU work shared across replicas.
+
+    Returns (ins, anchor, rank, dslot), each int32[B] in the downstream
+    anchor/rank wire form (engine/downstream.py _apply_update_batch5).
+    """
+    B = kind.shape[0]
+    j = jnp.arange(B, dtype=jnp.int32)
+    is_ins = kind == INSERT
+    is_del = kind == DELETE
+    ins = jnp.where(is_ins, elem, -1)
+    dslot = jnp.where(is_del, elem, -1)
+
+    # parent op: the same-batch op that inserted my origin (-1 = external).
+    eq = (
+        (origin[:, None] == ins[None, :])
+        & is_ins[:, None]
+        & (ins[None, :] >= 0)
+    )
+    org_op = jnp.sum(jnp.where(eq, j[None, :] + 1, 0), axis=1) - 1
+    parent = jnp.where(is_ins & (org_op >= 0), org_op, -1)
+
+    # ancestor closure (proper ancestors): A <- A | A@A, log2 B rounds.
+    A = (parent[:, None] == j[None, :]) & (parent[:, None] >= 0)
+    for _ in range(max(1, (B - 1).bit_length())):
+        prod = (
+            jnp.einsum(
+                "xm,ma->xa",
+                A.astype(jnp.bfloat16),
+                A.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+        A = A | prod
+    depth = jnp.sum(A.astype(jnp.int32), axis=1)
+    size = 1 + jnp.sum(A.astype(jnp.int32), axis=0)  # subtree size
+
+    # siblings: same internal parent, or both roots sharing an external
+    # origin (they splice after one sentinel, descending root order).
+    both_ins = is_ins[:, None] & is_ins[None, :]
+    same_par = parent[:, None] == parent[None, :]
+    root_pair = (
+        (parent[:, None] < 0)
+        & (parent[None, :] < 0)
+        & (origin[:, None] == origin[None, :])
+    )
+    sib = (
+        both_ins
+        & jnp.where(parent[:, None] >= 0, same_par, root_pair)
+        & (j[:, None] != j[None, :])
+    )
+    larger = sib & (j[None, :] > j[:, None])
+    W = jnp.sum(jnp.where(larger, size[None, :], 0), axis=1)
+
+    AoS = A | (j[:, None] == j[None, :])
+    rank = depth + jnp.sum(jnp.where(AoS, W[None, :], 0), axis=1)
+
+    # external anchor element: my root's own origin (-1 = document head).
+    is_root = is_ins & (parent < 0)
+    root = (
+        jnp.sum(
+            jnp.where(AoS & is_root[None, :], j[None, :] + 1, 0), axis=1
+        )
+        - 1
+    )
+    anchor = jnp.where(
+        is_ins, origin[jnp.clip(root, 0, B - 1)], -1
+    )
+    return ins, anchor, jnp.where(is_ins, rank, 0), dslot
+
+
+@partial(
+    jax.jit, static_argnames=("batch", "epoch", "nbits"), donate_argnums=(0,)
+)
+def merge_oplogs_packed(
+    state,
+    lamport: jax.Array,
+    agent: jax.Array,
+    kind: jax.Array,
+    elem: jax.Array,
+    origin: jax.Array,
+    ch: jax.Array,
+    *,
+    batch: int = 512,
+    epoch: int = 8,
+    nbits: int | None = None,
+):
+    """merge_oplogs on the packed doc-order state (engine/downstream.py
+    DownPacked) — sort + dedup, then batched chain-structure + id-resolved
+    integration through the same fused-kernel core as the downstream v5
+    apply.  N must be a multiple of ``batch * epoch`` (PAD-pad).
+
+    The whole merge is timed work: causal-order sort, duplicate
+    suppression, origin-chain resolution, id->position resolution
+    (ops/idpos.py), counting merge and expansion all run on device inside
+    this call — the capability of the reference's ``decode_and_add`` loop
+    (reference src/rope.rs:222-224) for arbitrarily divergent op logs.
+    """
+    from ..ops.idpos import snap_rebuild
+    from .downstream import DownPacked, _apply_update_batch5
+
+    lamport, agent, kind, elem, origin, ch = _sort_dedup(
+        lamport, agent, kind, elem, origin, ch
+    )
+    B = batch
+    nb = kind.shape[0] // B
+    if nbits is None:
+        nbits = max(1, B.bit_length())
+    K = min(epoch, nb)
+    if nb % K:
+        raise ValueError(f"batch count {nb} not a multiple of epoch {K}")
+    rs = lambda x: x.reshape(nb // K, K, B)
+
+    def step(st, ops):
+        kind_k, elem_k, origin_k = ops
+        doc, snap, length, nvis = st
+        levels: list = []
+        for k in range(K):
+            ins, anchor, rank, dslot = _chain_structure(
+                kind_k[k], elem_k[k], origin_k[k]
+            )
+            doc, length, nvis, lv = _apply_update_batch5(
+                doc, length, nvis, snap, levels, ins, anchor, rank, dslot,
+                nbits=nbits,
+            )
+            levels.append(lv)
+        return DownPacked(doc, snap_rebuild(doc), length, nvis), None
+
+    state, _ = jax.lax.scan(
+        step, state, (rs(kind), rs(elem), rs(origin))
+    )
+    return state
+
+
 # ---- host-side driver ------------------------------------------------------
 
 
@@ -374,9 +530,10 @@ class MergeSimulation:
             )
         return out
 
-    def _padded(self, log: OpLog) -> OpLog:
+    def _padded(self, log: OpLog, multiple: int | None = None) -> OpLog:
         n = len(log)
-        n_pad = (-n) % self.batch if n else self.batch
+        m = multiple or self.batch
+        n_pad = (-n) % m if n else m
         if not n_pad:
             return log
         z = lambda fill: np.full(n_pad, fill, np.int32)
@@ -404,8 +561,121 @@ class MergeSimulation:
             batch=self.batch,
         )
 
-    def decode(self, state: DownState) -> str:
+    def merge_packed(self, log: OpLog | None = None, n_replicas: int = 1,
+                     epoch: int = 8):
+        """Replica-batched merge on the packed fast path
+        (merge_oplogs_packed); returns a DownPacked state."""
+        from ..ops.idpos import snap_init
+        from ..ops.apply2 import init_state3
+        from .downstream import DownPacked
+
+        if self.capacity >= 1 << 25:
+            raise ValueError(
+                f"capacity {self.capacity} >= 2^25 exceeds the packed fill"
+                " range"
+            )
+        log = self._padded(
+            log if log is not None else self.log,
+            multiple=self.batch * epoch,
+        )
+        s3 = init_state3(n_replicas, self.capacity, self.n_base)
+        state = DownPacked(
+            doc=s3.doc,
+            snap=snap_init(n_replicas, self.capacity),
+            length=s3.length,
+            nvis=s3.nvis,
+        )
+        return merge_oplogs_packed(
+            state,
+            jnp.asarray(log.lamport),
+            jnp.asarray(log.agent),
+            jnp.asarray(log.kind),
+            jnp.asarray(log.elem),
+            jnp.asarray(log.origin),
+            jnp.asarray(log.ch),
+            batch=self.batch,
+            epoch=epoch,
+        )
+
+    def decode(self, state) -> str:
+        from ..ops.apply2 import PackedState, decode_state3
+        from .downstream import DownPacked
+
+        if isinstance(state, DownPacked):
+            codes, nvis = jax.jit(
+                decode_state3, static_argnames=("replica",)
+            )(
+                PackedState(
+                    doc=state.doc, length=state.length, nvis=state.nvis
+                ),
+                self.chars,
+            )
+            return "".join(
+                map(chr, np.asarray(codes)[: int(nvis)].tolist())
+            )
         return decode_to_str(state, self.chars)
+
+
+# ---- native cross-validation ----------------------------------------------
+
+
+def to_native_ops(sim: "MergeSimulation", log: OpLog | None = None,
+                  base_agent: int = 1_000_000):
+    """Translate a (union) op log into the native treap's struct-of-array
+    form (backends/native.py NativeMerge): ids become (agent, seq=lamport);
+    base slot k maps to (base_agent, k+1) per crdt_new's base assignment;
+    origin -1 (document head) maps to the native HEAD (0, 0); DELETE rows
+    carry the TARGET's id.  Ops are (lamport, agent)-sorted host-side.
+    Returns (type, id_agent, id_seq, org_agent, org_seq, ch) arrays."""
+    log = log if log is not None else sim.log
+    # slot -> (agent, seq) table
+    agent_of = np.zeros(sim.capacity, np.uint32)
+    seq_of = np.zeros(sim.capacity, np.uint32)
+    nb = sim.n_base
+    agent_of[:nb] = base_agent
+    seq_of[:nb] = np.arange(1, nb + 1, dtype=np.uint32)
+    for l in sim.agent_logs:
+        ins = l.kind == INSERT
+        agent_of[l.elem[ins]] = l.agent[ins].astype(np.uint32)
+        seq_of[l.elem[ins]] = l.lamport[ins].astype(np.uint32)
+
+    live = log.kind != PAD
+    order = np.lexsort((log.agent[live], log.lamport[live]))
+    k = log.kind[live][order]
+    elem = log.elem[live][order]
+    origin = log.origin[live][order]
+    is_ins = k == INSERT
+    type_ = np.where(is_ins, 1, 2).astype(np.uint8)
+    id_agent = np.where(
+        is_ins, log.agent[live][order].astype(np.uint32),
+        agent_of[np.clip(elem, 0, None)],
+    ).astype(np.uint32)
+    id_seq = np.where(
+        is_ins, log.lamport[live][order].astype(np.uint32),
+        seq_of[np.clip(elem, 0, None)],
+    ).astype(np.uint32)
+    head = origin < 0
+    org_agent = np.where(
+        head, 0, agent_of[np.clip(origin, 0, None)]
+    ).astype(np.uint32)
+    org_seq = np.where(
+        head, 0, seq_of[np.clip(origin, 0, None)]
+    ).astype(np.uint32)
+    return type_, id_agent, id_seq, org_agent, org_seq, (
+        log.ch[live][order].astype(np.int32)
+    )
+
+
+def native_merge_content(sim: "MergeSimulation",
+                         log: OpLog | None = None) -> str:
+    """Merged document per the independent native RGA treap."""
+    from ..backends.native import NativeMerge
+
+    nm = NativeMerge(
+        "".join(chr(int(c)) for c in np.asarray(sim.chars)[: sim.n_base])
+    )
+    nm.integrate(*to_native_ops(sim, log))
+    return nm.content()
 
 
 # ---- pure-Python merge oracle ---------------------------------------------

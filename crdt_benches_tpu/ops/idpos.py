@@ -39,15 +39,24 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-BIG = jnp.int32(2**31 - 1)
+BIG = jnp.int32(2**30)
 
 
 class Level(NamedTuple):
-    """One batch's contribution to the epoch position map."""
+    """One batch's contribution to the epoch position map, in RUN form —
+    each entry is a contiguous block of ``rlen`` consecutive slots
+    (slot0..slot0+rlen-1) inserted at post-batch positions
+    dest0..dest0+rlen-1.  Unit-op batches are rlen == 1 runs.
 
-    sub: jax.Array  # int32[R, B] sorted (dest_i - i), invalid rows = BIG
-    slot: jax.Array  # int32[R, B] inserted slot ids (-1 = no insert)
-    dest: jax.Array  # int32[R, B] post-batch destination of slot
+    ``sub[i] = dest0[i] - (chars of runs placed at smaller dest0)`` is the
+    weighted count_le form: an old element at pre-batch position p gains
+    ``sum_i rlen[i] * [sub[i] <= p]`` new left neighbors (a run never
+    splits around an old element — it fills one gap contiguously)."""
+
+    sub: jax.Array  # int32[R, B] (BIG for invalid rows)
+    rlen: jax.Array  # int32[R, B] run length (0 for invalid rows)
+    slot0: jax.Array  # int32[R, B] first slot id (BIG for invalid rows)
+    dest0: jax.Array  # int32[R, B] post-batch position of slot0
 
 
 def snap_rebuild(doc: jax.Array) -> jax.Array:
@@ -71,23 +80,33 @@ def snap_init(n_replicas: int, capacity: int) -> jax.Array:
     )
 
 
-def make_level(dest: jax.Array, is_ins: jax.Array, slot: jax.Array) -> Level:
-    """Build a level from a batch's insert destinations.
+def make_level_runs(
+    dest0: jax.Array, rlen: jax.Array, slot0: jax.Array, live: jax.Array
+) -> Level:
+    """Build a level from a batch's insert runs.
 
-    dest: int32[R, B] post-batch destinations (garbage where ``~is_ins``);
-    slot: int32[R, B] inserted slot ids.  The count_le form: with dests
-    sorted ascending (pads at the end as BIG), the i-th smallest dest has
-    exactly ``D_i - i`` old elements before it, so an old element at
-    pre-batch position p gains ``#{i : D_i - i <= p}`` new left neighbors.
+    dest0: int32[R, B] post-batch position of each run's first char
+    (garbage where ``~live``); rlen: run lengths; slot0: first slot ids.
+    ``sub[i] = dest0[i] - P[i]`` where P[i] = total chars of runs with
+    smaller dest0 (a B x B weighted count — runs fill distinct gaps, so
+    dest0 ties cannot occur among live runs).
     """
-    d = jnp.sort(jnp.where(is_ins, dest, BIG), axis=1)
-    i = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
-    sub = jnp.where(d < BIG, d - i, BIG)
-    return Level(
-        sub=sub,
-        slot=jnp.where(is_ins, slot, -1),
-        dest=dest,
+    L = jnp.where(live, rlen, 0)
+    d = jnp.where(live, dest0, BIG)
+    before = jnp.sum(
+        jnp.where(d[:, None, :] < d[:, :, None], L[:, None, :], 0), axis=2
     )
+    return Level(
+        sub=jnp.where(live, d - before, BIG),
+        rlen=L,
+        slot0=jnp.where(live, slot0, BIG),
+        dest0=dest0,
+    )
+
+
+def make_level(dest: jax.Array, is_ins: jax.Array, slot: jax.Array) -> Level:
+    """Unit-op level: each insert is a length-1 run."""
+    return make_level_runs(dest, jnp.ones_like(dest), slot, is_ins)
 
 
 def query(
@@ -95,16 +114,22 @@ def query(
 ) -> jax.Array:
     """Current physical positions of ``ids`` (int32[R, B]; rows with
     ids < 0 return garbage — mask at the call site).  ``levels`` are the
-    epoch's batches oldest-first; each is applied as shift-then-override."""
+    epoch's batches oldest-first; each is applied as shift-then-override
+    (an id inserted at level k takes its in-run position, already in that
+    level's frame, then shifts through newer levels)."""
     R, C = snap.shape
     p = jnp.take_along_axis(snap, jnp.clip(ids, 0, C - 1), axis=1)
     for lv in levels:
         shift = jnp.sum(
-            (lv.sub[:, None, :] <= p[:, :, None]).astype(jnp.int32), axis=2
+            jnp.where(
+                lv.sub[:, None, :] <= p[:, :, None], lv.rlen[:, None, :], 0
+            ),
+            axis=2,
         )
         p = p + shift
-        eq = ids[:, :, None] == lv.slot[:, None, :]
-        found = jnp.any(eq, axis=2)
-        pd = jnp.sum(jnp.where(eq, lv.dest[:, None, :], 0), axis=2)
+        off = ids[:, :, None] - lv.slot0[:, None, :]
+        m = (off >= 0) & (off < lv.rlen[:, None, :])
+        found = jnp.any(m, axis=2)
+        pd = jnp.sum(jnp.where(m, lv.dest0[:, None, :] + off, 0), axis=2)
         p = jnp.where(found, pd, p)
     return p

@@ -34,3 +34,21 @@ def doc_digest(order: jax.Array, visible: jax.Array, length: jax.Array,
     h1 = jnp.sum(rank * (ch * _MIX + 1), where=vis, initial=0)
     h2 = jnp.sum((rank * rank) ^ (ch * 31 + rank), where=vis, initial=0)
     return jnp.stack([h1, h2, rank[-1]])
+
+
+def doc_digest_packed(doc: jax.Array, length: jax.Array,
+                      chars: jax.Array) -> jax.Array:
+    """doc_digest over one replica's packed doc-order state
+    (ops/apply2.py PackedState layout: ((slot+2)<<1)|vis, tombstones
+    in-line).  Same digest value as doc_digest on the equivalent
+    order/visible arrays."""
+    C = doc.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    valid = idx < length
+    slot = jnp.right_shift(doc, 1) - 2
+    vis = valid & (jnp.bitwise_and(doc, 1) > 0)
+    rank = jnp.cumsum(vis.astype(jnp.int32))
+    ch = jnp.where(vis, chars[jnp.clip(slot, 0, chars.shape[0] - 1)], 0)
+    h1 = jnp.sum(rank * (ch * _MIX + 1), where=vis, initial=0)
+    h2 = jnp.sum((rank * rank) ^ (ch * 31 + rank), where=vis, initial=0)
+    return jnp.stack([h1, h2, rank[-1]])

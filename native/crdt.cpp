@@ -367,6 +367,86 @@ int64_t crdt_apply_updates(void* h, const uint8_t* flat, const int64_t* offsets,
     return c->len();
 }
 
+// Replay patches on a fresh single-agent replica and dump the FULL final
+// node order (slot = seq-1 per node, tombstones included), per-node final
+// visibility, and the per-unit-op delete-target sequence (slot of each
+// tombstoned char, in op order, from the op log).  This is the
+// insertion-faithful order the range-granular update generation
+// (engine/downstream_range.py) anchors against: local inserts splice
+// DIRECTLY after their origin (insert_after), the same convention the
+// receiver's anchor/rank apply reproduces — unlike a content-equivalent
+// order variant, it keeps delete-interval contiguity exact.
+// order_out/vis_out sized >= total nodes; dtarget_out sized >= total
+// deletes.  Returns total node count (or -1 if caps insufficient).
+int64_t crdt_replay_dump(const int32_t* init, int64_t init_n,
+                         const int32_t* pos, const int32_t* del_count,
+                         const int32_t* ins_off, const int32_t* ins_flat,
+                         int64_t n_patches,
+                         int32_t* order_out, int64_t order_cap,
+                         uint8_t* vis_out,
+                         int32_t* dtarget_out, int64_t dtarget_cap) {
+    Crdt* c = crdt_make(init, init_n, 1);
+    for (int64_t i = 0; i < n_patches; i++) {
+        uint32_t p = (uint32_t)pos[i];
+        uint32_t d = (uint32_t)del_count[i];
+        if (d) c->local_remove(p, p + d);
+        int32_t a = ins_off[i], b = ins_off[i + 1];
+        if (b > a) c->local_insert(p, ins_flat + a, (size_t)(b - a));
+    }
+    int64_t total = (int64_t)call(c->root);
+    int64_t n_del = 0;
+    for (const Op& op : c->oplog)
+        if (op.type == OP_DELETE) n_del++;
+    if (total > order_cap || n_del > dtarget_cap) {
+        c->free_all();
+        delete c;
+        return -1;
+    }
+    // full in-order traversal (tombstones included)
+    std::vector<Node*> stack;
+    Node* n = c->root;
+    size_t k = 0;
+    while (n || !stack.empty()) {
+        while (n) { stack.push_back(n); n = n->l; }
+        n = stack.back(); stack.pop_back();
+        order_out[k] = (int32_t)(n->id.seq - 1);
+        vis_out[k] = n->visible ? 1 : 0;
+        k++;
+        n = n->r;
+    }
+    k = 0;
+    for (const Op& op : c->oplog)
+        if (op.type == OP_DELETE)
+            dtarget_out[k++] = (int32_t)(op.id.seq - 1);
+    c->free_all();
+    delete c;
+    return total;
+}
+
+// Integrate a raw multi-agent op log (arrays of struct-of-array ops) into
+// the replica — the independent native RGA oracle/baseline for the
+// concurrent-merge path (engine/merge.py): same (seq=lamport, agent) id
+// order, same insert-after-origin intention rule, entirely separate
+// implementation (order-statistic treap + right-scan integration point).
+// type: 1=INSERT, 2=DELETE (DELETE's id fields name the TARGET element);
+// origin agent/seq = HEAD (0,0) for document-head inserts.  Returns the
+// visible length after integration.
+int64_t crdt_integrate_ops(void* h, int64_t n, const uint8_t* type,
+                           const uint32_t* id_agent, const uint32_t* id_seq,
+                           const uint32_t* org_agent, const uint32_t* org_seq,
+                           const int32_t* ch) {
+    Crdt* c = static_cast<Crdt*>(h);
+    for (int64_t i = 0; i < n; i++) {
+        Op op;
+        op.type = type[i];
+        op.id = Id{id_agent[i], id_seq[i]};
+        op.origin = Id{org_agent[i], org_seq[i]};
+        op.ch = ch[i];
+        c->integrate(op);
+    }
+    return c->len();
+}
+
 // One timed upstream iteration entirely native: init + per-patch replace +
 // final length (reference src/main.rs:28-37 semantics).
 int64_t crdt_replay(const int32_t* init, int64_t init_n,

@@ -1,0 +1,95 @@
+"""Range-layout downstream (engine/downstream_range.py): run-granular
+updates integrate to byte-identical final content, including block
+replaces, same-batch insert+delete kills, and the real block-edit traces."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.downstream_range import (
+    JaxRangeDownstreamEngine,
+    generate_range_updates,
+)
+from crdt_benches_tpu.oracle import OracleDocument
+from crdt_benches_tpu.traces.loader import TestData, TestTxn
+
+
+def check(patches, start="", batch_ops=4, n_replicas=1, epoch=2):
+    trace = TestData(start, "", [TestTxn("", patches)])
+    doc = OracleDocument.from_str(start)
+    for pos, d, ins in trace.iter_patches():
+        doc.replace(pos, pos + d, ins)
+    want = doc.content()
+    trace = TestData(start, want, [TestTxn("", patches)])
+    eng = JaxRangeDownstreamEngine(
+        trace, n_replicas=n_replicas, batch_ops=batch_ops, epoch=epoch
+    )
+    state = eng.run()
+    for r in range(n_replicas):
+        assert eng.decode(state, replica=r) == want
+
+
+def test_block_appends():
+    check([[0, 0, "hello "], [6, 0, "world"], [0, 0, ">> "]])
+
+
+def test_block_replace():
+    check([[0, 0, "abcdefgh"], [2, 3, "XY"], [0, 1, "z"]])
+
+
+def test_same_batch_insert_then_delete_block():
+    # insert a block and delete part of it within the same wire batch
+    check([[0, 0, "abcdef"], [1, 3, ""], [1, 0, "Q"]], batch_ops=8)
+
+
+def test_delete_spanning_batches():
+    check(
+        [[0, 0, "abcdefghij"], [0, 0, "123"], [2, 8, "Z"]],
+        batch_ops=2,
+    )
+
+
+def test_multi_replica():
+    check(
+        [[0, 0, "hello"], [5, 0, " there"], [0, 2, "HE"]],
+        n_replicas=3,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 3, 8])
+def test_random_block_edits_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    patches = []
+    doc_len = 0
+    letters = "abcdefghijklmnop"
+    for _ in range(120):
+        pos = int(rng.integers(0, doc_len + 1))
+        if doc_len and rng.random() < 0.35:
+            d = int(rng.integers(1, min(doc_len - pos, 9) + 1)) if (
+                pos < doc_len
+            ) else 0
+        else:
+            d = 0
+        n_ins = int(rng.integers(0, 7))
+        ins = "".join(
+            rng.choice(list(letters), n_ins)
+        ) if n_ins else ""
+        if d == 0 and not ins:
+            ins = "x"
+        patches.append([pos, d, ins])
+        doc_len += len(ins) - d
+    check(patches, batch_ops=8, epoch=4)
+
+
+def test_svelte_trace_byte_identical(svelte_trace):
+    eng = JaxRangeDownstreamEngine(svelte_trace, batch_ops=256)
+    state = eng.run()
+    assert int(np.asarray(state.nvis).reshape(-1)[0]) == len(
+        svelte_trace.end_content
+    )
+    assert eng.decode(state) == svelte_trace.end_content
+
+
+def test_wire_size_reported(svelte_trace):
+    upd = generate_range_updates(svelte_trace, batch_ops=256)
+    assert upd.nbytes() > 0
+    assert upd.n_patches == len(svelte_trace)

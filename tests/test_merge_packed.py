@@ -1,0 +1,129 @@
+"""Packed fast-path merge (engine/merge.py merge_oplogs_packed): the
+parallel chain-structure + id-resolved integration must agree byte-for-byte
+with the merge oracle, the portable v1 merge kernel, and across replicas,
+delivery orders, duplication, and batch/epoch choices."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.merge import (
+    MergeSimulation,
+    OpLog,
+    merge_oracle,
+)
+
+from test_merge import make_stream, shuffled_log, sim_for
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_packed_vs_oracle_and_v1(seed):
+    sim = sim_for(seed=seed, n_agents=3, n_ops=40)
+    want = merge_oracle(sim.log, "base text", np.asarray(sim.chars))
+    assert sim.decode(sim.merge()) == want
+    got = sim.decode(sim.merge_packed())
+    assert got == want
+
+
+def test_packed_replica_batched():
+    sim = sim_for(seed=9, n_agents=2, n_ops=30)
+    want = sim.decode(sim.merge())
+    state = sim.merge_packed(n_replicas=4)
+    for r in range(4):
+        from crdt_benches_tpu.ops.apply2 import PackedState, decode_state3
+        import jax
+
+        codes, nvis = jax.jit(
+            decode_state3, static_argnames=("replica",)
+        )(
+            PackedState(
+                doc=state.doc, length=state.length, nvis=state.nvis
+            ),
+            sim.chars,
+            replica=r,
+        )
+        got = "".join(map(chr, np.asarray(codes)[: int(nvis)].tolist()))
+        assert got == want
+
+
+def test_packed_delivery_order_and_duplication():
+    sim = sim_for(seed=4, n_agents=3, n_ops=30)
+    rng = np.random.default_rng(11)
+    want = sim.decode(sim.merge_packed())
+    got = sim.decode(sim.merge_packed(shuffled_log(sim.log, rng)))
+    assert got == want
+    dup = OpLog.concat([sim.log, sim.log])
+    got = sim.decode(sim.merge_packed(shuffled_log(dup, rng)))
+    assert got == want
+
+
+def test_packed_epoch_and_batch_independence():
+    rng = np.random.default_rng(6)
+    base = "shared"
+    streams = [make_stream(rng, base, 40, batch=16) for _ in range(2)]
+    sim16 = MergeSimulation(streams, base=base, batch=16)
+    sim8 = MergeSimulation(streams, base=base, batch=8)
+    want = sim16.decode(sim16.merge())
+    assert sim16.decode(sim16.merge_packed(epoch=2)) == want
+    assert sim16.decode(sim16.merge_packed(epoch=8)) == want
+    assert sim8.decode(sim8.merge_packed(epoch=4)) == want
+
+
+def test_packed_deep_chains_single_anchor():
+    """Adversarial shape: every agent types at position 0 (deep
+    same-anchor sibling chains + long internal runs)."""
+    from crdt_benches_tpu.traces.loader import TestData, TestTxn
+    from crdt_benches_tpu.traces.tensorize import tensorize
+
+    base = "x"
+    streams = []
+    for a in range(3):
+        patches = [[0, 0, chr(ord("a") + a) * 1] for _ in range(17)]
+        streams.append(
+            tensorize(TestData(base, "", [TestTxn("", patches)]), batch=8)
+        )
+    sim = MergeSimulation(streams, base=base, batch=8)
+    want = merge_oracle(sim.log, base, np.asarray(sim.chars))
+    assert sim.decode(sim.merge()) == want
+    assert sim.decode(sim.merge_packed(epoch=4)) == want
+
+
+def test_native_treap_agrees_small():
+    """The independent native RGA treap (separate implementation, C++)
+    agrees with both the Python oracle and the packed kernel."""
+    from crdt_benches_tpu.backends.native import native_available
+    from crdt_benches_tpu.engine.merge import native_merge_content
+
+    if not native_available():
+        import pytest as _pytest
+
+        _pytest.skip("native library unavailable")
+    for seed in range(3):
+        sim = sim_for(seed=seed, n_agents=3, n_ops=40)
+        want = merge_oracle(sim.log, "base text", np.asarray(sim.chars))
+        assert native_merge_content(sim) == want
+        assert sim.decode(sim.merge_packed()) == want
+
+
+def test_native_treap_agrees_100k_ops_24_agents():
+    """Independent large-scale validation (VERDICT round 1 item 6): >=100k
+    ops across dozens of agents, cross-checked against the native treap's
+    RGA integration — a separate implementation, not the shared-spec Python
+    oracle (which is infeasible at this size)."""
+    from crdt_benches_tpu.backends.native import native_available
+    from crdt_benches_tpu.engine.merge import native_merge_content
+
+    if not native_available():
+        import pytest as _pytest
+
+        _pytest.skip("native library unavailable")
+    rng = np.random.default_rng(42)
+    base = "base text for the concurrent merge scale test"
+    streams = [
+        make_stream(rng, base, 4200, batch=512) for _ in range(24)
+    ]
+    sim = MergeSimulation(streams, base=base, batch=512)
+    assert len(sim.log) >= 100_000
+    want = native_merge_content(sim)
+    got = sim.decode(sim.merge_packed(epoch=8))
+    assert len(got) == len(want)
+    assert got == want
