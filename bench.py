@@ -79,7 +79,9 @@ def main() -> int:
         )
         jax.config.update("jax_platforms", "cpu")
         platform = jax.devices()[0].platform
-    default_r = 128 if platform not in ("cpu",) else 8
+    # 1024 replicas = the BASELINE.md config-4 shape (aggregate throughput
+    # is flat from 128 up — per-replica O(C) work saturates the chip).
+    default_r = 1024 if platform not in ("cpu",) else 8
     replicas = int(os.environ.get("CRDT_BENCH_REPLICAS", str(default_r)))
 
     from crdt_benches_tpu.backends.jax_backend import JaxReplayBackend

@@ -174,17 +174,23 @@ def _merge_sim(config: str, merge_ops: int, batch: int):
             tensorize(load_testing_data("seph-blog1"), batch=batch),
         ]
         return MergeSimulation(streams, base="", batch=batch)
-    if config == "synthetic":
+    if config in ("synthetic", "adversarial"):
         from ..traces.loader import TestData, TestTxn
         from ..traces.synth import random_patches
 
         n_agents = 16
         rng = np.random.default_rng(1234)
         base = "the quick brown fox jumps over the lazy dog " * 4
+        # adversarial: merge_ops counts DELIVERED ops — the unique op set
+        # is merge_ops/16, and the delivered stream is built by run_merge
+        # as shuffled duplicated deliveries (capacity = unique inserts, so
+        # the state fits VMEM kernels while the merge still chews through
+        # the full delivered volume with dedup + idempotent integration).
+        unique_ops = merge_ops // 16 if config == "adversarial" else merge_ops
         streams = []
         for _ in range(n_agents):
             patches, _ = random_patches(
-                rng, merge_ops // n_agents, len(base)
+                rng, unique_ops // n_agents, len(base)
             )
             streams.append(
                 tensorize(
@@ -193,6 +199,27 @@ def _merge_sim(config: str, merge_ops: int, batch: int):
             )
         return MergeSimulation(streams, base=base, batch=batch)
     raise ValueError(f"unknown merge config {config!r}")
+
+
+def _delivered_log(sim, config: str, merge_ops: int):
+    """The wire-delivered op stream for a merge cell: the plain union, or
+    (adversarial) ~merge_ops shuffled ops where every unique op is
+    delivered ~16 times — the duplicated/reordered-delivery fault model
+    (CRDT idempotence at scale, BASELINE.md config 5)."""
+    import numpy as np
+
+    from ..engine.merge import OpLog
+
+    if config != "adversarial":
+        return sim.log
+    reps = max(1, merge_ops // max(len(sim.log), 1))
+    log = OpLog.concat([sim.log] * reps)
+    rng = np.random.default_rng(99)
+    perm = rng.permutation(len(log))
+    return OpLog(
+        *(getattr(log, f)[perm]
+          for f in ("lamport", "agent", "kind", "elem", "origin", "ch"))
+    )
 
 
 def run_merge(config: str, backend: str, samples: int, warmup: int,
@@ -207,14 +234,15 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
     import numpy as np
 
     sim = _merge_sim(config, merge_ops, batch)
-    elements = len(sim.log)
+    delivered = _delivered_log(sim, config, merge_ops)
+    elements = len(delivered)
     if backend == "cpp-crdt":
         from ..backends.native import NativeMerge, native_available
         from ..engine.merge import to_native_ops
 
         if not native_available():
             return None
-        ops = to_native_ops(sim)  # untimed translation, like encode
+        ops = to_native_ops(sim, delivered)  # untimed translation
         base = "".join(
             chr(int(c)) for c in np.asarray(sim.chars)[: sim.n_base]
         )
@@ -233,16 +261,15 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
         import jax
         import jax.numpy as jnp
 
-        from ..engine.downstream import DownPacked
+        from ..engine.downstream import down_packed_init
         from ..engine.merge import merge_oplogs_packed
-        from ..ops.apply2 import init_state3
-        from ..ops.idpos import snap_init
         from ..utils.digest import doc_digest_packed
 
-        # Pad + upload the union log ONCE (the cpp baseline's translation
-        # is likewise untimed); the timed region is fresh-replica init +
-        # on-device sort/dedup/integrate + convergence check.
-        log = sim._padded(sim.log, multiple=sim.batch * epoch)
+        # Pad + upload the delivered log ONCE (the cpp baseline's
+        # translation is likewise untimed); the timed region is
+        # fresh-replica init + on-device sort/dedup/integrate +
+        # convergence check.
+        log = sim._padded(delivered, multiple=sim.batch * epoch)
         dev = [
             jnp.asarray(getattr(log, f))
             for f in ("lamport", "agent", "kind", "elem", "origin", "ch")
@@ -252,17 +279,12 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
         )
 
         def iter_fn():
-            s3 = init_state3(replicas, sim.capacity, sim.n_base)
             state = merge_oplogs_packed(
-                DownPacked(
-                    doc=s3.doc,
-                    snap=snap_init(replicas, sim.capacity),
-                    length=s3.length,
-                    nvis=s3.nvis,
-                ),
+                down_packed_init(replicas, sim.capacity, sim.n_base),
                 *dev,
                 batch=sim.batch,
                 epoch=epoch,
+                max_unique=len(sim.log),
             )
             d = digest_r(state.doc, state.length, sim.chars)
             converged = bool(
@@ -387,8 +409,12 @@ def verify_merge(config: str, merge_ops: int, batch: int,
     if not native_available():
         return None
     sim = _merge_sim(config, merge_ops, batch)
-    want = native_merge_content(sim)
-    state = sim.merge_packed(n_replicas=replicas, epoch=epoch)
+    delivered = _delivered_log(sim, config, merge_ops)
+    want = native_merge_content(sim, delivered)
+    state = sim.merge_packed(
+        log=delivered, n_replicas=replicas, epoch=epoch,
+        max_unique=len(sim.log),
+    )
     return sim.decode(state) == want
 
 

@@ -412,6 +412,22 @@ class DownPacked(NamedTuple):
     nvis: jax.Array  # int32[R]
 
 
+def down_packed_init(
+    n_replicas: int, capacity: int, n_init: int
+) -> DownPacked:
+    """Fresh replica-batched DownPacked (base content laid out in order)."""
+    from ..ops.apply2 import init_state3
+    from ..ops.idpos import snap_init
+
+    s3 = init_state3(n_replicas, capacity, n_init)
+    return DownPacked(
+        doc=s3.doc,
+        snap=snap_init(n_replicas, capacity),
+        length=s3.length,
+        nvis=s3.nvis,
+    )
+
+
 def _apply_update_batch5(doc, length, nvis, snap, levels, ins, anchor,
                          rank, dslot, *, nbits: int):
     """Integrate one anchor/rank update batch with id->position resolution
@@ -617,17 +633,8 @@ class JaxDownstreamEngine:
 
     def run(self):
         if self.engine == "v5":
-            from ..ops.apply2 import init_state3
-            from ..ops.idpos import snap_init
-
-            s3 = init_state3(
+            st = down_packed_init(
                 self.n_replicas, self.upd.capacity, self.upd.n_init
-            )
-            st = DownPacked(
-                doc=s3.doc,
-                snap=snap_init(self.n_replicas, self.upd.capacity),
-                length=s3.length,
-                nvis=s3.nvis,
             )
             return apply_updates5(
                 st, self.ins_b, self.anchor_b, self.rank_b, self.dslot_b,

@@ -16,7 +16,7 @@ Wire form (generated UNTIMED, like reference ``upstream_updates``):
   same-anchor runs by ``rank``; ``alive=0`` runs are inserted already
   tombstoned (every char is deleted later in the SAME batch — generation
   splits runs at kill boundaries so aliveness is uniform per wire run).
-- delete interval: (dfirst, dlast, dcount) — element ids of the first and
+- delete interval: (dfirst, dlast) — element ids of the first and
   last earlier-batch targets; at apply time every *visible* element in the
   physical interval [pos(dfirst), pos(dlast)] is a target (tombstones in
   between were deleted earlier; same-batch targets are not in the pre-batch
@@ -57,7 +57,6 @@ class RangeUpdates:
     alive: np.ndarray  # int32[nb, W] 0/1
     dfirst: np.ndarray  # int32[nb, W] delete-interval first id (-1 = none)
     dlast: np.ndarray  # int32[nb, W]
-    dcount: np.ndarray  # int32[nb, W]
     capacity: int
     n_init: int
     chars: np.ndarray
@@ -69,7 +68,7 @@ class RangeUpdates:
             a.nbytes
             for a in (
                 self.anchor, self.rank, self.slot0, self.rlen, self.alive,
-                self.dfirst, self.dlast, self.dcount,
+                self.dfirst, self.dlast,
             )
         )
 
@@ -170,7 +169,6 @@ def generate_range_updates(
     a_pos_all = _prev_smaller(arrb)
 
     # killed[slot]: deleted by a delete op in the SAME wire batch
-    killed = np.zeros(capacity, bool)
     del_batch = np.full(capacity, -1, np.int64)  # wire batch that deletes it
     for i in np.nonzero(r_kind_a == 2)[0]:
         tgt = dslot_unit[r_a_a[i] : r_a_a[i] + r_len_a[i]]
@@ -254,7 +252,6 @@ def generate_range_updates(
     alive_a = np.zeros((nb, W), np.int32)
     dfirst = np.full((nb, W), -1, np.int32)
     dlast = np.full((nb, W), -1, np.int32)
-    dcount = np.zeros((nb, W), np.int32)
     si = 0
     for b, ops in enumerate(rows):
         for j, op in enumerate(ops):
@@ -268,13 +265,12 @@ def generate_range_updates(
             else:
                 dfirst[b, j] = op[1]
                 dlast[b, j] = op[2]
-                dcount[b, j] = op[3]
 
     from .replay import slot_char_table
 
     return RangeUpdates(
         anchor=anchor, rank=rank_a, slot0=slot0_a, rlen=rlen_a,
-        alive=alive_a, dfirst=dfirst, dlast=dlast, dcount=dcount,
+        alive=alive_a, dfirst=dfirst, dlast=dlast,
         capacity=capacity, n_init=n_init,
         chars=slot_char_table(tt, capacity),
         end_content=tt.end_content, n_patches=tt.n_patches,
@@ -283,7 +279,7 @@ def generate_range_updates(
 
 def _apply_range_update_batch5(
     doc, length, nvis, snap, levels,
-    anchor, rank, slot0, rlen, alive, dfirst, dlast, dcount,
+    anchor, rank, slot0, rlen, alive, dfirst, dlast,
     *, nbits: int,
 ):
     """Integrate one range wire batch with id->position resolution inside
@@ -299,11 +295,15 @@ def _apply_range_update_batch5(
     has_del = dfirst >= 0
     bc = lambda x: jnp.broadcast_to(x[None], (R, W))
 
-    # ---- resolve ids: anchors + delete interval endpoints (one combined
-    # query keeps the per-level passes shared) ----
-    a_phys = query(snap, levels, bc(anchor))
-    lo_phys = query(snap, levels, bc(dfirst))
-    hi_phys = query(snap, levels, bc(dlast))
+    # ---- resolve ids: anchors + delete interval endpoints in ONE query
+    # (a (R, 3W) id batch shares the per-level shift/override passes) ----
+    allq = query(
+        snap, levels,
+        jnp.concatenate([bc(anchor), bc(dfirst), bc(dlast)], axis=1),
+    )
+    a_phys = allq[:, :W]
+    lo_phys = allq[:, W : 2 * W]
+    hi_phys = allq[:, 2 * W :]
     gap = jnp.where(
         bc(is_ins), jnp.where(bc(anchor) >= 0, a_phys + 1, 0), drop
     )
@@ -439,7 +439,7 @@ def _apply_range_update_batch5(
 @partial(jax.jit, static_argnames=("nbits", "epoch"), donate_argnums=(0,))
 def apply_range_updates5(
     state: DownPacked,
-    anchor_b, rank_b, slot0_b, rlen_b, alive_b, dfirst_b, dlast_b, dcount_b,
+    anchor_b, rank_b, slot0_b, rlen_b, alive_b, dfirst_b, dlast_b,
     *, nbits: int, epoch: int = 8,
 ) -> DownPacked:
     """Scan all range wire batches; snapshot epoch structure as in
@@ -453,13 +453,13 @@ def apply_range_updates5(
     rs = lambda x: x.reshape(NB // K, K, W)
 
     def step(st, upd):
-        a, r, s0, ln, al, df, dl, dc = upd
+        a, r, s0, ln, al, df, dl = upd
         doc, snap, length, nvis = st
         levels: list = []
         for k in range(K):
             doc, length, nvis, lv = _apply_range_update_batch5(
                 doc, length, nvis, snap, levels,
-                a[k], r[k], s0[k], ln[k], al[k], df[k], dl[k], dc[k],
+                a[k], r[k], s0[k], ln[k], al[k], df[k], dl[k],
                 nbits=nbits,
             )
             levels.append(lv)
@@ -471,7 +471,7 @@ def apply_range_updates5(
             rs(x)
             for x in (
                 anchor_b, rank_b, slot0_b, rlen_b, alive_b,
-                dfirst_b, dlast_b, dcount_b,
+                dfirst_b, dlast_b,
             )
         ),
     )
@@ -514,7 +514,6 @@ class JaxRangeDownstreamEngine:
         self.alive_b = f(self.upd.alive, 0)
         self.dfirst_b = f(self.upd.dfirst, -1)
         self.dlast_b = f(self.upd.dlast, -1)
-        self.dcount_b = f(self.upd.dcount, 0)
         self.chars = jnp.asarray(self.upd.chars)
         self.nbits = max(
             1, int(self.upd.rlen.sum(axis=1).max(initial=1)).bit_length()
@@ -535,7 +534,7 @@ class JaxRangeDownstreamEngine:
         )
         return apply_range_updates5(
             st, self.anchor_b, self.rank_b, self.slot0_b, self.rlen_b,
-            self.alive_b, self.dfirst_b, self.dlast_b, self.dcount_b,
+            self.alive_b, self.dfirst_b, self.dlast_b,
             nbits=self.nbits, epoch=self.epoch,
         )
 

@@ -409,7 +409,9 @@ def _chain_structure(kind, elem, origin):
 
 
 @partial(
-    jax.jit, static_argnames=("batch", "epoch", "nbits"), donate_argnums=(0,)
+    jax.jit,
+    static_argnames=("batch", "epoch", "nbits", "max_unique"),
+    donate_argnums=(0,),
 )
 def merge_oplogs_packed(
     state,
@@ -423,6 +425,7 @@ def merge_oplogs_packed(
     batch: int = 512,
     epoch: int = 8,
     nbits: int | None = None,
+    max_unique: int | None = None,
 ):
     """merge_oplogs on the packed doc-order state (engine/downstream.py
     DownPacked) — sort + dedup, then batched chain-structure + id-resolved
@@ -434,6 +437,12 @@ def merge_oplogs_packed(
     (ops/idpos.py), counting merge and expansion all run on device inside
     this call — the capability of the reference's ``decode_and_add`` loop
     (reference src/rope.rs:222-224) for arbitrarily divergent op logs.
+
+    ``max_unique`` (static) bounds the DISTINCT op count: under
+    duplicated/reordered delivery the full N-op stream is sorted and
+    deduplicated, but integration only walks the unique prefix (sorted
+    PADs sink to the end) — the receiver-side analog of an op-log
+    capacity, so a 10x-duplicated delivery doesn't pay 10x integration.
     """
     from ..ops.idpos import snap_rebuild
     from .downstream import DownPacked, _apply_update_batch5
@@ -442,6 +451,15 @@ def merge_oplogs_packed(
         lamport, agent, kind, elem, origin, ch
     )
     B = batch
+    if max_unique is not None and max_unique < kind.shape[0]:
+        keep = -(-max_unique // (B * epoch)) * (B * epoch)
+        if keep < kind.shape[0]:
+            # Deduplication PADs duplicates IN PLACE (they sit next to
+            # their survivor in id order); compact survivors to the front
+            # (stable, order-preserving) before slicing the unique prefix.
+            perm = jnp.argsort((kind == PAD).astype(jnp.int8), stable=True)
+            sl = lambda x: jax.lax.slice_in_dim(x[perm], 0, keep, axis=0)
+            kind, elem, origin = sl(kind), sl(elem), sl(origin)
     nb = kind.shape[0] // B
     if nbits is None:
         nbits = max(1, B.bit_length())
@@ -562,29 +580,28 @@ class MergeSimulation:
         )
 
     def merge_packed(self, log: OpLog | None = None, n_replicas: int = 1,
-                     epoch: int = 8):
+                     epoch: int = 8, max_unique: int | None = None):
         """Replica-batched merge on the packed fast path
-        (merge_oplogs_packed); returns a DownPacked state."""
-        from ..ops.idpos import snap_init
-        from ..ops.apply2 import init_state3
-        from .downstream import DownPacked
+        (merge_oplogs_packed); returns a DownPacked state.  For delivered
+        streams with duplicates, pass ``max_unique`` (the distinct-op
+        bound — ``len(self.log)``) so integration walks only the deduped
+        prefix."""
+        from .downstream import down_packed_init
 
-        if self.capacity >= 1 << 25:
+        # spread_fill_combo's three 8-bit chunks carry fill < 2^23, i.e.
+        # capacity < 2^21 (fail loudly — high slot bits would silently
+        # drop, identically on every replica, so even the convergence
+        # check would pass on corrupt content).
+        if self.capacity >= 1 << 21:
             raise ValueError(
-                f"capacity {self.capacity} >= 2^25 exceeds the packed fill"
+                f"capacity {self.capacity} >= 2^21 exceeds the packed fill"
                 " range"
             )
         log = self._padded(
             log if log is not None else self.log,
             multiple=self.batch * epoch,
         )
-        s3 = init_state3(n_replicas, self.capacity, self.n_base)
-        state = DownPacked(
-            doc=s3.doc,
-            snap=snap_init(n_replicas, self.capacity),
-            length=s3.length,
-            nvis=s3.nvis,
-        )
+        state = down_packed_init(n_replicas, self.capacity, self.n_base)
         return merge_oplogs_packed(
             state,
             jnp.asarray(log.lamport),
@@ -595,6 +612,7 @@ class MergeSimulation:
             jnp.asarray(log.ch),
             batch=self.batch,
             epoch=epoch,
+            max_unique=max_unique,
         )
 
     def decode(self, state) -> str:

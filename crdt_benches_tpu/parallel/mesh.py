@@ -152,6 +152,53 @@ def sharded_merge_and_converge(
     return jax.jit(step)
 
 
+def sharded_merge_packed(
+    mesh: Mesh, capacity: int, n_base: int, batch: int, epoch: int = 4,
+    max_unique: int | None = None,
+):
+    """sharded_merge_and_converge on the packed fast path
+    (engine/merge.py merge_oplogs_packed): all_gather the per-replica op
+    logs over the mesh axis, every local replica batch integrates the
+    union through the id-resolved packed kernels, convergence by
+    pmin/pmax digest agreement.  ``step(logs, chars) -> (state, digests,
+    converged)`` with state a DownPacked whose leaves are [R, ...]
+    sharded over the replica axis.
+    """
+    from ..engine.downstream import DownPacked, down_packed_init
+    from ..engine.merge import merge_oplogs_packed
+    from ..utils.digest import doc_digest_packed
+
+    def body(lam, ag, kind, elem, orig, ch, chars):
+        g = lambda x: jax.lax.all_gather(x, AXIS, tiled=True).reshape(-1)
+        union = tuple(map(g, (lam, ag, kind, elem, orig, ch)))
+        state = merge_oplogs_packed(
+            down_packed_init(lam.shape[0], capacity, n_base),
+            *union,
+            batch=batch,
+            epoch=epoch,
+            max_unique=max_unique,
+        )
+        digests = jax.vmap(doc_digest_packed, in_axes=(0, 0, None))(
+            state.doc, state.length, chars
+        )
+        gmin = jax.lax.pmin(jnp.min(digests, axis=0), AXIS)
+        gmax = jax.lax.pmax(jnp.max(digests, axis=0), AXIS)
+        return state, digests, jnp.all(gmin == gmax)
+
+    from ..engine.downstream import DownPacked as _DP
+
+    log_spec = tuple(P(AXIS) for _ in range(6))
+    state_spec = _DP(P(AXIS), P(AXIS), P(AXIS), P(AXIS))
+    step = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=log_spec + (P(),),
+        out_specs=(state_spec, P(AXIS), P()),
+        check_rep=False,
+    )
+    return jax.jit(step)
+
+
 def make_sharded_state(
     mesh: Mesh, n_replicas: int, capacity: int, n_init: int = 0
 ) -> DocState:

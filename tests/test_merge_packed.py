@@ -127,3 +127,44 @@ def test_native_treap_agrees_100k_ops_24_agents():
     got = sim.decode(sim.merge_packed(epoch=8))
     assert len(got) == len(want)
     assert got == want
+
+
+def test_sharded_packed_merge_converges():
+    """8 divergent replicas sharded over the 8-device CPU mesh, merged on
+    the packed fast path: union exchange via all_gather, id-resolved
+    integration per shard, pmin/pmax digest agreement."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_benches_tpu.parallel.mesh import (
+        replica_mesh,
+        sharded_merge_packed,
+    )
+
+    sim = sim_for(seed=9, n_agents=8, n_ops=12, base="mesh base", batch=16)
+    logs = sim.stacked_logs()
+    # gathered union length (8 * N_local) must divide batch * epoch
+    n_local = logs["kind"].shape[1]
+    assert (8 * n_local) % (16 * 2) == 0
+    mesh = replica_mesh(8)
+    step = sharded_merge_packed(
+        mesh, sim.capacity, sim.n_base, batch=16, epoch=2
+    )
+    state, digests, converged = step(
+        jnp.asarray(logs["lamport"]),
+        jnp.asarray(logs["agent"]),
+        jnp.asarray(logs["kind"]),
+        jnp.asarray(logs["elem"]),
+        jnp.asarray(logs["origin"]),
+        jnp.asarray(logs["ch"]),
+        sim.chars,
+    )
+    assert bool(np.asarray(converged))
+    d = np.asarray(digests)
+    assert (d == d[0]).all()
+    from crdt_benches_tpu.engine.downstream import DownPacked
+
+    st0 = jax.tree.map(lambda x: x[:1], state)
+    assert sim.decode(
+        DownPacked(st0.doc, st0.snap, st0.length, st0.nvis)
+    ) == sim.decode(sim.merge())

@@ -127,9 +127,29 @@ def main():
         out = kern_only(kind, pos, carry)
         return carry + out[0][:, 0] * 0, None
 
-    t = (timeit(scan_k(kern_body, v0)) - base) / K
+    try:
+        t = (timeit(scan_k(kern_body, v0)) - base) / K
+        print(
+            f"resolver kernel only:  {t*1e3:8.3f} ms/batch"
+            f"  -> {t/B*1e9/R:8.1f} ns/op/replica"
+        )
+    except TypeError as e:
+        print(f"resolver kernel only:  skipped ({e})")
+
+    # --- apply_batch4 (the default engine's apply) ---
+    from crdt_benches_tpu.ops.apply2 import apply_batch4, init_state4
+
+    st40 = init_state4(R, C, 0)
+
+    def ap4_body(st, _):
+        return apply_batch4(st, resolved4, slot), None
+
+    resolved4 = jax.tree.map(
+        jnp.asarray, resolve_batch_pallas(kind, pos, v0, emit_origin=False)
+    )
+    t = (timeit(scan_k(ap4_body, st40)) - base) / K
     print(
-        f"resolver kernel only:  {t*1e3:8.3f} ms/batch"
+        f"apply_batch4:          {t*1e3:8.3f} ms/batch"
         f"  -> {t/B*1e9/R:8.1f} ns/op/replica"
     )
 
@@ -170,7 +190,7 @@ def main():
     print(f"  rank_to_phys2 x1:    {t*1e3:8.3f} ms")
 
     def mx_body(carry, _):
-        (o,) = _mxu_spread(q, [carry[:, :1] * 0 + 1], C)
+        (o,) = _mxu_spread(q, [carry * 0 + 1], C)
         return carry + o[:, :1] * 0, None
 
     t = (timeit(scan_k(mx_body, q)) - base) / K
