@@ -224,7 +224,7 @@ def _delivered_log(sim, config: str, merge_ops: int):
 
 def run_merge(config: str, backend: str, samples: int, warmup: int,
               replicas: int, batch: int, merge_ops: int,
-              epoch: int = 8) -> BenchResult | None:
+              epoch: int = 32) -> BenchResult | None:
     """Concurrent-merge throughput: timed region = integrate the full
     (shuffle-independent) union of divergent op logs into a fresh replica
     AND confirm convergence (digest agreement across replicas).  Element =
@@ -274,6 +274,15 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
             jnp.asarray(getattr(log, f))
             for f in ("lamport", "agent", "kind", "elem", "origin", "ch")
         ]
+        # non-adversarial unions are concatenated per-agent sorted logs:
+        # rank by count_le passes instead of the device sort
+        segments = None
+        if config != "adversarial":
+            n = len(sim.log)
+            n_pad = (-n) % (sim.batch * epoch)
+            segments = tuple(
+                len(l) for l in sim.agent_logs if len(l)
+            ) + ((n_pad,) if n_pad else ())
         digest_r = jax.jit(
             jax.vmap(doc_digest_packed, in_axes=(0, 0, None))
         )
@@ -285,6 +294,7 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
                 batch=sim.batch,
                 epoch=epoch,
                 max_unique=len(sim.log),
+                segments=segments,
             )
             d = digest_r(state.doc, state.length, sim.chars)
             converged = bool(
@@ -398,7 +408,7 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
 
 
 def verify_merge(config: str, merge_ops: int, batch: int,
-                 replicas: int, epoch: int = 8) -> bool | None:
+                 replicas: int, epoch: int = 32) -> bool | None:
     """Byte-identity for a merge cell: the packed JAX merge's decoded
     document must equal the independent native treap's (engine/merge.py
     native_merge_content), at the same epoch schedule the timed cell
@@ -411,10 +421,14 @@ def verify_merge(config: str, merge_ops: int, batch: int,
     sim = _merge_sim(config, merge_ops, batch)
     delivered = _delivered_log(sim, config, merge_ops)
     want = native_merge_content(sim, delivered)
-    state = sim.merge_packed(
-        log=delivered, n_replicas=replicas, epoch=epoch,
-        max_unique=len(sim.log),
-    )
+    if config == "adversarial":
+        state = sim.merge_packed(
+            log=delivered, n_replicas=replicas, epoch=epoch,
+            max_unique=len(sim.log),
+        )
+    else:
+        # same construction as the timed cell (sorted-segments rank path)
+        state = sim.merge_packed(n_replicas=replicas, epoch=epoch)
     return sim.decode(state) == want
 
 
@@ -434,7 +448,7 @@ def main(argv=None) -> int:
              "random interleaving of ~--merge-ops ops",
     )
     ap.add_argument("--merge-ops", type=int, default=1_000_000)
-    ap.add_argument("--epoch", type=int, default=8,
+    ap.add_argument("--epoch", type=int, default=32,
                     help="id->position snapshot rebuild period (batches)")
     ap.add_argument("--save-baseline", default=None)
     ap.add_argument("--baseline", default=None)

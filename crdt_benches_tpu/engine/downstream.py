@@ -494,23 +494,11 @@ def _apply_update_batch5(doc, length, nvis, snap, levels, ins, anchor,
     n_live = jnp.sum(alive.astype(jnp.int32))
     length2 = length + n_ins
 
-    from ..ops.expand_pallas import (
-        FUSED_STACK_BYTES_PER_POS,
-        apply_fused_nocv,
-        apply_fused_nocv_xla,
-    )
+    from ..ops.expand_pallas import fused_apply_nocv_dispatch
 
-    if (
-        jax.default_backend() == "tpu"
-        and FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20
-    ):
-        doc2 = apply_fused_nocv(
-            doc_predel, combo, cnt_base, length2, nbits=nbits
-        )
-    else:
-        doc2 = apply_fused_nocv_xla(
-            doc_predel, combo, cnt_base, length2, nbits=nbits
-        )
+    doc2 = fused_apply_nocv_dispatch(
+        doc_predel, combo, cnt_base, length2, nbits=nbits
+    )
     level = make_level(dest, bc(is_ins), bc(ins))
     return doc2, length2, nvis + n_live - n_del_eff, level
 
@@ -518,7 +506,7 @@ def _apply_update_batch5(doc, length, nvis, snap, levels, ins, anchor,
 @partial(jax.jit, static_argnames=("nbits", "epoch"), donate_argnums=(0,))
 def apply_updates5(
     state: DownPacked, ins_b, anchor_b, rank_b, dslot_b,
-    *, nbits: int, epoch: int = 8
+    *, nbits: int, epoch: int = 32
 ) -> DownPacked:
     """Scan all anchor/rank update batches into the packed state; the epoch
     snapshot is rebuilt (one scatter) every ``epoch`` batches, with the
@@ -593,7 +581,10 @@ class JaxDownstreamEngine:
         self.epoch = (
             epoch
             if epoch is not None
-            else int(os.environ.get("CRDT_DOWN_EPOCH", "8"))
+            else int(os.environ.get("CRDT_DOWN_EPOCH", "32"))
+        )
+        self.epoch = min(
+            self.epoch, max(1, self.upd.ins_slot.shape[0])
         )
         pad = (-self.upd.ins_slot.shape[0]) % self.epoch
         if pad and self.engine == "v5":

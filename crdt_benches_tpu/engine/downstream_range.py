@@ -415,23 +415,11 @@ def _apply_range_update_batch5(
     n_live = jnp.sum(jnp.where(is_ins, rlen * alive, 0))
     length2 = length + n_ins
 
-    from ..ops.expand_pallas import (
-        FUSED_STACK_BYTES_PER_POS,
-        apply_fused_nocv,
-        apply_fused_nocv_xla,
-    )
+    from ..ops.expand_pallas import fused_apply_nocv_dispatch
 
-    if (
-        jax.default_backend() == "tpu"
-        and FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20
-    ):
-        doc2 = apply_fused_nocv(
-            doc_predel, combo, cnt_base, length2, nbits=nbits
-        )
-    else:
-        doc2 = apply_fused_nocv_xla(
-            doc_predel, combo, cnt_base, length2, nbits=nbits
-        )
+    doc2 = fused_apply_nocv_dispatch(
+        doc_predel, combo, cnt_base, length2, nbits=nbits
+    )
     level = make_level_runs(dest0, bc(rlen), bc(slot0), bc(is_ins))
     return doc2, length2, nvis + n_live - n_del_eff, level
 
@@ -440,7 +428,7 @@ def _apply_range_update_batch5(
 def apply_range_updates5(
     state: DownPacked,
     anchor_b, rank_b, slot0_b, rlen_b, alive_b, dfirst_b, dlast_b,
-    *, nbits: int, epoch: int = 8,
+    *, nbits: int, epoch: int = 32,
 ) -> DownPacked:
     """Scan all range wire batches; snapshot epoch structure as in
     engine/downstream.py apply_updates5."""
@@ -497,8 +485,9 @@ class JaxRangeDownstreamEngine:
         self.epoch = (
             epoch
             if epoch is not None
-            else int(os.environ.get("CRDT_DOWN_EPOCH", "8"))
+            else int(os.environ.get("CRDT_DOWN_EPOCH", "32"))
         )
+        self.epoch = min(self.epoch, max(1, self.upd.anchor.shape[0]))
         pad = (-self.upd.anchor.shape[0]) % self.epoch
         f = lambda a, fill: jnp.asarray(
             np.concatenate(

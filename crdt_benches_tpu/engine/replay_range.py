@@ -71,13 +71,14 @@ class RangeReplayEngine:
         self.n_replicas = n_replicas
         self.capacity = _round_up(max(rt.capacity, 1), lane)
         # Arithmetic-range preconditions of the packed spread paths: the
-        # run-delta spread carries |ddelta| in 3x7-bit chunks and slot/fill
-        # packing shifts by up to 2 bits — fail loudly on oversized traces
-        # instead of silently truncating (ADVICE round 1).
-        if self.capacity >= 1 << 21:
+        # run-delta spread carries |ddelta| <= 2*capacity in 3x7-bit
+        # chunks (< 2^21), so capacity must stay below 2^20 — fail loudly
+        # on oversized traces instead of silently truncating (ADVICE
+        # round 1).
+        if self.capacity >= 1 << 20:
             raise ValueError(
-                f"capacity {self.capacity} >= 2^21 exceeds the packed-spread"
-                " arithmetic range (|ddelta| chunks, tile_base chunks)"
+                f"capacity {self.capacity} >= 2^20 exceeds the packed-spread"
+                " arithmetic range (|ddelta| <= 2*capacity chunks)"
             )
         self.n_init = len(rt.init_chars)
         self.pack = pack

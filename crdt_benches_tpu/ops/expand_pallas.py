@@ -351,6 +351,166 @@ def apply_fused_nocv_xla(doc_predel, combo, cnt_base, new_len, *, nbits: int):
     return out
 
 
+def fused_apply_nocv_dispatch(doc_predel, combo, cnt_base, new_len, *,
+                              nbits: int):
+    """Pick the right no-cumvis fused apply for the platform and capacity:
+    monolithic VMEM kernel under the ~1.09M-position gate, the blocked
+    halo kernel above it (TPU), XLA fallback elsewhere."""
+    C = doc_predel.shape[1]
+    if jax.default_backend() == "tpu":
+        if FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20:
+            return apply_fused_nocv(
+                doc_predel, combo, cnt_base, new_len, nbits=nbits
+            )
+        return apply_fused_blocked(
+            doc_predel, combo, cnt_base, new_len, nbits=nbits
+        )
+    return apply_fused_nocv_xla(
+        doc_predel, combo, cnt_base, new_len, nbits=nbits
+    )
+
+
+def _apply_fused_blocked_kernel(
+    doc_ref, docp_ref, combo_ref, combop_ref, cbase_ref, cbasep_ref,
+    newlen_ref, doc_out, cnt_scr, work_scr,
+    *, bt: int, pt: int, nbits: int,
+):
+    """Blocked fused apply for capacities beyond VMEM: grid (R, nt/bt).
+    The expansion y[d] = x[d - r(d)] reads only LEFTWARD, and every
+    intermediate read of the bit recursion stays within [d - r(d), d]
+    (the 1-Lipschitz argument, see _expand), with r(d) < 2**nbits — so an
+    output block of ``bt`` tiles needs just its own tiles plus a halo of
+    ``pt`` = ceil(2**nbits / 128) + 1 previous tiles, delivered as a
+    second BlockSpec view of the same array (block j-1; at j == 0 the
+    halo aliases block 0, whose values are never read: the gcol >= step
+    guards keep reads at nonnegative global positions).
+
+    The per-tile global insert-count exclusive prefix rides the same
+    block+halo views as the doc (cbase/cbasep, shape (1, bt, 1)) so no
+    dynamic slicing happens in-kernel.
+    """
+    j = pl.program_id(1)
+    ext = pt + bt
+    work_scr[:, :pt, :] = docp_ref[:, bt - pt :, :]
+    work_scr[:, pt:, :] = doc_ref[:]
+    combo = jnp.concatenate(
+        [combop_ref[:, bt - pt :, :], combo_ref[:]], axis=1
+    )
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, ext, LANE), 2)
+    gcol = (
+        (
+            jax.lax.broadcasted_iota(jnp.int32, (1, ext, LANE), 1)
+            + j * bt
+            - pt
+        )
+        * LANE
+        + lane
+    )
+
+    # absolute shift map over the window: within-tile lane cumsum of the
+    # insert indicator + the global per-tile base
+    cnt_scr[:] = jnp.bitwise_and(combo, 1)
+    for b in range(7):
+        s = 1 << b
+        c = cnt_scr[:]
+        cnt_scr[:] = c + jnp.where(lane >= s, _roll_ax(c, s, 2), 0)
+    row = jnp.concatenate(
+        [cbasep_ref[:, bt - pt :, :], cbase_ref[:]], axis=1
+    )
+    cnt_scr[:] = cnt_scr[:] + row
+    maxcnt = jnp.max(cnt_scr[:, pt:, LANE - 1 :])
+
+    for b in reversed(range(nbits)):
+        step = 1 << b
+
+        @pl.when(maxcnt >= step)
+        def _():
+            w = work_scr[:]
+            take = (jnp.bitwise_and(cnt_scr[:], step) != 0) & (
+                gcol >= step
+            )
+            work_scr[:] = jnp.where(take, _flat_roll(w, step), w)
+
+    out = jnp.where(
+        jnp.bitwise_and(combo, 1) != 0,
+        jnp.right_shift(combo, 1),
+        work_scr[:],
+    )
+    out = jnp.where(gcol >= newlen_ref[:], 2, out)
+    doc_out[:] = out[:, pt:, :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nbits", "block_tiles", "interpret")
+)
+def apply_fused_blocked(doc_predel, combo, cnt_base, new_len, *,
+                        nbits: int, block_tiles: int = 1024,
+                        interpret: bool = False):
+    """apply_fused_nocv for arbitrary capacities (the two-pass/windowed
+    form): blocked along C with a left halo of ceil(2**nbits / 128) + 1
+    tiles — the max shift any position receives in one batch.  VMEM per
+    grid step ~ 5 * (block + halo) * 128 * 4 bytes, independent of C."""
+    R, C = doc_predel.shape
+    nt = C // LANE
+    bt = block_tiles
+    while nt % bt:
+        bt //= 2
+    # halo tiles, rounded to a multiple of 8 so every sublane-dim slice
+    # and roll in the kernel stays tile-aligned (unaligned VMEM copies
+    # serialize in Mosaic)
+    pt = -(-(-(-(1 << nbits) // LANE) + 1) // 8) * 8
+    if pt > bt:
+        raise ValueError(
+            f"halo {pt} tiles exceeds block {bt}; raise block_tiles or"
+            " lower the per-batch insert bound (nbits)"
+        )
+    nblk = nt // bt
+    r3 = lambda x: x.reshape(R, nt, LANE)
+    cb3 = cnt_base.reshape(R, nt, 1)
+    blk = pl.BlockSpec(
+        (1, bt, LANE), lambda r, j: (r, j, 0), memory_space=pltpu.VMEM
+    )
+    blkp = pl.BlockSpec(
+        (1, bt, LANE),
+        lambda r, j: (r, jnp.maximum(j - 1, 0), 0),
+        memory_space=pltpu.VMEM,
+    )
+    cbs = pl.BlockSpec(
+        (1, bt, 1), lambda r, j: (r, j, 0), memory_space=pltpu.VMEM
+    )
+    cbsp = pl.BlockSpec(
+        (1, bt, 1),
+        lambda r, j: (r, jnp.maximum(j - 1, 0), 0),
+        memory_space=pltpu.VMEM,
+    )
+    one = pl.BlockSpec(
+        (1, 1, 1), lambda r, j: (r, 0, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _apply_fused_blocked_kernel, bt=bt, pt=pt, nbits=nbits
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(R, nblk),
+        in_specs=[blk, blkp, blk, blkp, cbs, cbsp, one],
+        out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((1, bt + pt, LANE), jnp.int32),
+            pltpu.VMEM((1, bt + pt, LANE), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2**20
+        ),
+        interpret=interpret,
+    )(
+        r3(doc_predel), r3(doc_predel), r3(combo), r3(combo),
+        cb3, cb3,
+        new_len.reshape(R, 1, 1).astype(jnp.int32),
+    )
+    return out.reshape(R, C)
+
+
 def apply_fused_xla(doc_predel, combo, cnt_base, new_len, *, nbits: int):
     """Reference/fallback implementation of apply_fused in plain XLA
     (used on CPU and for differential tests)."""
