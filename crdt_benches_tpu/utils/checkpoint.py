@@ -15,15 +15,17 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..engine.downstream import DownState
+from ..engine.downstream import DownPacked, DownState
 from ..ops.apply import DocState
-from ..ops.apply2 import PackedState, ReplayState
+from ..ops.apply2 import PackedState, PackedState4, ReplayState
 
 _CLASSES = {
     "DocState": DocState,
     "DownState": DownState,
     "ReplayState": ReplayState,
     "PackedState": PackedState,
+    "PackedState4": PackedState4,
+    "DownPacked": DownPacked,
 }
 
 

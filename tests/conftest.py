@@ -35,6 +35,17 @@ import pytest  # noqa: E402
 from crdt_benches_tpu.traces import load_testing_data  # noqa: E402
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_per_module():
+    """Drop compiled executables after each test module: a full-suite run
+    in one process otherwise accumulates enough XLA CPU compile state to
+    segfault mid-run (round-2 verdict, weak #2)."""
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def svelte_trace():
     return load_testing_data("sveltecomponent")
