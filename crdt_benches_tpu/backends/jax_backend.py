@@ -20,45 +20,70 @@ from .base import BatchedReplay
 
 
 class JaxReplayBackend(BatchedReplay):
-    def __init__(self, n_replicas: int = 1, batch: int = 512):
+    def __init__(self, n_replicas: int = 1, batch: int = 512,
+                 layout: str | None = None):
         self.n_replicas = n_replicas
         self.batch = batch
+        #: 'auto' (default; overridable via CRDT_ENGINE_LAYOUT) picks the
+        #: coalesced range engine when RLE shrinks the op stream >= 2x;
+        #: 'unit' forces the per-char engine (the labeled jax-unit bench
+        #: column); 'range' forces the range engine.
+        self.layout = layout
         self._eng: ReplayEngine | None = None
         self._tt = None
 
     @property
     def NAME(self) -> str:  # type: ignore[override]
         plat = jax.devices()[0].platform
-        return f"jax-{plat}" + (f"-r{self.n_replicas}" if self.n_replicas > 1 else "")
+        suffix = f"-{self.layout}" if self.layout else ""
+        return (
+            f"jax-{plat}"
+            + (f"-r{self.n_replicas}" if self.n_replicas > 1 else "")
+            + suffix
+        )
 
     @property
     def replicas(self) -> int:
         return self.n_replicas
 
     def prepare(self, trace: TestData) -> None:
-        # Layout auto-selection (SURVEY.md section 7 hard-part 4): block-edit
-        # traces explode to many unit ops per patch — use the range engine
-        # when the explosion ratio is significant; keystroke traces stay on
-        # the exploded engine (lower per-op constants).
+        # Layout auto-selection (SURVEY.md section 7 hard-part 4): the edit
+        # stream is run-length encoded across patch boundaries
+        # (traces/tensorize.py coalesce_patches — the same RLE diamond-
+        # types' op log applies internally, reference src/rope.rs:119-126)
+        # and replayed as range ops whenever that shrinks the sequential
+        # op count materially; the unit-op engine remains for streams with
+        # no run structure (and as the labeled jax-unit bench column).
         import os
 
-        unit_ops = sum(
-            d + len(ins) for _, d, ins in trace.iter_patches()
-        )
-        range_ops = sum(
-            (1 if d else 0) + (1 if ins else 0)
-            for _, d, ins in trace.iter_patches()
-        )
-        layout = os.environ.get("CRDT_ENGINE_LAYOUT", "auto")
-        use_range = (
-            layout == "range"
-            or (layout == "auto" and unit_ops >= 2 * range_ops)
-        )
+        layout = self.layout or os.environ.get("CRDT_ENGINE_LAYOUT", "auto")
+        coalesce = os.environ.get("CRDT_ENGINE_COALESCE", "1") != "0"
+        patches = None
+        if layout == "auto":
+            from ..traces.tensorize import coalesce_patches
+
+            unit_ops = sum(
+                d + len(ins) for _, d, ins in trace.iter_patches()
+            )
+            patches = list(
+                coalesce_patches(trace) if coalesce
+                else trace.iter_patches()
+            )
+            range_ops = sum(
+                (1 if d else 0) + (1 if ins else 0)
+                for _, d, ins in patches
+            )
+            use_range = unit_ops >= 2 * range_ops
+        else:
+            use_range = layout == "range"
         if use_range:
             from ..engine.replay_range import RangeReplayEngine
             from ..traces.tensorize import tensorize_ranges
 
-            rt = tensorize_ranges(trace, batch=self.batch)
+            rt = tensorize_ranges(
+                trace, batch=self.batch, coalesce=coalesce,
+                patches=patches,
+            )
             self._eng = RangeReplayEngine(
                 rt, n_replicas=self.n_replicas, pack=8
             )

@@ -68,13 +68,27 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
 
         times = measure(iter_fn, warmup=0, samples=max(2, samples // 2))
         return BenchResult("upstream", trace_name, backend, elements, times)
-    if backend == "jax":
+    if backend == "py-reconcile":
+        from ..backends.reconcile import PyReconcile
+
+        def iter_fn():
+            doc = PyReconcile.from_str(trace.start_content)
+            for pos, d, ins in trace.iter_patches():
+                doc.replace(pos, pos + d, ins)
+            assert len(doc) == len(trace.end_content.encode())
+
+        times = measure(iter_fn, warmup=0, samples=max(2, samples // 2))
+        return BenchResult("upstream", trace_name, backend, elements, times)
+    if backend in ("jax", "jax-unit"):
         try:
             from ..backends.jax_backend import JaxReplayBackend
         except ImportError:
             return None
 
-        b = JaxReplayBackend(n_replicas=replicas, batch=batch)
+        b = JaxReplayBackend(
+            n_replicas=replicas, batch=batch,
+            layout="unit" if backend == "jax-unit" else None,
+        )
         b.prepare(trace)
         times = measure(b.replay_once, warmup=warmup, samples=samples)
         if profile_dir:
@@ -265,6 +279,18 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
         from ..engine.merge import merge_oplogs_packed
         from ..utils.digest import doc_digest_packed
 
+        # Mirror merge_packed's guards (this cell calls
+        # merge_oplogs_packed directly): packed-fill overflow corrupts
+        # content identically on every replica, so the in-region
+        # convergence assert could NOT catch it.
+        if sim.capacity >= 1 << 21:
+            raise ValueError(
+                f"merge/{config}: capacity {sim.capacity} >= 2^21 exceeds"
+                " the packed fill range"
+            )
+        # clamp epoch exactly as merge_packed does, so segments padding
+        # matches the padded log length
+        epoch = min(epoch, max(1, -(-max(len(delivered), 1) // sim.batch)))
         # Pad + upload the delivered log ONCE (the cpp baseline's
         # translation is likewise untimed); the timed region is
         # fresh-replica init + on-device sort/dedup/integrate +
@@ -283,6 +309,16 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
             segments = tuple(
                 len(l) for l in sim.agent_logs if len(l)
             ) + ((n_pad,) if n_pad else ())
+            from ..engine.merge import MAX_AGENTS
+
+            max_lamport = max(
+                (int(l.lamport.max(initial=0)) for l in sim.agent_logs),
+                default=0,
+            )
+            assert (
+                max_lamport * MAX_AGENTS + MAX_AGENTS - 1
+                < (1 << 31) - 1 - len(segments)
+            ), "lamport too large for the packed rank key"
         digest_r = jax.jit(
             jax.vmap(doc_digest_packed, in_axes=(0, 0, None))
         )
@@ -361,13 +397,23 @@ def verify_upstream(trace_name: str, backend: str, replicas: int,
         return got == want
     if backend == "python-oracle":
         return True  # the oracle is the reference point
-    if backend == "jax":
+    if backend == "py-reconcile":
+        from ..backends.reconcile import PyReconcile
+
+        doc = PyReconcile.from_str(trace.start_content)
+        for pos, d, ins in trace.iter_patches():
+            doc.replace(pos, pos + d, ins)
+        return doc.content() == want
+    if backend in ("jax", "jax-unit"):
         try:
             from ..backends.jax_backend import JaxReplayBackend
         except ImportError:
             return None
 
-        b = JaxReplayBackend(n_replicas=replicas, batch=batch)
+        b = JaxReplayBackend(
+            n_replicas=replicas, batch=batch,
+            layout="unit" if backend == "jax-unit" else None,
+        )
         b.prepare(trace)
         return b.final_content() == want
     return None

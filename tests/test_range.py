@@ -96,3 +96,60 @@ def test_range_matches_exploded_v3(svelte_trace):
     tt = tensorize(sub, batch=64)
     e_v = ReplayEngine(tt, n_replicas=1, resolver="scan", engine="v3")
     assert e_v.decode(e_v.run()) == want
+
+
+# ---- cross-patch run coalescing (RLE of the edit stream) -------------------
+
+
+def test_coalesce_patches_patterns():
+    from crdt_benches_tpu.traces.loader import TestData, TestPatch, TestTxn
+    from crdt_benches_tpu.traces.tensorize import coalesce_patches
+
+    def mk(patches):
+        return TestData(
+            start_content="", end_content="",
+            txns=[TestTxn(time="", patches=[TestPatch(*p) for p in patches])],
+        )
+
+    # typing run: consecutive inserts at advancing positions merge
+    t = mk([[0, 0, "a"], [1, 0, "b"], [2, 0, "c"]])
+    assert list(coalesce_patches(t)) == [(0, 0, "abc")]
+    # forward delete (Del key): same position
+    t = mk([[3, 1, ""], [3, 1, ""], [3, 1, ""]])
+    assert list(coalesce_patches(t)) == [(3, 3, "")]
+    # backspace run: deletes walking leftward
+    t = mk([[5, 1, ""], [4, 1, ""], [3, 1, ""]])
+    assert list(coalesce_patches(t)) == [(3, 3, "")]
+    # non-adjacent edits do NOT merge
+    t = mk([[0, 0, "a"], [5, 0, "b"]])
+    assert list(coalesce_patches(t)) == [(0, 0, "a"), (5, 0, "b")]
+    # replace patches split into delete + insert, each coalescing separately
+    t = mk([[2, 2, "xy"], [4, 0, "z"]])
+    assert list(coalesce_patches(t)) == [(2, 2, ""), (2, 0, "xyz")]
+
+
+@pytest.mark.parametrize("seed", [0, 3, 9])
+def test_coalesce_oracle_equivalence_synth(seed):
+    from crdt_benches_tpu.traces.synth import synth_trace
+    from crdt_benches_tpu.traces.tensorize import coalesce_patches
+
+    trace = synth_trace(seed=seed, n_ops=300, base="coalesce me now")
+    want = _oracle(trace)
+    doc = OracleDocument.from_str(trace.start_content)
+    n_coal = 0
+    for p, d, ins in coalesce_patches(trace):
+        doc.replace(p, p + d, ins)
+        n_coal += 1
+    assert doc.content() == want
+    assert n_coal <= sum(len(t.patches) for t in trace.txns) * 2
+
+
+def test_coalesced_range_engine_byte_identical(svelte_trace):
+    rt = tensorize_ranges(svelte_trace, batch=256, coalesce=True)
+    rt_plain = tensorize_ranges(svelte_trace, batch=256)
+    assert rt.n_ops < rt_plain.n_ops // 2  # the point: far fewer ops
+    assert rt.capacity == rt_plain.capacity  # same slot universe
+    eng = RangeReplayEngine(rt, n_replicas=2, interpret=True, chunk=8)
+    st = eng.run()
+    assert eng.decode(st, replica=0) == svelte_trace.end_content
+    assert eng.decode(st, replica=1) == svelte_trace.end_content

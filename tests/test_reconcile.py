@@ -1,0 +1,68 @@
+"""Whole-doc-reconcile backend (C6, the automerge capability shape): the
+edit position must be recoverable from a whole-document diff, per-edit,
+byte-identical to the oracle on a real trace (reference src/rope.rs:35-78)."""
+
+import numpy as np
+
+from crdt_benches_tpu.backends.base import upstream_backends
+from crdt_benches_tpu.backends.reconcile import PyReconcile
+from crdt_benches_tpu.oracle import OracleDocument
+from crdt_benches_tpu.traces.synth import synth_trace
+
+
+def test_registered_under_backend_trait():
+    assert upstream_backends()["py-reconcile"] is PyReconcile
+
+
+def test_basic_replace_shapes():
+    d = PyReconcile.from_str("hello world")
+    ids0 = d._doc_ids.copy()
+    d.replace(6, 11, "there")
+    assert d.content() == "hello there"
+    # reconcile preserved the untouched prefix's element ids
+    assert (d._doc_ids[:6] == ids0[:6]).all()
+    # and assigned fresh ids to the spliced middle
+    assert (d._doc_ids[6:] >= 11).all()
+    # byte length semantics (src/rope.rs:74-77)
+    d.replace(0, 0, "é")  # 2 UTF-8 bytes
+    assert len(d) == len("éhello there".encode())
+
+
+def test_pure_insert_and_delete():
+    d = PyReconcile.from_str("abc")
+    d.replace(1, 1, "XY")  # insert only
+    assert d.content() == "aXYbc"
+    d.replace(0, 2, "")  # delete only
+    assert d.content() == "Ybc"
+    d.replace(0, 3, "")  # delete everything
+    assert d.content() == ""
+    d.replace(0, 0, "new")
+    assert d.content() == "new"
+
+
+def test_repeated_char_ambiguity():
+    # common prefix/suffix overlap: "aaaa" -> "aaa" must not double-count
+    d = PyReconcile.from_str("aaaa")
+    d.replace(1, 2, "")
+    assert d.content() == "aaa"
+    d2 = PyReconcile.from_str("abab")
+    d2.replace(2, 2, "ab")
+    assert d2.content() == "ababab"
+
+
+def test_synth_trace_byte_identical():
+    trace = synth_trace(seed=11, n_ops=400, base="reconcile me")
+    d = PyReconcile.from_str(trace.start_content)
+    o = OracleDocument.from_str(trace.start_content)
+    for pos, dl, ins in trace.iter_patches():
+        d.replace(pos, pos + dl, ins)
+        o.replace(pos, pos + dl, ins)
+    assert d.content() == o.content()
+    assert len(d._doc_ids) == len(np.unique(d._doc_ids))
+
+
+def test_svelte_trace_byte_identical(svelte_trace):
+    d = PyReconcile.from_str(svelte_trace.start_content)
+    for pos, dl, ins in svelte_trace.iter_patches():
+        d.replace(pos, pos + dl, ins)
+    assert d.content() == svelte_trace.end_content
