@@ -13,8 +13,9 @@ stand-in since no reference numbers are published — BASELINE.md).
 Environment knobs:
   CRDT_BENCH_TRACE     trace name (default automerge-paper)
   CRDT_BENCH_REPLICAS  replica count (default auto: 256 on TPU, 8 on CPU)
-  CRDT_BENCH_SAMPLES   timed samples (default 3)
-  CRDT_BENCH_BATCH     op batch size (default 512)
+  CRDT_BENCH_SAMPLES   timed samples (default 5)
+  CRDT_BENCH_BATCH     op batch size (default 1536; the coalesced range
+                       engine peaks there on automerge-paper)
   CRDT_BENCH_PLATFORM  pin the JAX platform (e.g. "cpu"); if the accelerator
                        backend errors out, bench falls back to CPU anyway
 """
@@ -28,13 +29,13 @@ import sys
 
 from statistics import median as _median  # noqa: E402
 # Median sample time — matches the harness and recorded results (the
-# headline must not get the most favorable of 3 samples).
+# headline must not get the most favorable of the samples).
 
 
 def main() -> int:
     trace_name = os.environ.get("CRDT_BENCH_TRACE", "automerge-paper")
-    samples = int(os.environ.get("CRDT_BENCH_SAMPLES", "3"))
-    batch = int(os.environ.get("CRDT_BENCH_BATCH", "512"))
+    samples = int(os.environ.get("CRDT_BENCH_SAMPLES", "5"))
+    batch = int(os.environ.get("CRDT_BENCH_BATCH", "1536"))
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from crdt_benches_tpu.bench.harness import measure

@@ -215,6 +215,15 @@ def _merge_sim(config: str, merge_ops: int, batch: int):
     raise ValueError(f"unknown merge config {config!r}")
 
 
+def _range_merge_sim(sim, batch: int):
+    """The ONE RunMergeSimulation schedule (batch/epoch) shared by the
+    timed jax-range merge cell and its --verify check — a drift here
+    would verify a different schedule than the one benchmarked."""
+    from ..engine.merge_range import RunMergeSimulation
+
+    return RunMergeSimulation(sim, batch=min(batch, 256), epoch=8)
+
+
 def _delivered_log(sim, config: str, merge_ops: int):
     """The wire-delivered op stream for a merge cell: the plain union, or
     (adversarial) ~merge_ops shuffled ops where every unique op is
@@ -345,6 +354,35 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
             "merge", config, f"jax-{plat}{tag}", elements, times,
             replicas=replicas,
         )
+    if backend == "jax-range":
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.digest import doc_digest_packed
+
+        if config == "adversarial":
+            return None  # duplicated-delivery fault injection stays unit-op
+        rm = _range_merge_sim(sim, batch)
+        if not rm.fast_ok:
+            return None  # precondition violated -> unit merge only
+        digest_r = jax.jit(
+            jax.vmap(doc_digest_packed, in_axes=(0, 0, None))
+        )
+
+        def iter_fn():
+            st = rm.merge(n_replicas=replicas)
+            d = digest_r(st.doc, st.length, sim.chars)
+            assert bool(
+                np.asarray(jnp.all(jnp.min(d, 0) == jnp.max(d, 0)))
+            ), "replicas diverged"
+
+        times = measure(iter_fn, warmup=warmup, samples=samples)
+        plat = jax.devices()[0].platform
+        tag = f"-r{replicas}" if replicas > 1 else ""
+        return BenchResult(
+            "merge", config, f"jax-{plat}{tag}-range", elements, times,
+            replicas=replicas,
+        )
     return None
 
 
@@ -454,11 +492,13 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
 
 
 def verify_merge(config: str, merge_ops: int, batch: int,
-                 replicas: int, epoch: int = 32) -> bool | None:
-    """Byte-identity for a merge cell: the packed JAX merge's decoded
-    document must equal the independent native treap's (engine/merge.py
-    native_merge_content), at the same epoch schedule the timed cell
-    uses."""
+                 replicas: int, epoch: int = 32,
+                 engine: str = "unit") -> bool | None:
+    """Byte-identity for a merge cell: the JAX merge's decoded document
+    must equal the independent native treap's (engine/merge.py
+    native_merge_content), at the same schedule the timed cell uses.
+    ``engine``: 'unit' = packed unit-op merge; 'range' = run-granular
+    merge (engine/merge_range.py)."""
     from ..backends.native import native_available
     from ..engine.merge import native_merge_content
 
@@ -466,6 +506,14 @@ def verify_merge(config: str, merge_ops: int, batch: int,
         return None
     sim = _merge_sim(config, merge_ops, batch)
     delivered = _delivered_log(sim, config, merge_ops)
+    if engine == "range":
+        if config == "adversarial":
+            return None
+        rm = _range_merge_sim(sim, batch)
+        if not rm.fast_ok:
+            return None
+        want = native_merge_content(sim, delivered)
+        return rm.decode(rm.merge(n_replicas=replicas)) == want
     want = native_merge_content(sim, delivered)
     if config == "adversarial":
         state = sim.merge_packed(
@@ -539,16 +587,20 @@ def main(argv=None) -> int:
                         failures.append((group, trace, backend))
         if not args.filter or args.filter in "merge":
             for config in args.merge_configs.split(","):
-                ok = verify_merge(
-                    config, args.merge_ops, args.batch, args.replicas,
-                    args.epoch,
-                )
-                if ok is None:
-                    continue
-                tag = "ok" if ok else "MISMATCH"
-                print(f"verify merge/{config}/jax: {tag}", file=sys.stderr)
-                if not ok:
-                    failures.append(("merge", config, "jax"))
+                for engine in ("unit", "range"):
+                    ok = verify_merge(
+                        config, args.merge_ops, args.batch, args.replicas,
+                        args.epoch, engine=engine,
+                    )
+                    if ok is None:
+                        continue
+                    tag = "ok" if ok else "MISMATCH"
+                    print(
+                        f"verify merge/{config}/jax-{engine}: {tag}",
+                        file=sys.stderr,
+                    )
+                    if not ok:
+                        failures.append(("merge", config, f"jax-{engine}"))
         if failures:
             print(f"verify FAILED: {failures}", file=sys.stderr)
             return 1

@@ -1,0 +1,527 @@
+"""Run-granular concurrent merge: integrate whole insert RUNS per step.
+
+The unit-op merge (engine/merge.py) integrates the delivered union one
+element at a time — 1.35M sequential unit ops for the rustcode+seph-blog1
+concurrent-agents workload (~178k patches), which left that cell slower
+than one CPU core (round-2 verdict).  diamond-types' own wire encoding is
+run-length encoded (reference src/rope.rs:214 encodes positional runs);
+this module brings the same granularity to the merge path: one wire op per
+contiguous insert run / delete interval, so the sequential batch count
+scales with RUNS (~33k for the traces config) instead of characters.
+
+Correctness design
+------------------
+Element ids are (lamport, agent) with lamport-consecutive runs: a run's
+j-th element has key ``head_key + j*MAX_AGENTS``.  Like the unit path, the
+union is integrated in ASCENDING HEAD-KEY order, so at integration time
+every previously-placed sibling (run head under the same origin element)
+has a smaller head key — RGA's newest-first sibling rule then places each
+new run DIRECTLY after its anchor element, no sibling skipping (the same
+classical fact engine/merge.py relies on, lifted from elements to runs).
+
+Runs are atomic per batch, which is only sound when a run head anchoring
+at element ``o`` either finds no chain-child of ``o`` (o is its run's last
+element) or outranks that chain-child's key.  The one violating pattern is
+an exact lamport tie with a smaller agent id; :func:`check_no_skip`
+verifies the precondition host-side at wire-translation time and callers
+fall back to the unit merge when it fails (it cannot occur for agents
+diverging from a shared base — they only anchor on base or own elements).
+
+Within a batch the run forest (same-batch anchor containment) is resolved
+in parallel with the W x W boolean-matmul closure of engine/merge.py
+``_chain_structure``, extended to runs: a child run anchored mid-parent
+SPLITS the parent into pieces, so the batch emits up to 2W FRAGMENTS,
+each with (external anchor, char-offset rank, slot0, len) — exactly the
+wire form of the range downstream apply
+(engine/downstream_range.py ``_apply_range_update_batch5``), which this
+module reuses verbatim for the position-resolved integration.
+
+Deletes commute and positions are PHYSICAL (tombstones never move,
+ops/idpos.py), so delete intervals are folded ONCE after all inserts:
+paint a killed-slot indicator from the id intervals, then one
+capacity-sized scatter through the final slot->position snapshot clears
+visibility — the same cost class as a single epoch snapshot rebuild.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..traces.tensorize import DELETE, INSERT
+from .downstream import DownPacked, down_packed_init
+from .merge import MAX_AGENTS, MergeSimulation, OpLog
+
+
+@dataclass
+class RunLog:
+    """One agent's op log as runs (the RLE wire form).
+
+    Insert runs: ``rlen`` lamport/slot-consecutive elements; ``origin`` is
+    the HEAD's origin slot (-1 = document head) and element j chains on
+    element j-1.  Delete intervals: inclusive slot ranges [dlo, dhi]."""
+
+    lamport: np.ndarray  # int32[Nr] head lamport
+    agent: np.ndarray  # int32[Nr]
+    slot0: np.ndarray  # int32[Nr]
+    rlen: np.ndarray  # int32[Nr]
+    origin: np.ndarray  # int32[Nr] head origin slot (-1 = doc head)
+    dlo: np.ndarray  # int32[Nd] delete interval first slot
+    dhi: np.ndarray  # int32[Nd] delete interval last slot
+    n_unit_ops: int  # unit ops this log RLE-compresses (element count)
+
+
+def runs_from_oplog(log: OpLog) -> RunLog:
+    """RLE a lamport-ascending unit-op log into insert runs + delete
+    intervals (host, untimed — wire translation, the analog of the cpp
+    baseline's untimed ``to_native_ops``)."""
+    lam, ag, kind = log.lamport, log.agent, log.kind
+    elem, orig = log.elem, log.origin
+    is_ins = kind == INSERT
+    prev_elem = np.roll(elem, 1)
+    prev_lam = np.roll(lam, 1)
+    cont = (
+        is_ins
+        & np.roll(is_ins, 1)
+        & (orig == prev_elem)
+        & (lam == prev_lam + 1)
+        & (elem == prev_elem + 1)
+    )
+    if len(cont):
+        cont[0] = False
+    head = is_ins & ~cont
+    hidx = np.nonzero(head)[0]
+    # run lengths: distance to the next head within the insert stream
+    run_id = np.cumsum(head) - 1
+    rlen = np.bincount(
+        run_id[is_ins], minlength=len(hidx)
+    ).astype(np.int32)
+
+    # delete intervals: ascending-contiguous target slots coalesce; any
+    # other step starts a new interval (deletes commute — interval
+    # structure is just wire compactness)
+    is_del = kind == DELETE
+    didx = np.nonzero(is_del)[0]
+    dtgt = elem[didx]
+    if len(dtgt):
+        brk = np.concatenate([[True], np.diff(dtgt) != 1])
+        d0 = np.nonzero(brk)[0]
+        d1 = np.concatenate([d0[1:], [len(dtgt)]])
+        dlo = dtgt[d0].astype(np.int32)
+        dhi = dtgt[d1 - 1].astype(np.int32)
+    else:
+        dlo = dhi = np.zeros(0, np.int32)
+
+    return RunLog(
+        lamport=lam[hidx].astype(np.int32),
+        agent=ag[hidx].astype(np.int32),
+        slot0=elem[hidx].astype(np.int32),
+        rlen=rlen,
+        origin=orig[hidx].astype(np.int32),
+        dlo=dlo,
+        dhi=dhi,
+        n_unit_ops=int(is_ins.sum() + is_del.sum()),
+    )
+
+
+def check_no_skip(runlogs: list[RunLog]) -> bool:
+    """Host precondition for run-atomic integration (module docstring):
+    every run head anchoring at a non-last element ``o`` of some run must
+    outrank o's chain child, i.e. NOT (head.lamport == o.lamport + 1 AND
+    head.agent < o.agent).  True = the fast path is exact."""
+    slot0 = np.concatenate([r.slot0 for r in runlogs])
+    rlen = np.concatenate([r.rlen for r in runlogs])
+    lam0 = np.concatenate([r.lamport for r in runlogs])
+    ag = np.concatenate([r.agent for r in runlogs])
+    if not len(slot0):
+        return True
+    order = np.argsort(slot0)
+    s0, rl, l0, a0 = slot0[order], rlen[order], lam0[order], ag[order]
+    for r in runlogs:
+        o = r.origin
+        m = o >= 0
+        if not m.any():
+            continue
+        j = np.searchsorted(s0, o[m], side="right") - 1
+        j = np.clip(j, 0, len(s0) - 1)
+        off = o[m] - s0[j]
+        inside = (off >= 0) & (off < rl[j])
+        has_child = inside & (off < rl[j] - 1)
+        o_lam = l0[j] + off
+        bad = has_child & (r.lamport[m] == o_lam + 1) & (
+            r.agent[m] < a0[j]
+        )
+        if bad.any():
+            return False
+    return True
+
+
+# ---- device integration -----------------------------------------------------
+
+BIGKEY = jnp.int32(2**31 - 1)
+
+
+def _run_batch_fragments(key, slot0, rlen, origin):
+    """In-batch run forest -> integration fragments, all parallel W x W
+    work shared across replicas (the run-granular ``_chain_structure``).
+
+    Inputs are one batch's runs sorted ascending by ``key`` (head key;
+    BIGKEY rows = padding).  Returns fragment arrays of width 2W:
+    (anchor slot, char-offset rank within the anchor's gap group, slot0,
+    rlen); invalid fragments have slot0 == -1, rlen == 0.
+    """
+    W = key.shape[0]
+    j = jnp.arange(W, dtype=jnp.int32)
+    live = (key < BIGKEY) & (rlen > 0)
+
+    # parent: the same-batch run containing my head's origin element.
+    inside = (
+        (origin[:, None] >= slot0[None, :])
+        & (origin[:, None] < (slot0 + rlen)[None, :])
+        & live[None, :]
+        & live[:, None]
+    )
+    parent = jnp.sum(jnp.where(inside, j[None, :] + 1, 0), axis=1) - 1
+    internal = parent >= 0
+    # chars of the parent before my splice point (cut after this many)
+    off = jnp.where(
+        internal,
+        origin - jnp.sum(jnp.where(inside, slot0[None, :], 0), axis=1) + 1,
+        0,
+    )
+
+    # ancestor closure (proper ancestors), log W boolean squarings.
+    A = (parent[:, None] == j[None, :]) & internal[:, None]
+    for _ in range(max(1, (W - 1).bit_length())):
+        prod = (
+            jnp.einsum(
+                "xm,ma->xa",
+                A.astype(jnp.bfloat16),
+                A.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+        A = A | prod
+    AoS = A | ((j[:, None] == j[None, :]) & live[:, None])
+    # subtree char sizes
+    size = rlen + jnp.sum(
+        jnp.where(A, rlen[:, None], 0), axis=0
+    )
+
+    # frame precedence M[a, b]: a's subtree entirely before b's at a
+    # shared frame — same internal parent, or roots sharing an external
+    # anchor (off == 0 there).  Newest-first: same offset -> larger op
+    # index (= larger key) first.
+    both = live[:, None] & live[None, :]
+    same_int = (
+        internal[:, None]
+        & internal[None, :]
+        & (parent[:, None] == parent[None, :])
+    )
+    root_pair = (
+        ~internal[:, None]
+        & ~internal[None, :]
+        & (origin[:, None] == origin[None, :])
+    )
+    framed = both & (same_int | root_pair) & (j[:, None] != j[None, :])
+    less = (off[:, None] < off[None, :]) | (
+        (off[:, None] == off[None, :]) & (j[:, None] > j[None, :])
+    )
+    M = framed & less
+
+    # whole-subtree precedence of g before r: g directly frame-precedes
+    # some ancestor-or-self of r (maximal preceding subtree roots only —
+    # no double counting).
+    topb = (
+        jnp.einsum(
+            "gs,rs->gr",
+            M.astype(jnp.bfloat16),
+            AoS.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        > 0
+    )
+    # char rank of each run's first char within its gap group
+    rank_chars = jnp.sum(
+        jnp.where(topb, size[:, None], 0), axis=0
+    ) + jnp.sum(jnp.where(AoS, jnp.where(internal, off, 0)[None, :], 0),
+                axis=1)
+
+    # external anchor: my root's origin.
+    is_root = live & ~internal
+    root = (
+        jnp.sum(jnp.where(AoS & is_root[None, :], j[None, :] + 1, 0), axis=1)
+        - 1
+    )
+    anchor = jnp.where(
+        live, origin[jnp.clip(root, 0, W - 1)], -2
+    )
+    anchor = jnp.where(live & ~internal, origin, anchor)
+
+    # ---- fragments ----
+    # head piece of run w: chars [0, first cut); parent piece after w's
+    # cut: chars [off_w, next cut), owned by the OLDEST (min op index)
+    # child at (parent, off).
+    child_of = (parent[None, :] == j[:, None]) & internal[None, :]  # [p, c]
+    first_cut = jnp.min(
+        jnp.where(child_of, off[None, :], jnp.int32(1 << 30)), axis=1
+    )
+    head_len = jnp.minimum(rlen, first_cut)
+
+    next_cut = jnp.min(
+        jnp.where(
+            child_of[parent] & (off[None, :] > off[:, None]),
+            off[None, :],
+            jnp.int32(1 << 30),
+        ),
+        axis=1,
+    )
+    p_rlen = rlen[jnp.clip(parent, 0, W - 1)]
+    piece_len = jnp.minimum(p_rlen, next_cut) - off
+    owner = internal & (
+        jnp.sum(
+            jnp.where(
+                (parent[None, :] == parent[:, None])
+                & internal[None, :]
+                & (off[None, :] == off[:, None])
+                & (j[None, :] < j[:, None]),
+                1,
+                0,
+            ),
+            axis=1,
+        )
+        == 0
+    )
+    # chars of sibling subtrees cut at or before my offset
+    sib_before = jnp.sum(
+        jnp.where(
+            (parent[None, :] == parent[:, None])
+            & internal[None, :]
+            & (off[None, :] <= off[:, None]),
+            size[None, :],
+            0,
+        ),
+        axis=1,
+    )
+    p_idx = jnp.clip(parent, 0, W - 1)
+    piece_rank = rank_chars[p_idx] + off + sib_before
+    piece_slot0 = slot0[p_idx] + off
+    piece_anchor = anchor[p_idx]
+
+    f_anchor = jnp.concatenate(
+        [jnp.where(live, anchor, -2), jnp.where(owner, piece_anchor, -2)]
+    )
+    f_rank = jnp.concatenate(
+        [jnp.where(live, rank_chars, 0), jnp.where(owner, piece_rank, 0)]
+    )
+    f_slot0 = jnp.concatenate(
+        [
+            jnp.where(live & (head_len > 0), slot0, -1),
+            jnp.where(owner & (piece_len > 0), piece_slot0, -1),
+        ]
+    )
+    f_rlen = jnp.concatenate(
+        [
+            jnp.where(live, head_len, 0),
+            jnp.where(owner, jnp.maximum(piece_len, 0), 0),
+        ]
+    )
+    f_rlen = jnp.where(f_slot0 >= 0, f_rlen, 0)
+    return f_anchor, f_rank, f_slot0, f_rlen
+
+
+@partial(
+    jax.jit,
+    static_argnames=("batch", "epoch", "nbits"),
+    donate_argnums=(0,),
+)
+def merge_runlogs(
+    state: DownPacked,
+    lamport, agent, slot0, rlen, origin,
+    *,
+    batch: int = 256,
+    epoch: int = 8,
+    nbits: int = 18,
+) -> DownPacked:
+    """Integrate a union of insert-run logs (delete intervals fold
+    separately, :func:`delete_fold`).  The causal-order sort of run heads,
+    the per-batch forest resolution, the id->position queries and the
+    fused expansion all run on device inside this call — N runs must be a
+    multiple of ``batch * epoch`` (pad with rlen == 0 rows).
+    """
+    from ..ops.idpos import snap_rebuild
+    from .downstream_range import _apply_range_update_batch5
+
+    key = jnp.where(rlen > 0, lamport * MAX_AGENTS + agent, BIGKEY)
+    perm = jnp.argsort(key)
+    key, slot0, rlen, origin = (
+        key[perm], slot0[perm], rlen[perm], origin[perm]
+    )
+
+    NB = key.shape[0] // batch
+    K = min(epoch, NB)
+    rs = lambda x: x.reshape(NB // K, K, batch)
+    neg1 = jnp.full((batch,), -1, jnp.int32)
+
+    def step(st, upd):
+        k_e, s0_e, rl_e, or_e = upd
+        doc, snap, length, nvis = st
+        levels: list = []
+        for k in range(K):
+            fa, fr, fs, fl = _run_batch_fragments(
+                k_e[k], s0_e[k], rl_e[k], or_e[k]
+            )
+            doc, length, nvis, lv = _apply_range_update_batch5(
+                doc, length, nvis, snap, levels,
+                fa, fr, fs, fl,
+                jnp.ones_like(fa),  # alive: deletes fold later
+                jnp.concatenate([neg1, neg1]),  # no dfirst
+                jnp.concatenate([neg1, neg1]),  # no dlast
+                nbits=nbits,
+            )
+            levels.append(lv)
+        return DownPacked(doc, snap_rebuild(doc), length, nvis), None
+
+    state, _ = jax.lax.scan(
+        step, state, (rs(key), rs(slot0), rs(rlen), rs(origin))
+    )
+    return state
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def delete_fold(state: DownPacked, dlo, dhi) -> DownPacked:
+    """Fold all delete intervals in one pass: paint a killed-slot
+    indicator from the id intervals (deletes commute; a complete causal
+    log lets every tombstone land after integration), scatter it through
+    the final slot->position snapshot, clear visibility."""
+    R, C = state.doc.shape
+    starts = (
+        jnp.zeros(C + 1, jnp.int32)
+        .at[jnp.clip(dlo, 0, C)]
+        .add(jnp.where(dlo >= 0, 1, 0), mode="drop")
+    )
+    stops = (
+        jnp.zeros(C + 1, jnp.int32)
+        .at[jnp.clip(dhi + 1, 0, C)]
+        .add(jnp.where(dlo >= 0, 1, 0), mode="drop")
+    )
+    killed = (jnp.cumsum(starts - stops)[:C] > 0).astype(jnp.int32)
+
+    # state.snap is exact here: merge_runlogs ends every scan step with
+    # snap_rebuild(doc), so no extra rebuild is needed.
+    kill_doc = jax.vmap(
+        lambda s: jnp.zeros(C, jnp.int32).at[s].add(killed, mode="drop")
+    )(state.snap)
+    vis = jnp.bitwise_and(state.doc, 1)
+    newvis = vis * (kill_doc == 0).astype(jnp.int32)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    in_doc = col < state.length[:, None]
+    return DownPacked(
+        doc=state.doc - (vis - newvis),
+        snap=state.snap,
+        length=state.length,
+        nvis=jnp.sum(newvis * in_doc.astype(jnp.int32), axis=1),
+    )
+
+
+# ---- host-side driver -------------------------------------------------------
+
+
+class RunMergeSimulation:
+    """Run-granular view over a :class:`MergeSimulation`: RLE wire
+    translation (untimed), precondition check, device merge + delete fold.
+    """
+
+    def __init__(self, sim: MergeSimulation, batch: int = 256,
+                 epoch: int = 8):
+        self.sim = sim
+        self.batch = batch
+        self.epoch = epoch
+        self.runlogs = [runs_from_oplog(l) for l in sim.agent_logs]
+        self.fast_ok = check_no_skip(self.runlogs)
+        self.n_runs = int(sum(len(r.slot0) for r in self.runlogs))
+        self.n_unit_ops = int(sum(r.n_unit_ops for r in self.runlogs))
+        cat = lambda f: np.concatenate([getattr(r, f) for r in self.runlogs])
+        n = self.n_runs
+        m = batch * min(epoch, max(1, -(-n // batch)))
+        pad = (-n) % m
+        z = lambda fill: np.full(pad, fill, np.int32)
+        # Pre-sort by head key HOST-side so per-batch sizing (nbits) is
+        # computed on the same batches the device forms: merge_runlogs
+        # re-sorts on device (the causal-order arrangement is timed work),
+        # which is then an identical permutation.
+        lamport = np.concatenate([cat("lamport"), z(0)])
+        agent = np.concatenate([cat("agent"), z(0)])
+        slot0 = np.concatenate([cat("slot0"), z(-1)])
+        rlen = np.concatenate([cat("rlen"), z(0)])
+        origin = np.concatenate([cat("origin"), z(-2)])
+        assert int(lamport.max(initial=0)) * MAX_AGENTS + MAX_AGENTS \
+            < 2**31 - 1, "lamport too large for the packed run key"
+        key = np.where(
+            rlen > 0, lamport * MAX_AGENTS + agent, np.int32(2**31 - 1)
+        )
+        perm = np.argsort(key, kind="stable")
+        self.lamport = lamport[perm]
+        self.agent = agent[perm]
+        self.slot0 = slot0[perm]
+        self.rlen = rlen[perm]
+        self.origin = origin[perm]
+        self.dlo = cat("dlo")
+        self.dhi = cat("dhi")
+        nb = len(self.lamport) // batch
+        per_batch_chars = (
+            np.where(self.rlen > 0, self.rlen, 0)
+            .reshape(nb, batch)
+            .sum(axis=1)
+        )
+        self.nbits = max(1, int(per_batch_chars.max(initial=1)).bit_length())
+        self.epoch_eff = min(epoch, nb)
+        # device upload ONCE (untimed, matching the unit merge cell's
+        # hoisted upload) — merge() only dispatches
+        self._dev = tuple(
+            jnp.asarray(a)
+            for a in (self.lamport, self.agent, self.slot0, self.rlen,
+                      self.origin)
+        )
+        self._dev_del = (
+            (jnp.asarray(self.dlo), jnp.asarray(self.dhi))
+            if len(self.dlo)
+            else None
+        )
+
+    def merge(self, n_replicas: int = 1) -> DownPacked:
+        """Timed region: fresh replicas + full run integration + delete
+        fold (callers add digest/convergence checks)."""
+        if not self.fast_ok:
+            raise ValueError(
+                "run-atomic precondition violated; use the unit merge"
+            )
+        st = down_packed_init(
+            n_replicas, self.sim.capacity, self.sim.n_base
+        )
+        st = merge_runlogs(
+            st, *self._dev,
+            batch=self.batch, epoch=self.epoch_eff, nbits=self.nbits,
+        )
+        if self._dev_del is not None:
+            st = delete_fold(st, *self._dev_del)
+        return st
+
+    def decode(self, state: DownPacked, replica: int = 0) -> str:
+        from ..ops.apply2 import PackedState, decode_state3
+
+        codes, nvis = jax.jit(
+            decode_state3, static_argnames=("replica",)
+        )(
+            PackedState(
+                doc=state.doc, length=state.length, nvis=state.nvis
+            ),
+            self.sim.chars,
+            replica=replica,
+        )
+        return "".join(map(chr, np.asarray(codes)[: int(nvis)].tolist()))

@@ -1,0 +1,108 @@
+"""Run-granular merge (engine/merge_range.py): RLE wire translation,
+run-atomicity precondition, and byte-identical convergence against the
+unit-op merge on multi-agent divergent edits."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.merge import MergeSimulation
+from crdt_benches_tpu.engine.merge_range import (
+    RunMergeSimulation,
+    check_no_skip,
+    runs_from_oplog,
+)
+from crdt_benches_tpu.traces.synth import synth_trace
+from crdt_benches_tpu.traces.tensorize import tensorize
+
+
+def _sim(seeds, base, batch=16, n_ops=60):
+    streams = [
+        tensorize(synth_trace(seed=s, n_ops=n_ops, base=base), batch=batch)
+        for s in seeds
+    ]
+    return MergeSimulation(streams, base=base, batch=batch)
+
+
+def test_runs_roundtrip_counts():
+    sim = _sim([0, 1], base="shared base text here")
+    for log in sim.agent_logs:
+        rl = runs_from_oplog(log)
+        # every unit op is covered exactly once
+        n_ins = int(rl.rlen.sum())
+        n_del = int((rl.dhi - rl.dlo + 1).sum()) if len(rl.dlo) else 0
+        assert n_ins + n_del == rl.n_unit_ops == len(log)
+        # far fewer runs than unit ops on synth streams with runs
+        assert len(rl.slot0) + len(rl.dlo) <= len(log)
+        # runs are slot- and lamport-contiguous by construction
+        assert (rl.rlen >= 1).all()
+
+
+def test_no_skip_holds_for_diverged_agents():
+    sim = _sim([2, 3, 4], base="the shared base document ")
+    assert check_no_skip(
+        [runs_from_oplog(l) for l in sim.agent_logs]
+    )
+
+
+@pytest.mark.parametrize("seeds", [[0, 1], [2, 3, 4], [5, 6, 7, 8]])
+def test_run_merge_matches_unit_merge(seeds):
+    base = "concurrent editing from a shared base "
+    sim = _sim(seeds, base=base, n_ops=50)
+    want = sim.decode(sim.merge())  # unit-op v1 merge (ground truth)
+    rm = RunMergeSimulation(sim, batch=8, epoch=2)
+    assert rm.fast_ok
+    st = rm.merge(n_replicas=2)
+    assert rm.decode(st, replica=0) == want
+    assert rm.decode(st, replica=1) == want
+    assert (np.asarray(st.nvis) == len(want)).all()
+
+
+def test_run_merge_empty_base():
+    sim = _sim([9, 10], base="", n_ops=40)
+    want = sim.decode(sim.merge())
+    rm = RunMergeSimulation(sim, batch=8, epoch=2)
+    st = rm.merge()
+    assert rm.decode(st) == want
+
+
+def test_run_merge_batch_epoch_independence():
+    sim = _sim([11, 12], base="invariance base ", n_ops=45)
+    want = sim.decode(sim.merge())
+    for batch, epoch in [(4, 1), (8, 4), (32, 2)]:
+        rm = RunMergeSimulation(sim, batch=batch, epoch=epoch)
+        assert rm.decode(rm.merge()) == want, (batch, epoch)
+
+
+def test_run_merge_traces_prefix(rustcode_trace, seph_trace):
+    import dataclasses
+
+    a = dataclasses.replace(rustcode_trace, txns=rustcode_trace.txns[:120])
+    b = dataclasses.replace(seph_trace, txns=seph_trace.txns[:120])
+    streams = [tensorize(a, batch=64), tensorize(b, batch=64)]
+    sim = MergeSimulation(streams, base="", batch=64)
+    want = sim.decode(sim.merge())
+    rm = RunMergeSimulation(sim, batch=16, epoch=2)
+    assert rm.fast_ok
+    assert rm.n_runs < rm.n_unit_ops // 3  # the point: fewer sequential steps
+    st = rm.merge(n_replicas=1)
+    assert rm.decode(st) == want
+
+
+def test_nbits_sized_on_sorted_batches():
+    # Interleaved key ranges with uneven run lengths: per-batch char sums
+    # must be computed on the SORTED batch layout the device integrates
+    # (host-order sizing undercounted and corrupted the expansion).
+    base = "x" * 8
+    streams = [
+        tensorize(synth_trace(seed=s, n_ops=70, base=base), batch=8)
+        for s in (21, 22)
+    ]
+    sim = MergeSimulation(streams, base=base, batch=8)
+    want = sim.decode(sim.merge())
+    rm = RunMergeSimulation(sim, batch=4, epoch=2)
+    nb = len(rm.lamport) // 4
+    sorted_sums = (
+        np.where(rm.rlen > 0, rm.rlen, 0).reshape(nb, 4).sum(axis=1)
+    )
+    assert 2 ** rm.nbits > int(sorted_sums.max())
+    assert rm.decode(rm.merge()) == want
