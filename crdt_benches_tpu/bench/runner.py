@@ -292,10 +292,10 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
         # merge_oplogs_packed directly): packed-fill overflow corrupts
         # content identically on every replica, so the in-region
         # convergence assert could NOT catch it.
-        if sim.capacity >= 1 << 21:
+        if sim.capacity >= 1 << 28:
             raise ValueError(
-                f"merge/{config}: capacity {sim.capacity} >= 2^21 exceeds"
-                " the packed fill range"
+                f"merge/{config}: capacity {sim.capacity} >= 2^28 exceeds"
+                " the packed fill range (int32 combo)"
             )
         # clamp epoch exactly as merge_packed does, so segments padding
         # matches the padded log length

@@ -213,7 +213,8 @@ def _rank_sorted_segments(
     # TPU lowers every value scatter through a sort (~0.5s at 1.35M) and
     # large arbitrary-index gathers are slower still, so materialize the
     # arrangement with exactly TWO scatters by packing the only fields
-    # integration consumes: A = (elem+2)*4 + kind (elem < 2^21, kind < 4),
+    # integration consumes: A = (elem+2)*4 + kind (elem < 2^28 per the
+    # capacity guard; (2^28+2)*4 still fits int32, 2^29 would not),
     # B = origin + 2.  lamport/agent/ch are fully consumed by the ranking
     # itself (ch travels via the slot->char table).
     a = (elem + 2) * 4 + kind
@@ -696,14 +697,15 @@ class MergeSimulation:
         sorted-segments rank path replaces the device sort."""
         from .downstream import down_packed_init
 
-        # spread_fill_combo's three 8-bit chunks carry fill < 2^23, i.e.
-        # capacity < 2^21 (fail loudly — high slot bits would silently
+        # spread_fill_combo grows a fourth fill chunk beyond 2^21 slots
+        # and caps out where combo = (fill << 1) | ind leaves int32 —
+        # capacity < 2^28 (fail loudly — high slot bits would silently
         # drop, identically on every replica, so even the convergence
         # check would pass on corrupt content).
-        if self.capacity >= 1 << 21:
+        if self.capacity >= 1 << 28:
             raise ValueError(
-                f"capacity {self.capacity} >= 2^21 exceeds the packed fill"
-                " range"
+                f"capacity {self.capacity} >= 2^28 exceeds the packed fill"
+                " range (int32 combo)"
             )
         src = log if log is not None else self.log
         # never pad beyond the real batch count (a 32-wide unrolled scan

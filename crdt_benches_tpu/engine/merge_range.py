@@ -439,6 +439,16 @@ class RunMergeSimulation:
 
     def __init__(self, sim: MergeSimulation, batch: int = 256,
                  epoch: int = 8):
+        # _apply_range_update_batch5 paints per-run slot deltas in 3x7-bit
+        # chunks (|ddelta| <= 2*capacity < 2^21), the same bound the range
+        # downstream engine guards (engine/downstream_range.py) — without
+        # this check a wrapped delta would corrupt content identically on
+        # every replica, invisible to the convergence digest.
+        if sim.capacity >= 1 << 20:
+            raise ValueError(
+                f"capacity {sim.capacity} >= 2^20 exceeds the run-delta"
+                " chunked-arithmetic range; use the unit merge"
+            )
         self.sim = sim
         self.batch = batch
         self.epoch = epoch
@@ -504,10 +514,11 @@ class RunMergeSimulation:
         st = down_packed_init(
             n_replicas, self.sim.capacity, self.sim.n_base
         )
-        st = merge_runlogs(
-            st, *self._dev,
-            batch=self.batch, epoch=self.epoch_eff, nbits=self.nbits,
-        )
+        if self.n_runs:
+            st = merge_runlogs(
+                st, *self._dev,
+                batch=self.batch, epoch=self.epoch_eff, nbits=self.nbits,
+            )
         if self._dev_del is not None:
             st = delete_fold(st, *self._dev_del)
         return st

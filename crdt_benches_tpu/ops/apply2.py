@@ -526,18 +526,30 @@ def spread_fill_combo(dest, fill, C: int):
 
     Three 8-bit chunks (one-hot spreads deliver exactly one contribution
     per cell, and integers <= 255 are exact in bf16) cover combo bits
-    0..23, i.e. fill < 2**23 — exactly the bound the capacity < 2**21
-    assertion at engine construction guarantees
-    (fill = ((slot + 2) << 1) | vis < 4 * capacity).  ``fill`` must be 0
-    where ``dest`` is out of range.
+    0..23, i.e. fill < 2**23 (capacity < 2**21, since
+    fill = ((slot + 2) << 1) | vis < 4 * capacity).  Capacities beyond
+    that gain a FOURTH chunk for combo bits 24..30 (fill < 2**30, i.e.
+    capacity < 2**28 — the int32 combo ceiling); the chunk count is
+    static per compiled shape, so small documents never pay for it.
+    ``fill`` must be 0 where ``dest`` is out of range.
     """
+    if C >= 1 << 28:
+        raise ValueError(
+            f"capacity {C} >= 2^28: combo = (fill << 1) | ind no longer"
+            " fits int32"
+        )
     chunks = [
         jnp.bitwise_and(fill, 127) * 2 + 1,
         jnp.bitwise_and(jnp.right_shift(fill, 7), 255),
         jnp.bitwise_and(jnp.right_shift(fill, 15), 255),
     ]
-    (c0, c1, c2), ind_tcount = _mxu_spread_tc(dest, chunks, C)
-    combo = c0 + jnp.left_shift(c1, 8) + jnp.left_shift(c2, 16)
+    wide = 4 * C > 1 << 23  # fill can exceed the 3-chunk range
+    if wide:
+        chunks.append(jnp.bitwise_and(jnp.right_shift(fill, 23), 127))
+    outs, ind_tcount = _mxu_spread_tc(dest, chunks, C)
+    combo = outs[0] + jnp.left_shift(outs[1], 8) + jnp.left_shift(outs[2], 16)
+    if wide:
+        combo = combo + jnp.left_shift(outs[3], 24)
     return combo, _excl_cumsum_small(ind_tcount)
 
 

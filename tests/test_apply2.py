@@ -141,3 +141,27 @@ def test_v3_large_batch_sort_rank_path(batch):
     tt = tensorize(trace, batch=batch)
     eng = ReplayEngine(tt, n_replicas=1, resolver="scan", engine="v3", pack=1)
     assert eng.decode(eng.run()) == _oracle_replay(trace)
+
+
+def test_spread_fill_combo_wide_capacity():
+    # Capacities beyond 2^21 engage the fourth fill chunk: combo must be
+    # exactly (fill << 1) | 1 at each destination, 0 elsewhere, including
+    # fills whose high bits live in chunk 3 (slots near the top).
+    import jax.numpy as jnp
+
+    from crdt_benches_tpu.ops.apply2 import pack_doc, spread_fill_combo
+
+    C = (1 << 21) + 1024  # wide but small enough for a CPU test
+    slots = jnp.asarray([0, 5, (1 << 21) - 3, (1 << 21) + 500], jnp.int32)
+    vis = jnp.asarray([1, 0, 1, 1], jnp.int32)
+    fill = pack_doc(slots, vis)[None, :]
+    dest = jnp.asarray([[7, 129, 4096, C - 1]], jnp.int32)
+    combo, cnt_base = spread_fill_combo(dest, fill, C)
+    combo = np.asarray(combo)[0]
+    want = np.zeros(C, np.int64)
+    for d, f in zip(np.asarray(dest)[0], np.asarray(fill)[0]):
+        want[d] = (int(f) << 1) | 1
+    assert (combo == want).all()
+    # count base: one destination in tile 0, one in tile 1, one in tile 32
+    cb = np.asarray(cnt_base)[0]
+    assert cb[0] == 0 and cb[1] == 1 and cb[2] == 2 and cb[33] == 3

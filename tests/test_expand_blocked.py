@@ -84,3 +84,71 @@ def test_blocked_dense_shifts_at_boundaries():
         )
     )
     np.testing.assert_array_equal(got, want)
+
+
+# ---- real-TPU (non-interpret) coverage --------------------------------------
+
+import os
+import jax
+
+_on_tpu = (
+    os.environ.get("CRDT_TPU_TESTS") == "1"
+    and jax.default_backend() == "tpu"
+)
+
+
+@pytest.mark.skipif(not _on_tpu, reason="set CRDT_TPU_TESTS=1 on a TPU")
+def test_blocked_on_silicon_above_vmem_gate():
+    """Compile + run the blocked kernel NON-interpreted on the real chip
+    at a capacity ABOVE the ~1.09M-position monolithic-VMEM gate (the
+    round-2 verdict gap: the kernel had only ever run in interpret mode
+    at C=4096)."""
+    from crdt_benches_tpu.ops.expand_pallas import (
+        FUSED_STACK_BYTES_PER_POS,
+    )
+
+    rng = np.random.default_rng(11)
+    C = 1536 * 1024  # 1.57M positions > the 96MB VMEM gate
+    assert FUSED_STACK_BYTES_PER_POS * C > 96 * 2**20
+    R, n_ins, nbits = 4, 500, 9
+    doc, combo, cb, ln = _mk(rng, R, C, n_ins, nbits)
+    want = np.asarray(
+        apply_fused_nocv_xla(doc, combo, cb, ln, nbits=nbits)
+    )
+    got = np.asarray(
+        apply_fused_blocked(doc, combo, cb, ln, nbits=nbits)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.skipif(not _on_tpu, reason="set CRDT_TPU_TESTS=1 on a TPU")
+def test_blocked_on_silicon_boundary_shifts():
+    """Non-interpret boundary-cluster case: inserts packed right at a
+    block edge so the halo path runs on silicon."""
+    rng = np.random.default_rng(13)
+    R, C = 2, 256 * 1024
+    nt = C // LANE
+    nbits = 10
+    doc = jnp.asarray(rng.integers(2, 999, (R, C)).astype(np.int32))
+    combo = np.zeros((R, C), np.int32)
+    bt = 64  # force several blocks
+    d0 = bt * LANE - 400
+    combo[:, d0 : d0 + 800] = (
+        rng.integers(1, 1 << 20, (R, 800)).astype(np.int32) << 1
+    ) | 1
+    ind = (combo & 1).reshape(R, nt, LANE).sum(axis=2)
+    cb = np.zeros((R, nt), np.int32)
+    cb[:, 1:] = np.cumsum(ind, axis=1)[:, :-1]
+    ln = jnp.asarray(np.full(R, C, np.int32))
+    want = np.asarray(
+        apply_fused_nocv_xla(
+            doc, jnp.asarray(combo), jnp.asarray(cb), ln, nbits=nbits
+        )
+    )
+    got = np.asarray(
+        apply_fused_blocked(
+            doc, jnp.asarray(combo), jnp.asarray(cb), ln, nbits=nbits,
+            block_tiles=bt,
+        )
+    )
+    np.testing.assert_array_equal(got, want)

@@ -106,3 +106,29 @@ def test_nbits_sized_on_sorted_batches():
     )
     assert 2 ** rm.nbits > int(sorted_sums.max())
     assert rm.decode(rm.merge()) == want
+
+
+def test_delete_only_union():
+    # A union with zero insert runs must not divide by zero: the base
+    # document with deletes folded is the converged result.
+    from crdt_benches_tpu.traces.loader import TestData, TestTxn
+
+    base = "abcdefghij"
+    streams = [
+        tensorize(TestData(base, "", [TestTxn("", [[2, 3, ""]])]), batch=4),
+        tensorize(TestData(base, "", [TestTxn("", [[7, 1, ""]])]), batch=4),
+    ]
+    sim = MergeSimulation(streams, base=base, batch=4)
+    want = sim.decode(sim.merge())
+    rm = RunMergeSimulation(sim, batch=4, epoch=2)
+    assert rm.n_runs == 0
+    st = rm.merge(n_replicas=2)
+    assert rm.decode(st, replica=0) == want == "abfgij"
+
+
+def test_capacity_guard():
+    sim = _sim([0, 1], base="guard")
+    RunMergeSimulation(sim, batch=4)  # small capacity passes
+    sim.capacity = 1 << 20  # fresh sim per _sim call; safe to mutate
+    with pytest.raises(ValueError, match="2\\^20"):
+        RunMergeSimulation(sim, batch=4)
