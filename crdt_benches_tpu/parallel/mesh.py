@@ -199,6 +199,53 @@ def sharded_merge_packed(
     return jax.jit(step)
 
 
+def sharded_merge_runs(
+    mesh: Mesh, capacity: int, n_base: int, batch: int, epoch: int,
+    nbits: int,
+):
+    """sharded_merge_packed at RUN granularity (engine/merge_range.py):
+    each device contributes its shard of the run-log wire stream,
+    all_gather reassembles the union over the mesh axis, every local
+    replica integrates it through merge_runlogs + the one-pass delete
+    fold, and convergence is pmin/pmax digest agreement.
+
+    ``step(lam, ag, slot0, rlen, origin, dlo, dhi, chars)`` with the five
+    run arrays (N,) and delete intervals (Nd,) sharded over the axis
+    (N and Nd divisible by the mesh size; pad runs with rlen == 0 and
+    intervals with dlo == -1 — both are no-ops end to end).
+    """
+    from ..engine.downstream import DownPacked as _DP
+    from ..engine.downstream import down_packed_init
+    from ..engine.merge_range import delete_fold, merge_runlogs
+    from ..utils.digest import doc_digest_packed
+
+    def body(lam, ag, s0, rl, orig, dlo, dhi, chars):
+        g = lambda x: jax.lax.all_gather(x, AXIS, tiled=True).reshape(-1)
+        state = merge_runlogs(
+            down_packed_init(1, capacity, n_base),
+            g(lam), g(ag), g(s0), g(rl), g(orig),
+            batch=batch, epoch=epoch, nbits=nbits,
+        )
+        state = delete_fold(state, g(dlo), g(dhi))
+        digests = jax.vmap(doc_digest_packed, in_axes=(0, 0, None))(
+            state.doc, state.length, chars
+        )
+        gmin = jax.lax.pmin(jnp.min(digests, axis=0), AXIS)
+        gmax = jax.lax.pmax(jnp.max(digests, axis=0), AXIS)
+        return state, digests, jnp.all(gmin == gmax)
+
+    wire_spec = tuple(P(AXIS) for _ in range(7))
+    state_spec = _DP(P(AXIS), P(AXIS), P(AXIS), P(AXIS))
+    step = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=wire_spec + (P(),),
+        out_specs=(state_spec, P(AXIS), P()),
+        check_rep=False,
+    )
+    return jax.jit(step)
+
+
 def make_sharded_state(
     mesh: Mesh, n_replicas: int, capacity: int, n_init: int = 0
 ) -> DocState:
