@@ -23,12 +23,13 @@ from .replay import _round_up
 
 @partial(
     jax.jit,
-    static_argnames=("nbits", "pack", "interpret"),
+    static_argnames=("nbits", "pack", "interpret", "token_cap"),
     donate_argnums=(0,),
 )
 def replay_ranges(
     state: PackedState, kind_b, pos_b, rlen_b, slot0_b,
-    *, nbits: int, pack: int = 4, interpret: bool = False
+    *, nbits: int, pack: int = 4, interpret: bool = False,
+    token_cap: int | None = None,
 ) -> PackedState:
     from ..ops.resolve_range_pallas import resolve_range_pallas
 
@@ -42,7 +43,8 @@ def replay_ranges(
         k, p, ln, s0 = batch
         for i in range(K):
             tokens, dints = resolve_range_pallas(
-                k[i], p[i], ln[i], st.nvis, interpret=interpret
+                k[i], p[i], ln[i], st.nvis, interpret=interpret,
+                token_cap=token_cap,
             )
             st = apply_range_batch(st, tokens, dints, s0[i], nbits=nbits)
         return st, None
@@ -98,6 +100,20 @@ class RangeReplayEngine:
             )
             for i in range(0, rt.n_batches, self.chunk)
         ]
+        # Per-chunk resolver token caps from the exact host simulation
+        # (ops/token_sim.py) — resolver cost is linear in the VMEM token
+        # list, and real batches sit far below the 2B+2 worst case.
+        self.token_caps: list[int | None] = [None] * len(self.chunks)
+        if os.environ.get("CRDT_ENGINE_TOKENSIM", "1") != "0":
+            from ..ops.token_sim import simulate_range_token_counts
+
+            tc = simulate_range_token_counts(
+                kind_b, pos_b, rlen_b, self.n_init
+            )
+            self.token_caps = [
+                _round_up(int(tc[i : i + self.chunk].max()) + 8, 128)
+                for i in range(0, rt.n_batches, self.chunk)
+            ]
         chars = np.zeros(self.capacity, np.int32)
         chars[: rt.capacity] = rt.chars
         self.chars = jnp.asarray(chars)
@@ -108,10 +124,13 @@ class RangeReplayEngine:
             if state is None
             else state
         )
-        for kind, pos, rlen, slot0 in self.chunks:
+        for tcap, (kind, pos, rlen, slot0) in zip(
+            self.token_caps, self.chunks
+        ):
             st = replay_ranges(
                 st, kind, pos, rlen, slot0,
                 nbits=self.nbits, pack=self.pack, interpret=self.interpret,
+                token_cap=tcap,
             )
         return st
 

@@ -209,20 +209,29 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("replica_tile", "interpret")
+    jax.jit, static_argnames=("replica_tile", "interpret", "token_cap")
 )
 def resolve_range_pallas(
-    kind, pos, rlen, v0, *, replica_tile: int = 32, interpret: bool = False
+    kind, pos, rlen, v0, *, replica_tile: int = 32, interpret: bool = False,
+    token_cap: int | None = None,
 ):
     """Resolve one batch of range ops for R replicas.
 
     kind/pos/rlen: int32[B]; v0: int32[R].  Returns
     (ttype, ta, tch, tlen) int32[R, T] token arrays and
     (drank_lo, drank_hi, dcount) int32[R, B] per-op delete intervals.
+
+    ``token_cap`` bounds the VMEM token list below the 2B+2 worst case
+    when the caller KNOWS the batch's final token count (host simulation,
+    ops/token_sim.py simulate_range_token_counts — kernel cost is linear
+    in the list size).  An undersized cap silently corrupts; callers must
+    use the simulation, and verify modes byte-check against the oracle.
     """
     B = kind.shape[0]
     R = v0.shape[0]
-    T = _round_up(2 * B + 2, 128)
+    T = _round_up(
+        min(2 * B + 2, token_cap) if token_cap else 2 * B + 2, 128
+    )
     Rt = min(replica_tile, max(8, (12 * 2**20) // ((12 * T + 6 * B) * 4)))
     Rt = 1 << (Rt.bit_length() - 1)
     while R % Rt:

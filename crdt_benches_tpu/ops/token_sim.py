@@ -116,3 +116,98 @@ def simulate_token_counts(
         jnp.asarray(kind_b), jnp.asarray(pos_b), jnp.asarray(v0), B=B
     )
     return np.asarray(out)
+
+
+# ---- range-op variant (ops/resolve_range_pallas.py sizing) ------------------
+
+
+@functools.partial(jax.jit, static_argnames=("B",), backend="cpu")
+def _sim_batches_range(kind_b, pos_b, rlen_b, v0, *, B: int):
+    """Token-count simulation for the RANGE resolver: inserts add 1-2
+    tokens (2 when splitting a run), deletes add a token only when
+    strictly inside one token (the vector clamp handles spanning deletes
+    without growth) — mirroring resolve_range_pallas's ``m`` rule.
+
+    Batches chain SEQUENTIALLY: each batch's end total (with the
+    kernel's own delete clamping applied) is the next batch's v0, so an
+    over-long delete cannot skew later batches' caps — an undersized cap
+    silently corrupts by the kernel's contract."""
+    T = 2 * B + 2
+
+    def batch_sim(v0, ops):
+        kind, pos, rlen = ops
+        tlen0 = jnp.zeros(T, jnp.int32).at[0].set(v0)
+        didx = jnp.arange(T, dtype=jnp.int32)
+
+        def step(carry, op):
+            tlen, nused = carry
+            k, p0, L0 = op
+            cum = jnp.cumsum(tlen)
+            total = cum[-1]
+            p = jnp.clip(p0, 0, total)
+            is_ins = (k == INSERT) & (L0 > 0)
+            D = jnp.where(k == DELETE, jnp.clip(L0, 0, total - p), 0)
+            is_del = (k == DELETE) & (D > 0)
+            L = jnp.where(is_ins, L0, 0)
+            pD = p + D
+
+            t = jnp.searchsorted(cum, p, side="right").astype(jnp.int32)
+            t = jnp.minimum(t, nused)
+            c_t = cum[t]
+            off = p - (c_t - tlen[t])
+            split_ins = is_ins & (off > 0)
+            split_del = is_del & (off > 0) & (pD < c_t)
+            m = jnp.where(
+                is_ins,
+                jnp.where(split_ins, 3, 2),
+                jnp.where(split_del, 2, 1),
+            )
+
+            # delete clamp: remove [p, pD) overlap from every token
+            clamped = jnp.minimum(cum, p) + jnp.maximum(0, cum - pD)
+            cum_c = jnp.where(is_del, clamped, cum)
+            tlen_c = cum_c - jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                              cum_c[:-1]])
+
+            n0l = jnp.where(
+                is_ins,
+                jnp.where(split_ins, off, L),
+                jnp.where(split_del, off, tlen_c[t]),
+            )
+            n1l = jnp.where(
+                is_ins,
+                jnp.where(split_ins, L, tlen[t]),
+                tlen[t] - off - D,
+            )
+            n2l = tlen[t] - off
+
+            src = jnp.clip(didx - (m - 1), 0, T - 1)
+            base = jnp.where(is_del, tlen_c, tlen)
+            shifted = base[src]
+            out = jnp.where(didx < t, base, shifted)
+            out = jnp.where(didx == t, n0l, out)
+            out = jnp.where((m >= 2) & (didx == t + 1), n1l, out)
+            out = jnp.where((m == 3) & (didx == t + 2), n2l, out)
+            return (out, nused + m - 1), None
+
+        (tlen, nused), _ = jax.lax.scan(
+            step, (tlen0, jnp.int32(1)), (kind, pos, rlen)
+        )
+        return jnp.sum(tlen), nused  # (next batch's v0, token count)
+
+    _, counts = jax.lax.scan(
+        batch_sim, jnp.int32(v0), (kind_b, pos_b, rlen_b)
+    )
+    return counts
+
+
+def simulate_range_token_counts(
+    kind_b: np.ndarray, pos_b: np.ndarray, rlen_b: np.ndarray, n_init: int
+) -> np.ndarray:
+    """Final token count per RANGE batch (host, prepare-time)."""
+    nb, B = kind_b.shape
+    out = _sim_batches_range(
+        jnp.asarray(kind_b), jnp.asarray(pos_b), jnp.asarray(rlen_b),
+        int(n_init), B=B,
+    )
+    return np.asarray(out)

@@ -153,3 +153,23 @@ def test_coalesced_range_engine_byte_identical(svelte_trace):
     st = eng.run()
     assert eng.decode(st, replica=0) == svelte_trace.end_content
     assert eng.decode(st, replica=1) == svelte_trace.end_content
+
+
+def test_range_token_cap_exact(svelte_trace):
+    # The capped resolver must produce byte-identical replay: the host
+    # simulation (simulate_range_token_counts) bounds the real token list.
+    import os
+
+    rt = tensorize_ranges(svelte_trace, batch=128, coalesce=True)
+    eng = RangeReplayEngine(rt, n_replicas=1, interpret=True, chunk=8)
+    # caps actually bite: strictly below the uncapped rounded T (384)
+    assert any(c is not None and c < 384 for c in eng.token_caps)
+    assert eng.decode(eng.run()) == svelte_trace.end_content
+
+    os.environ["CRDT_ENGINE_TOKENSIM"] = "0"
+    try:
+        eng2 = RangeReplayEngine(rt, n_replicas=1, interpret=True, chunk=8)
+        assert eng2.token_caps == [None] * len(eng2.chunks)
+        assert eng2.decode(eng2.run()) == svelte_trace.end_content
+    finally:
+        del os.environ["CRDT_ENGINE_TOKENSIM"]
