@@ -99,7 +99,7 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
         return BenchResult(
             "upstream", trace_name, b.NAME, elements, times, replicas=replicas
         )
-    if backend in ("jax-pos", "jax-range"):
+    if backend in ("jax-pos", "jax-range", "jax-runs"):
         return None  # downstream-only variants
     raise ValueError(f"unknown backend {backend!r}")
 
@@ -138,10 +138,11 @@ def run_downstream(trace_name: str, backend: str, samples: int,
         times = measure(iter_fn, warmup=warmup, samples=samples,
                         min_sample_time=0.05)
         return BenchResult("downstream", trace_name, backend, elements, times)
-    if backend in ("jax", "jax-pos", "jax-range"):
+    if backend in ("jax", "jax-pos", "jax-range", "jax-runs"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
             from ..engine.downstream_range import JaxRangeDownstreamBackend
+            from ..engine.merge_range import JaxRunDownstreamBackend
         except ImportError:
             return None
         if backend == "jax-range":
@@ -150,12 +151,17 @@ def run_downstream(trace_name: str, backend: str, samples: int,
             if not native_available():
                 return None  # range generation anchors on the native dump
             b = JaxRangeDownstreamBackend(n_replicas=replicas)
+        elif backend == "jax-runs":
+            b = JaxRunDownstreamBackend(n_replicas=replicas)
         else:
             b = JaxDownstreamBackend(
                 n_replicas=replicas, batch=batch,
                 engine="v3" if backend == "jax-pos" else None,
             )
-        b.prepare(trace)
+        try:
+            b.prepare(trace)
+        except ValueError:
+            return None  # capacity beyond this engine's bound: skip cell
         times = measure(b.replay_once, warmup=warmup, samples=samples)
         return BenchResult(
             "downstream", trace_name, b.NAME, elements, times,
@@ -469,10 +475,11 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
         down, _ = CppCrdtDownstream.upstream_updates(trace)
         down.apply_all_native()
         return down.content() == want
-    if backend in ("jax", "jax-pos", "jax-range"):
+    if backend in ("jax", "jax-pos", "jax-range", "jax-runs"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
             from ..engine.downstream_range import JaxRangeDownstreamBackend
+            from ..engine.merge_range import JaxRunDownstreamBackend
         except ImportError:
             return None
         if backend == "jax-range":
@@ -481,12 +488,17 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
             if not native_available():
                 return None
             b = JaxRangeDownstreamBackend(n_replicas=replicas)
+        elif backend == "jax-runs":
+            b = JaxRunDownstreamBackend(n_replicas=replicas)
         else:
             b = JaxDownstreamBackend(
                 n_replicas=replicas, batch=batch,
                 engine="v3" if backend == "jax-pos" else None,
             )
-        b.prepare(trace)
+        try:
+            b.prepare(trace)
+        except ValueError:
+            return None  # capacity beyond this engine's bound: skip cell
         return b.final_content() == want
     return None
 
@@ -622,9 +634,9 @@ def main(argv=None) -> int:
                         f"{r.median * 1e3:.2f}ms -> {r.elements_per_sec:,.0f} el/s",
                         file=sys.stderr,
                     )
-            if backend in ("cpp-crdt", "jax", "jax-pos", "jax-range") and (
-                not args.filter or args.filter in "downstream"
-            ):
+            if backend in (
+                "cpp-crdt", "jax", "jax-pos", "jax-range", "jax-runs"
+            ) and (not args.filter or args.filter in "downstream"):
                 r = run_downstream(trace, backend, args.samples, args.warmup,
                                    replicas=args.replicas, batch=args.batch)
                 if r:

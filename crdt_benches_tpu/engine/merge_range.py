@@ -536,3 +536,58 @@ class RunMergeSimulation:
             replica=replica,
         )
         return "".join(map(chr, np.asarray(codes)[: int(nvis)].tolist()))
+
+
+class JaxRunDownstreamBackend:
+    """Downstream bench backend at RUN granularity (column
+    ``jax-*-runs``): a single-writer log is the one-agent special case of
+    the run merge, so the RLE'd wire stream (the form diamond-types' own
+    binary updates take, reference src/rope.rs:214) integrates through
+    merge_runlogs — id->position anchor resolution, fragment placement
+    and the delete fold all INSIDE the timed region.  Wire translation
+    (per-patch updates -> runs) is untimed, like the reference's update
+    generation (src/main.rs:60).
+    """
+
+    def __init__(self, n_replicas: int = 1, batch: int = 256,
+                 epoch: int = 8):
+        self.n_replicas = n_replicas
+        self.batch = batch
+        self.epoch = epoch
+        self._rm: RunMergeSimulation | None = None
+
+    @property
+    def NAME(self) -> str:
+        plat = jax.devices()[0].platform
+        tag = f"-r{self.n_replicas}" if self.n_replicas > 1 else ""
+        return f"jax-{plat}{tag}-runs"
+
+    @property
+    def replicas(self) -> int:
+        return self.n_replicas
+
+    def prepare(self, trace) -> None:
+        from ..traces.tensorize import tensorize
+
+        tt = tensorize(trace, batch=512)
+        sim = MergeSimulation(
+            [tt], base=trace.start_content, batch=self.batch
+        )
+        self._rm = RunMergeSimulation(
+            sim, batch=self.batch, epoch=self.epoch
+        )
+        assert self._rm.fast_ok  # single writer: always holds
+        self._end_len = len(trace.end_content)
+
+    def replay_once(self) -> int:
+        state = self._rm.merge(n_replicas=self.n_replicas)
+        lengths = np.asarray(state.nvis)  # device -> host sync point
+        assert (lengths == self._end_len).all(), (
+            f"length mismatch: {lengths} != {self._end_len}"
+        )
+        return int(lengths.reshape(-1)[0])
+
+    def final_content(self) -> str:
+        state = self._rm.merge(n_replicas=self.n_replicas)
+        jax.block_until_ready(state)
+        return self._rm.decode(state)

@@ -132,3 +132,22 @@ def test_capacity_guard():
     sim.capacity = 1 << 20  # fresh sim per _sim call; safe to mutate
     with pytest.raises(ValueError, match="2\\^20"):
         RunMergeSimulation(sim, batch=4)
+
+
+def test_run_downstream_backend_byte_identical():
+    # single-writer special case: the run merge as a downstream apply
+    from crdt_benches_tpu.engine.merge_range import JaxRunDownstreamBackend
+    from crdt_benches_tpu.oracle import OracleDocument
+
+    from crdt_benches_tpu.traces.loader import TestData
+
+    trace = synth_trace(seed=31, n_ops=300, base="downstream via runs ")
+    doc = OracleDocument.from_str(trace.start_content)
+    for p, d, ins in trace.iter_patches():
+        doc.replace(p, p + d, ins)
+    want = doc.content()
+    trace = TestData(trace.start_content, want, trace.txns)
+    b = JaxRunDownstreamBackend(n_replicas=2, batch=16, epoch=2)
+    b.prepare(trace)
+    assert b.replay_once() == len(want)
+    assert b.final_content() == want
