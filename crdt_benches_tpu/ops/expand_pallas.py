@@ -453,8 +453,32 @@ def apply_fused_blocked(doc_predel, combo, cnt_base, new_len, *,
     R, C = doc_predel.shape
     nt = C // LANE
     bt = block_tiles
-    while nt % bt:
-        bt //= 2
+    # When the tile count doesn't divide into blocks (e.g. an odd nt at
+    # multi-M capacities), PAD the capacity axis up to a block multiple
+    # rather than shrinking bt toward 1 (a 1-tile block cannot host the
+    # halo): the pad region carries no inserts (combo 0), tombstone-coded
+    # doc (pack_doc(-1,0) == 2), and a flat cnt_base, so every padded
+    # output column is past new_len and sliced away below.
+    pad_t = (-nt) % bt
+    if pad_t and pad_t > nt // 4 and bt > 8:
+        # avoid >25% padded work: try smaller blocks first
+        while bt > 8 and (-nt) % bt > nt // 4:
+            bt //= 2
+        pad_t = (-nt) % bt
+    if pad_t:
+        padc = pad_t * LANE
+        doc_predel = jnp.concatenate(
+            [doc_predel, jnp.full((R, padc), 2, jnp.int32)], axis=1
+        )
+        combo = jnp.concatenate(
+            [combo, jnp.zeros((R, padc), jnp.int32)], axis=1
+        )
+        cnt_base = jnp.concatenate(
+            [cnt_base,
+             jnp.broadcast_to(cnt_base[:, -1:], (R, pad_t))],
+            axis=1,
+        )
+        nt += pad_t
     # halo tiles, rounded to a multiple of 8 so every sublane-dim slice
     # and roll in the kernel stays tile-aligned (unaligned VMEM copies
     # serialize in Mosaic)
@@ -508,7 +532,8 @@ def apply_fused_blocked(doc_predel, combo, cnt_base, new_len, *,
         cb3, cb3,
         new_len.reshape(R, 1, 1).astype(jnp.int32),
     )
-    return out.reshape(R, C)
+    out = out.reshape(R, nt * LANE)
+    return out[:, :C] if nt * LANE != C else out
 
 
 def apply_fused_xla(doc_predel, combo, cnt_base, new_len, *, nbits: int):

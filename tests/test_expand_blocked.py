@@ -152,3 +152,22 @@ def test_blocked_on_silicon_boundary_shifts():
         )
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_blocked_pads_indivisible_tile_counts():
+    # nt with no usable divisor (e.g. odd) must pad to a block multiple
+    # instead of degrading to 1-tile blocks that cannot host the halo.
+    rng = np.random.default_rng(17)
+    R, C = 1, 131 * LANE  # nt = 131 (prime)
+    nbits = 6
+    doc, combo, cb, ln = _mk(rng, R, C, 40, nbits)
+    want = np.asarray(
+        apply_fused_nocv_xla(doc, combo, cb, ln, nbits=nbits)
+    )
+    got = np.asarray(
+        apply_fused_blocked(
+            doc, combo, cb, ln, nbits=nbits, block_tiles=16,
+            interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(got, want)
