@@ -461,7 +461,11 @@ def count_le_two_level(cv_intile, tile_base, tmax_abs, q):
         jax.lax.broadcasted_iota(jnp.int32, (R, B, ns), 2) == sq[:, :, None]
     )
     base = jnp.zeros((R, B), jnp.int32)
-    for k in range(3):  # tile_base < 2**21 (capacity bound)
+    # tile_base < C: derive the chunk count from the static capacity so
+    # capacities beyond 2^21 cannot silently drop high bits (the same
+    # adaptive widening spread_fill_combo applies).
+    n_chunks = max(3, -(-int(C).bit_length() // 7))
+    for k in range(n_chunks):
         chunk = jnp.bitwise_and(
             jnp.right_shift(base_p, 7 * k), 127
         ).astype(jnp.bfloat16)
