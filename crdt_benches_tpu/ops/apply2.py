@@ -464,7 +464,7 @@ def count_le_two_level(cv_intile, tile_base, tmax_abs, q):
     # tile_base < C: derive the chunk count from the static capacity so
     # capacities beyond 2^21 cannot silently drop high bits (the same
     # adaptive widening spread_fill_combo applies).
-    n_chunks = max(3, -(-int(C).bit_length() // 7))
+    n_chunks = max(3, -(-((int(C) - 1).bit_length()) // 7))
     for k in range(n_chunks):
         chunk = jnp.bitwise_and(
             jnp.right_shift(base_p, 7 * k), 127

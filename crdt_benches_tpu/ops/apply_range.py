@@ -44,7 +44,12 @@ def _two_level_vis(doc, length):
     one-hot row fetches ride the MXU — the take_along_axis row gather it
     replaces serializes per row (~21ns each; was ~100ms/batch at R=1024,
     3 query sets).  Also removes the full-capacity cumvis cumsum: the
-    within-tile cumsum has no cross-tile dependency."""
+    within-tile cumsum has no cross-tile dependency.
+
+    Same structure init_state4 builds for the MAINTAINED-cumvis engine
+    (apply2.py) — kept separate because this one masks by ``length``
+    (the recomputed-per-batch form) while init_state4 builds from a
+    fresh doc with no live length; change both if the layout changes."""
     R, C = doc.shape
     nt = C // LANE
     col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
