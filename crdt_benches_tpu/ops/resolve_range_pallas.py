@@ -142,10 +142,22 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
         # Replacement pieces.  For inserts: [left?, TINS(j,0,L), right].
         # For an inside-delete: [left-keep, right-keep].  m == 1 writes the
         # token's CLAMPED values back (identity for inserts/PAD; the
-        # delete's boundary adjustment for spanning deletes).
-        c_t_clamped = jnp.sum(jnp.where(m_t, cum_c, 0), axis=1, keepdims=True)
-        tta_cl = jnp.sum(jnp.where(m_t, tta_c, 0), axis=1, keepdims=True)
-        ch_cl = jnp.sum(jnp.where(m_t, tch_c, 0), axis=1, keepdims=True)
+        # delete's boundary adjustment for spanning deletes).  The clamped
+        # values AT t are derived by scalar arithmetic from the already-
+        # fetched (c_t, pre, tta_t, ch) — three fewer (Rt, T) reductions
+        # per op than re-reducing the clamped arrays.
+        c_t_clamped = jnp.where(
+            is_del,
+            jnp.minimum(c_t, p) + jnp.maximum(0, c_t - pD),
+            c_t,
+        )
+        adv_t = jnp.where(
+            is_del & (c_t > pD),
+            jnp.maximum(0, jnp.minimum(c_t, pD) - jnp.maximum(pre, p)),
+            0,
+        )
+        tta_cl = tta_t + jnp.where(is_run_t, adv_t * 4, 0)
+        ch_cl = ch + jnp.where(tt == TINS, adv_t, 0)
         tta_right_del = tta_t + jnp.where(is_run_t, (pD - pre) * 4, 0)
         ch_right_del = jnp.where(is_run_t, ch, ch + (pD - pre))
         tta_right_ins = tta_t + jnp.where(is_run_t, off * 4, 0)
