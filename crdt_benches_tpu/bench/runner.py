@@ -224,10 +224,12 @@ def _merge_sim(config: str, merge_ops: int, batch: int):
 def _range_merge_sim(sim, batch: int):
     """The ONE RunMergeSimulation schedule (batch/epoch) shared by the
     timed jax-range merge cell and its --verify check — a drift here
-    would verify a different schedule than the one benchmarked."""
+    would verify a different schedule than the one benchmarked.  W=512
+    runs/batch measured ~1.5x over 256 on the traces config (fewer
+    sequential batches; the W x W forest stays cheap)."""
     from ..engine.merge_range import RunMergeSimulation
 
-    return RunMergeSimulation(sim, batch=min(batch, 256), epoch=8)
+    return RunMergeSimulation(sim, batch=512, epoch=8)
 
 
 def _delivered_log(sim, config: str, merge_ops: int):
