@@ -549,8 +549,10 @@ class JaxRunDownstreamBackend:
     generation (src/main.rs:60).
     """
 
-    def __init__(self, n_replicas: int = 1, batch: int = 256,
+    def __init__(self, n_replicas: int = 1, batch: int = 512,
                  epoch: int = 8):
+        # 512 runs/batch measured ~1.4x over 256 on automerge-paper at
+        # 64 replicas (fewer sequential batches, same per-batch shape)
         self.n_replicas = n_replicas
         self.batch = batch
         self.epoch = epoch
