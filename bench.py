@@ -46,10 +46,17 @@ def main() -> int:
     elements = len(trace)
     end_len = len(trace.end_content)
 
-    # ---- single-core native CRDT baseline (untimed setup, timed replay) ----
-    baseline_eps = None
+    # ---- single-core native CRDT baselines (untimed setup, timed replay).
+    # TWO cpp-crdt columns for stream symmetry (VERDICT r3 weak #4): the
+    # per-patch stream (the reference's own calling shape, one replace per
+    # patch, src/main.rs:31-32) and the RLE-coalesced stream — the SAME
+    # stream the JAX range engine replays — so the headline ratio compares
+    # identical inputs on both sides.  Throughput unit stays element =
+    # trace patch for both (the same document work either way). ----
+    baseline_eps = baseline_rle_eps = None
     try:
         from crdt_benches_tpu.backends.native import CppCrdt, native_available
+        from crdt_benches_tpu.traces.tensorize import coalesce_patches
 
         if native_available():
             pa = patch_arrays(trace)
@@ -59,6 +66,16 @@ def main() -> int:
 
             times = measure(native_iter, warmup=1, samples=samples)
             baseline_eps = elements / _median(times)
+
+            pa_rle = patch_arrays(
+                trace, patches=list(coalesce_patches(trace))
+            )
+
+            def native_iter_rle():
+                assert CppCrdt.replay_patches(pa_rle) == end_len
+
+            times = measure(native_iter_rle, warmup=1, samples=samples)
+            baseline_rle_eps = elements / _median(times)
     except Exception as e:  # baseline is advisory; the metric must still print
         print(f"native baseline failed: {e}", file=sys.stderr)
 
@@ -92,21 +109,36 @@ def main() -> int:
     times = measure(backend.replay_once, warmup=1, samples=samples)
     agg_eps = elements * replicas / _median(times)
 
-    vs = agg_eps / baseline_eps if baseline_eps else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"{trace_name} aggregate replay throughput, "
-                    f"{replicas} replicas, jax-{platform} "
-                    f"(baseline: cpp-crdt 1 core)"
-                ),
-                "value": round(agg_eps, 1),
-                "unit": "elements/sec",
-                "vs_baseline": round(vs, 3),
-            }
-        )
+    # Headline ratio = stream-SYMMETRIC: the cpp baseline consumes the
+    # same RLE-coalesced stream the JAX range engine replays.  The
+    # per-patch-stream ratio (the reference's own calling shape, and the
+    # r1-r3 headline denominator) rides along as vs_cpp_perpatch.  If the
+    # RLE baseline failed to run, the label says which denominator was
+    # actually used — never claim stream symmetry on a fallback.
+    base = baseline_rle_eps or baseline_eps
+    vs = agg_eps / base if base else 0.0
+    base_desc = (
+        "cpp-crdt 1 core, same coalesced stream"
+        if baseline_rle_eps
+        else "cpp-crdt 1 core, per-patch stream (RLE baseline unavailable)"
     )
+    out = {
+        "metric": (
+            f"{trace_name} aggregate replay throughput, "
+            f"{replicas} replicas, jax-{platform} "
+            f"(baseline: {base_desc})"
+        ),
+        "value": round(agg_eps, 1),
+        "unit": "elements/sec",
+        "vs_baseline": round(vs, 3),
+    }
+    if baseline_eps:
+        out["vs_cpp_perpatch"] = round(agg_eps / baseline_eps, 3)
+    if baseline_rle_eps:
+        out["cpp_rle_els_per_sec"] = round(baseline_rle_eps, 1)
+    if baseline_eps:
+        out["cpp_perpatch_els_per_sec"] = round(baseline_eps, 1)
+    print(json.dumps(out))
     return 0
 
 

@@ -72,6 +72,12 @@ def _load_lib():
     sig(lib.crdt_gen_updates, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _u8p, _i64, _i64p])
     sig(lib.crdt_integrate_ops, _i64, [_vp, _i64, _u8p, _u32p, _u32p, _u32p, _u32p, _i32p])
     sig(lib.crdt_replay_dump, _i64, [_i32p, _i64, _i32p, _i32p, _i32p, _i32p, _i64, _i32p, _i64, _u8p, _i32p, _i64])
+    sig(lib.cola_new, _vp, [_i64])
+    sig(lib.cola_free, None, [_vp])
+    sig(lib.cola_len, _i64, [_vp])
+    sig(lib.cola_insert, None, [_vp, _i64, _i64])
+    sig(lib.cola_remove, None, [_vp, _i64, _i64])
+    sig(lib.cola_replay, _i64, [_i64, _i32p, _i32p, _i32p, _i64])
     return lib
 
 
@@ -209,6 +215,49 @@ class CppRopeBytes(CppRope):
         )
         # Elements are UTF-8 bytes, not codepoints.
         return bytes(out[:n].astype(np.uint8).tobytes()).decode("utf-8")
+
+
+@register_upstream
+class CppCola(Upstream):
+    """Content-free (lengths-only) sequence-CRDT replica: the cola
+    capability (reference src/rope.rs:79-101 — ``Replica::new(1,
+    s.len())`` seeds from a LENGTH, edits are ``(offset, length)`` pairs,
+    and the only readback is ``len()``).  No character data is stored or
+    even crosses the FFI; ``content()`` stays None (the trait default for
+    lengths-only engines).  Byte-addressed like the reference's cola
+    adapter (EDITS_USE_BYTE_OFFSETS, src/rope.rs:82).  Engine:
+    native/cola.cpp run-granular implicit treap with retained tombstones.
+    """
+
+    NAME = "cpp-cola"
+    EDITS_USE_BYTE_OFFSETS = True
+
+    def __init__(self, handle):
+        self._h = handle
+
+    @classmethod
+    def from_str(cls, s: str) -> "CppCola":
+        return cls(lib().cola_new(len(s.encode("utf-8"))))
+
+    def insert(self, at: int, text: str) -> None:
+        lib().cola_insert(self._h, at, len(text.encode("utf-8")))
+
+    def remove(self, start: int, end: int) -> None:
+        lib().cola_remove(self._h, start, end)
+
+    def __len__(self) -> int:
+        return lib().cola_len(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            lib().cola_free(self._h)
+            self._h = None
+
+    @staticmethod
+    def replay_patches(pa: PatchArrays) -> int:
+        return lib().cola_replay(
+            len(pa.init), pa.pos, pa.del_count, pa.ins_off, pa.n_patches
+        )
 
 
 @register_upstream

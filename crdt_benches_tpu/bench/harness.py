@@ -63,6 +63,15 @@ class BenchResult:
         return min(self.samples)
 
     @property
+    def worst(self) -> float:
+        return max(self.samples)
+
+    @property
+    def outliers(self) -> dict:
+        """Tukey classification of this cell's final kept samples."""
+        return classify_outliers(self.samples)
+
+    @property
     def elements_per_sec(self) -> float:
         """Criterion throughput: elements / median sample time, scaled by the
         replica count for batched backends (aggregate throughput)."""
@@ -74,9 +83,61 @@ class BenchResult:
             median=self.median,
             mean=self.mean,
             stddev=self.stddev,
+            min=self.best,
+            max=self.worst,
             elements_per_sec=self.elements_per_sec,
+            outliers=self.outliers,
         )
+        # measure() hands back a SampleList carrying any samples it
+        # discarded as severe outliers and re-ran — persist them so every
+        # committed artifact is self-describing (VERDICT r3 missing #1).
+        discarded = getattr(self.samples, "discarded", [])
+        if discarded:
+            d["discarded_outliers"] = list(discarded)
         return d
+
+
+def _quantile(sorted_s: list[float], p: float) -> float:
+    n = len(sorted_s)
+    k = p * (n - 1)
+    f = math.floor(k)
+    c = min(f + 1, n - 1)
+    return sorted_s[f] + (sorted_s[c] - sorted_s[f]) * (k - f)
+
+
+def classify_outliers(samples: list[float]) -> dict:
+    """Tukey-fence outlier classification (criterion's analysis: mild
+    outside Q1/Q3 +- 1.5*IQR, severe outside +- 3*IQR — the capability the
+    reference gets from the criterion crate, Cargo.toml:11).  Returns
+    counts plus the flagged values so saved artifacts are self-auditing."""
+    n = len(samples)
+    if n < 4:
+        return {"mild": 0, "severe": 0, "flagged": []}
+    s = sorted(samples)
+    q1, q3 = _quantile(s, 0.25), _quantile(s, 0.75)
+    iqr = q3 - q1
+    lo3, lo15 = q1 - 3.0 * iqr, q1 - 1.5 * iqr
+    hi15, hi3 = q3 + 1.5 * iqr, q3 + 3.0 * iqr
+    severe = [x for x in samples if x < lo3 or x > hi3]
+    mild = [x for x in samples
+            if (lo3 <= x < lo15) or (hi15 < x <= hi3)]
+    out = {"mild": len(mild), "severe": len(severe),
+           "flagged": sorted(mild + severe)}
+    if severe or mild:
+        out["fences"] = [lo3, lo15, hi15, hi3]
+    return out
+
+
+class SampleList(list):
+    """The kept samples of one cell plus the harness's annotations:
+    ``discarded`` = severe outliers that were re-measured and replaced
+    (each re-run logged, never silently dropped), ``reruns`` = how many
+    replacement rounds ran."""
+
+    def __init__(self, xs=()):
+        super().__init__(xs)
+        self.discarded: list[float] = []
+        self.reruns: int = 0
 
 
 def measure(
@@ -85,18 +146,25 @@ def measure(
     warmup: int = 1,
     samples: int = 5,
     min_sample_time: float = 0.0,
-) -> list[float]:
+    max_reruns: int = 2,
+) -> SampleList:
     """Time ``fn`` ``samples`` times after ``warmup`` untimed calls.
 
     ``fn`` must be synchronous/blocking (device backends call
     ``block_until_ready`` internally — honest timing per SURVEY.md section 7
     hard-part 6).  If one call is shorter than ``min_sample_time``, loops
     within the sample and divides (criterion's iteration batching).
-    """
-    for _ in range(warmup):
-        fn()
-    out: list[float] = []
-    for _ in range(samples):
+
+    Outlier policy (VERDICT r3 missing #1 — criterion's statistical
+    rigor): after sampling, severe Tukey outliers (outside Q1/Q3 +-
+    3*IQR; on this box they are environmental — a recompile, a tunnel
+    stall, cpp running against a busy shared core) are re-measured up to
+    ``max_reruns`` times; replaced values are kept in ``.discarded`` and
+    persisted by BenchResult.to_dict, so a 12x-off sample can never sit
+    unexplained in a committed artifact again.  Survivors after the
+    rerun budget stay IN the sample set (annotated, not dropped)."""
+
+    def one_sample() -> float:
         iters = 0
         t0 = time.perf_counter()
         while True:
@@ -105,7 +173,26 @@ def measure(
             dt = time.perf_counter() - t0
             if dt >= min_sample_time:
                 break
-        out.append(dt / iters)
+        return dt / iters
+
+    for _ in range(warmup):
+        fn()
+    out = SampleList(one_sample() for _ in range(samples))
+    for _ in range(max_reruns):
+        cls = classify_outliers(out)
+        if not cls["severe"]:
+            break
+        # fences come from the SAME classification that decided a rerun
+        # is needed (severe > 0 guarantees they're present) — one Tukey
+        # definition, no second copy of the formula to drift.
+        lo3, hi3 = cls["fences"][0], cls["fences"][3]
+        keep = SampleList(x for x in out if lo3 <= x <= hi3)
+        keep.discarded = out.discarded + [
+            x for x in out if x < lo3 or x > hi3
+        ]
+        keep.reruns = out.reruns + 1
+        keep.extend(one_sample() for _ in range(samples - len(keep)))
+        out = keep
     return out
 
 

@@ -30,7 +30,7 @@ def replay_ranges(
     state: PackedState, kind_b, pos_b, rlen_b, slot0_b,
     *, nbits: int, pack: int = 4, interpret: bool = False,
     token_cap: int | None = None,
-) -> PackedState:
+):
     from ..ops.resolve_range_pallas import resolve_range_pallas
 
     NB, B = kind_b.shape
@@ -39,20 +39,23 @@ def replay_ranges(
         K -= 1
     rs = lambda x: x.reshape(NB // K, K, B)
 
-    def step(st, batch):
+    def step(carry, batch):
+        st, mx = carry
         k, p, ln, s0 = batch
         for i in range(K):
-            tokens, dints = resolve_range_pallas(
+            tokens, dints, nused = resolve_range_pallas(
                 k[i], p[i], ln[i], st.nvis, interpret=interpret,
                 token_cap=token_cap,
             )
+            mx = jnp.maximum(mx, jnp.max(nused))
             st = apply_range_batch(st, tokens, dints, s0[i], nbits=nbits)
-        return st, None
+        return (st, mx), None
 
-    state, _ = jax.lax.scan(
-        step, state, (rs(kind_b), rs(pos_b), rs(rlen_b), rs(slot0_b))
+    (state, max_nused), _ = jax.lax.scan(
+        step, (state, jnp.int32(0)),
+        (rs(kind_b), rs(pos_b), rs(rlen_b), rs(slot0_b)),
     )
-    return state
+    return state, max_nused
 
 
 class RangeReplayEngine:
@@ -65,9 +68,15 @@ class RangeReplayEngine:
         lane: int = 128,
         chunk: int = 32,
         pack: int = 4,
-        interpret: bool = False,
+        interpret: bool | None = None,
     ):
         import os
+
+        if interpret is None:
+            # The range resolver has no XLA twin in this driver; off-TPU
+            # (bench.py's CPU fallback, virtual-device runs) the Pallas
+            # kernel must run in interpret mode or pallas_call errors out.
+            interpret = jax.default_backend() != "tpu"
 
         self.rt = rt
         self.n_replicas = n_replicas
@@ -124,14 +133,31 @@ class RangeReplayEngine:
             if state is None
             else state
         )
+        # (effective kernel T, device max nused) per chunk; a single
+        # host fetch AFTER the loop keeps syncs out of the chunk loop
+        # while turning an undersized token cap into a loud failure
+        # instead of silent corruption (ADVICE r3).
+        demands: list[tuple[int, jax.Array]] = []
+        from ..ops.resolve_range_pallas import effective_token_list_size
+
         for tcap, (kind, pos, rlen, slot0) in zip(
             self.token_caps, self.chunks
         ):
-            st = replay_ranges(
+            st, mx = replay_ranges(
                 st, kind, pos, rlen, slot0,
                 nbits=self.nbits, pack=self.pack, interpret=self.interpret,
                 token_cap=tcap,
             )
+            demands.append(
+                (effective_token_list_size(kind.shape[1], tcap), mx)
+            )
+        for i, (t_eff, mx) in enumerate(demands):
+            got = int(mx)
+            if got > t_eff:  # not assert: must survive python -O
+                raise RuntimeError(
+                    f"range resolver token overflow in chunk {i}: demand"
+                    f" {got} > VMEM list size {t_eff} (token_sim drift?)"
+                )
         return st
 
     def decode(self, state: PackedState, replica: int = 0) -> str:

@@ -44,13 +44,22 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def effective_token_list_size(B: int, token_cap: int | None) -> int:
+    """The kernel's actual VMEM token-list size T for a batch of B ops
+    under ``token_cap`` — the ONE formula shared with overflow-checking
+    callers (engine/replay_range.py), so the nused <= T guard can never
+    drift from the kernel's real sizing."""
+    return _round_up(min(2 * B + 2, token_cap) if token_cap else 2 * B + 2,
+                     128)
+
+
 def _roll1(x):
     return jnp.concatenate([x[:, -1:], x[:, :-1]], axis=1)
 
 
 def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
             dlo_ref, dhi_ref, dn_ref,
-            ttype_ref, ta_ref, tch_ref, tlen_ref,
+            ttype_ref, ta_ref, tch_ref, tlen_ref, nused_ref,
             *, B: int, T: int, Rt: int):
     lane_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, T), 1)
     lane_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
@@ -218,7 +227,7 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
             nused + (m - 1),
         )
 
-    tta, tch, cum, _, _ = jax.lax.fori_loop(
+    tta, tch, cum, _, nused = jax.lax.fori_loop(
         0, B, body, (tta0, tch0, cum0, total0, nused0)
     )
     ttype = jnp.bitwise_and(tta, 3)
@@ -227,6 +236,10 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
     ta_ref[:] = ta
     tch_ref[:] = tch
     tlen_ref[:] = cum - jnp.where(lane_t == 0, 0, _roll1(cum))
+    # nused counts m-1 per op UNCONDITIONALLY, so it is the TRUE token
+    # demand even when placements past T were dropped — callers compare
+    # it against T to turn an undersized token_cap into a loud failure.
+    nused_ref[:] = nused
 
 
 @functools.partial(
@@ -239,20 +252,21 @@ def resolve_range_pallas(
     """Resolve one batch of range ops for R replicas.
 
     kind/pos/rlen: int32[B]; v0: int32[R].  Returns
-    (ttype, ta, tch, tlen) int32[R, T] token arrays and
-    (drank_lo, drank_hi, dcount) int32[R, B] per-op delete intervals.
+    (ttype, ta, tch, tlen) int32[R, T] token arrays,
+    (drank_lo, drank_hi, dcount) int32[R, B] per-op delete intervals,
+    and nused int32[R, 1] — the batch's TRUE final token demand.
 
     ``token_cap`` bounds the VMEM token list below the 2B+2 worst case
     when the caller KNOWS the batch's final token count (host simulation,
     ops/token_sim.py simulate_range_token_counts — kernel cost is linear
-    in the list size).  An undersized cap silently corrupts; callers must
-    use the simulation, and verify modes byte-check against the oracle.
+    in the list size).  An undersized cap corrupts the token arrays, so
+    callers MUST check ``nused <= T`` (T = the rounded cap this function
+    used) after the run — nused counts demand past T, turning sim/kernel
+    drift into a loud failure instead of silent corruption (ADVICE r3).
     """
     B = kind.shape[0]
     R = v0.shape[0]
-    T = _round_up(
-        min(2 * B + 2, token_cap) if token_cap else 2 * B + 2, 128
-    )
+    T = effective_token_list_size(B, token_cap)
     # 12MB scoped-VMEM budget: at typical B the power-of-two floor below
     # caps Rt at 64 — measured fastest (32 is ~6% slower; 128 fails to
     # compile under Mosaic's real VMEM accounting)
@@ -275,9 +289,12 @@ def resolve_range_pallas(
                   pl.BlockSpec((Rt, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=[ospec(B), ospec(B), ospec(B),
-                   ospec(T), ospec(T), ospec(T), ospec(T)],
+                   ospec(T), ospec(T), ospec(T), ospec(T),
+                   pl.BlockSpec((Rt, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)],
         out_shape=[jax.ShapeDtypeStruct((R, B), jnp.int32)] * 3
-        + [jax.ShapeDtypeStruct((R, T), jnp.int32)] * 4,
+        + [jax.ShapeDtypeStruct((R, T), jnp.int32)] * 4
+        + [jax.ShapeDtypeStruct((R, 1), jnp.int32)],
         interpret=interpret,
     )(
         kind.reshape(1, B).astype(jnp.int32),
@@ -285,5 +302,5 @@ def resolve_range_pallas(
         rlen.reshape(1, B).astype(jnp.int32),
         v0.reshape(R, 1).astype(jnp.int32),
     )
-    dlo, dhi, dn, ttype, ta, tch, tlen = out
-    return (ttype, ta, tch, tlen), (dlo, dhi, dn)
+    dlo, dhi, dn, ttype, ta, tch, tlen, nused = out
+    return (ttype, ta, tch, tlen), (dlo, dhi, dn), nused

@@ -151,3 +151,52 @@ def test_run_downstream_backend_byte_identical():
     b.prepare(trace)
     assert b.replay_once() == len(want)
     assert b.final_content() == want
+
+
+def test_patch_granularity_downstream_byte_identical():
+    """The strict like-for-like wire (granularity='patch'): one update
+    per trace patch component, NO cross-patch RLE coalescing — matching
+    the reference's per-patch generation loop (src/rope.rs:196-220).
+    Byte-identical apply, and every wire run must lie inside a single
+    patch's insert range."""
+    import numpy as np
+
+    from crdt_benches_tpu.engine.merge_range import JaxRunDownstreamBackend
+    from crdt_benches_tpu.oracle import OracleDocument
+    from crdt_benches_tpu.traces.loader import TestData
+
+    trace = synth_trace(seed=33, n_ops=400, base="per-patch wire ")
+    doc = OracleDocument.from_str(trace.start_content)
+    for p, d, ins in trace.iter_patches():
+        doc.replace(p, p + d, ins)
+    want = doc.content()
+    trace = TestData(trace.start_content, want, trace.txns)
+
+    b = JaxRunDownstreamBackend(n_replicas=2, batch=16, epoch=2,
+                                granularity="patch")
+    b.prepare(trace)
+    assert b.replay_once() == len(want)
+    assert b.final_content() == want
+
+    # granularity: map every insert slot to its patch; no run may span two
+    from crdt_benches_tpu.traces.tensorize import tensorize
+
+    tt = tensorize(trace, batch=512)
+    n_base = len(trace.start_content)
+    patch_of_slot = np.full(int(tt.slot.max(initial=0)) + 2, -1, np.int64)
+    u = 0
+    for i, (_p, d, ins) in enumerate(trace.iter_patches()):
+        for k in range(len(ins)):
+            patch_of_slot[tt.slot[u + d + k]] = i
+        u += d + len(ins)
+    rl = b._rm.runlogs[0]
+    s0 = rl.slot0
+    ln = rl.rlen
+    assert (
+        patch_of_slot[s0] == patch_of_slot[s0 + ln - 1]
+    ).all(), "a wire run crosses a patch boundary"
+
+    # the coalesced wire on the same trace is allowed to be coarser
+    bc = JaxRunDownstreamBackend(n_replicas=1, batch=16, epoch=2)
+    bc.prepare(trace)
+    assert b._rm.n_runs >= bc._rm.n_runs

@@ -239,3 +239,82 @@ def test_byte_offset_crdt_replay_rustcode(rustcode_trace):
         if ins:
             doc.insert(pos, ins)
     assert doc.content() == rustcode_trace.end_content
+
+
+def test_cola_content_free_basic():
+    """Lengths-only replica (the cola capability, reference
+    src/rope.rs:79-101): seeded from a byte LENGTH, edits are
+    (offset, length), readback is len() only — content() is None."""
+    from crdt_benches_tpu.backends.native import CppCola
+
+    r = CppCola.from_str("héllo")  # 6 bytes
+    assert len(r) == 6
+    assert r.content() is None
+    r.insert(3, "XY")
+    assert len(r) == 8
+    r.remove(1, 4)
+    assert len(r) == 5
+    r.replace(0, 2, "abc")  # trait-default replace: remove + insert
+    assert len(r) == 6
+
+
+def test_cola_random_differential_lengths():
+    """Randomized edit sequence vs a Python shadow byte-list: every
+    intermediate length must agree (the only observable of a
+    content-free replica)."""
+    import numpy as np
+
+    from crdt_benches_tpu.backends.native import CppCola
+
+    rng = np.random.default_rng(7)
+    r = CppCola.from_str("x" * 40)
+    shadow = 40
+    for _ in range(3000):
+        if shadow and rng.integers(3) == 0:
+            a = int(rng.integers(shadow))
+            b = int(rng.integers(a, min(shadow, a + 12) + 1))
+            r.remove(a, b)
+            shadow -= b - a
+        else:
+            at = int(rng.integers(shadow + 1))
+            n = int(rng.integers(1, 9))
+            r.insert(at, "y" * n)
+            shadow += n
+        assert len(r) == shadow
+
+
+def test_cola_replay_all_traces_length(request):
+    """Full four-trace replay through the one-call native path, in UTF-8
+    byte units (the runner's EDITS_USE_BYTE_OFFSETS path), asserting the
+    end length — exactly the observable the reference's cola bench
+    asserts (src/main.rs:35)."""
+    from crdt_benches_tpu.backends.native import CppCola
+    from crdt_benches_tpu.traces.patches import patch_arrays
+
+    for fixture in (
+        "svelte_trace", "rustcode_trace", "seph_trace", "automerge_trace"
+    ):
+        trace = request.getfixturevalue(fixture)
+        pa = patch_arrays(trace.chars_to_bytes(), bytes_mode=True)
+        assert CppCola.replay_patches(pa) == pa.end_len == len(
+            trace.end_content.encode("utf-8")
+        )
+
+
+def test_coalesced_stream_native_replay_byte_identical(svelte_trace):
+    """The RLE-coalesced patch stream (traces/tensorize.py
+    coalesce_patches) replayed through the native engines is
+    byte-identical — the guarantee behind the stream-symmetric headline
+    baseline (bench.py feeds cpp-crdt the same coalesced stream the JAX
+    range engine replays)."""
+    from crdt_benches_tpu.backends.native import CppCrdt, CppRope
+    from crdt_benches_tpu.traces.patches import patch_arrays
+    from crdt_benches_tpu.traces.tensorize import coalesce_patches
+
+    patches = list(coalesce_patches(svelte_trace))
+    assert len(patches) < len(svelte_trace)  # RLE actually coalesced
+    pa = patch_arrays(svelte_trace, patches=patches)
+    assert CppCrdt.replay_patches(pa) == len(svelte_trace.end_content)
+    assert (
+        CppRope.replay_patches_content(pa) == svelte_trace.end_content
+    )

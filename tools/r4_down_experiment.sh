@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 downstream experiment (serialized TPU job — ONE tpu client at a
+# time on this box): per-patch-wire downstream (jax-patch) vs cpp-crdt,
+# batch/replica sweep on automerge-paper + rustcode, paired baselines.
+# Results land in bench_results/ via the runner's save_results plus this
+# log.  Run with: nohup bash tools/r4_down_experiment.sh > /tmp/r4down.log 2>&1 &
+set -x
+cd /root/repo
+
+run() {  # run one cell matrix with a timeout and keep going on failure
+  timeout 2400 python -m crdt_benches_tpu.bench.runner "$@" || true
+}
+
+# 1) paired cpp-crdt downstream baselines (the denominator, same run)
+run --filter downstream --backends cpp-crdt \
+    --traces automerge-paper,rustcode,sveltecomponent,seph-blog1 \
+    --samples 5 --save-baseline down_cpp_r4
+
+# 2) jax-patch at r64, default batch 512
+run --filter downstream --backends jax-patch \
+    --traces automerge-paper,rustcode --replicas 64 \
+    --samples 3 --save-baseline down_patch_r64_b512
+
+# 3) batch sweep via env (RunMergeSimulation batch is the backend arg;
+#    expose via CRDT_DOWN_RUNS_BATCH)
+CRDT_DOWN_RUNS_BATCH=1024 run --filter downstream --backends jax-patch \
+    --traces automerge-paper --replicas 64 \
+    --samples 3 --save-baseline down_patch_r64_b1024
+CRDT_DOWN_RUNS_BATCH=2048 run --filter downstream --backends jax-patch \
+    --traces automerge-paper --replicas 64 \
+    --samples 3 --save-baseline down_patch_r64_b2048
+
+# 4) replica scaling at the best-known batch
+CRDT_DOWN_RUNS_BATCH=1024 run --filter downstream --backends jax-patch \
+    --traces automerge-paper --replicas 256 \
+    --samples 3 --save-baseline down_patch_r256_b1024
+
+echo DONE_R4_DOWN_EXPERIMENT
