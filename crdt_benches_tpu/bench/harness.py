@@ -115,7 +115,13 @@ def classify_outliers(samples: list[float]) -> dict:
         return {"mild": 0, "severe": 0, "flagged": []}
     s = sorted(samples)
     q1, q3 = _quantile(s, 0.25), _quantile(s, 0.75)
-    iqr = q3 - q1
+    med = _quantile(s, 0.5)
+    # Relative floor on the fence width: with tightly clustered samples
+    # the raw IQR can be <0.1% of the median, and then ordinary timer
+    # jitter lands outside 3*IQR and burns rerun rounds on benign
+    # samples.  2% of the median keeps the Tukey shape while only
+    # flagging deviations that could actually move a reported number.
+    iqr = max(q3 - q1, 0.02 * abs(med))
     lo3, lo15 = q1 - 3.0 * iqr, q1 - 1.5 * iqr
     hi15, hi3 = q3 + 1.5 * iqr, q3 + 3.0 * iqr
     severe = [x for x in samples if x < lo3 or x > hi3]

@@ -17,8 +17,24 @@ import numpy as np
 
 from ..ops.apply2 import PackedState, init_state3
 from ..ops.apply_range import apply_range_batch
-from ..traces.tensorize import RangeTrace
-from .replay import _round_up
+from ..traces.tensorize import INSERT, RangeTrace
+from .replay import _round_up, _stage_capacity
+
+
+def _grow_state3(state: PackedState, new_cap: int) -> PackedState:
+    """Pad a PackedState's capacity axis to new_cap (doc pads with
+    pack_doc(-1, 0) == 2 — the same beyond-length coding apply_range_batch
+    re-stamps every batch)."""
+    R, C = state.doc.shape
+    if new_cap <= C:
+        return state
+    return PackedState(
+        doc=jnp.concatenate(
+            [state.doc, jnp.full((R, new_cap - C), 2, jnp.int32)], axis=1
+        ),
+        length=state.length,
+        nvis=state.nvis,
+    )
 
 
 @partial(
@@ -123,13 +139,34 @@ class RangeReplayEngine:
                 _round_up(int(tc[i : i + self.chunk].max()) + 8, 128)
                 for i in range(0, rt.n_batches, self.chunk)
             ]
+        # Capacity staging (live-prefix), same scheme as the unit v4
+        # engine (engine/replay.py): every apply pass streams the full
+        # (R, C) doc, but the document grows over the replay — early
+        # chunks run at a geometrically-staged capacity covering their
+        # end-of-chunk used length (host-known: n_init + running insert
+        # chars; slot ids are insertion-ordered so they always fit).
+        ins_chars = np.where(kind_b == INSERT, rlen_b, 0).sum(axis=1)
+        end_len = self.n_init + np.cumsum(ins_chars)
+        self.stage_caps: list[int] = []
+        for i in range(0, rt.n_batches, self.chunk):
+            need = int(end_len[min(i + self.chunk, len(end_len)) - 1])
+            self.stage_caps.append(
+                min(self.capacity, _stage_capacity(need, lane))
+            )
+        for i in range(1, len(self.stage_caps)):
+            self.stage_caps[i] = max(
+                self.stage_caps[i], self.stage_caps[i - 1]
+            )
+        if not self.stage_caps:
+            self.stage_caps = [self.capacity]
+
         chars = np.zeros(self.capacity, np.int32)
         chars[: rt.capacity] = rt.chars
         self.chars = jnp.asarray(chars)
 
     def run(self, state: PackedState | None = None) -> PackedState:
         st = (
-            init_state3(self.n_replicas, self.capacity, self.n_init)
+            init_state3(self.n_replicas, self.stage_caps[0], self.n_init)
             if state is None
             else state
         )
@@ -140,9 +177,10 @@ class RangeReplayEngine:
         demands: list[tuple[int, jax.Array]] = []
         from ..ops.resolve_range_pallas import effective_token_list_size
 
-        for tcap, (kind, pos, rlen, slot0) in zip(
-            self.token_caps, self.chunks
+        for cap, tcap, (kind, pos, rlen, slot0) in zip(
+            self.stage_caps, self.token_caps, self.chunks
         ):
+            st = _grow_state3(st, cap)
             st, mx = replay_ranges(
                 st, kind, pos, rlen, slot0,
                 nbits=self.nbits, pack=self.pack, interpret=self.interpret,

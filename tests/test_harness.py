@@ -101,3 +101,14 @@ def test_benchresult_persists_outlier_record():
     assert d["min"] == 24.08 and d["max"] == 24.13
     assert d["outliers"]["severe"] == 0
     assert r.worst == 24.13
+
+
+def test_classify_relative_floor_on_tight_clusters():
+    # Near-zero IQR must not turn sub-percent jitter into 'severe'
+    # (code-review r4): 0.06% above median is benign on a warm cell.
+    c = classify_outliers([24.1201, 24.1214, 24.1216, 24.1219, 24.135])
+    assert c["severe"] == 0
+    # ...but a genuinely large deviation still flags even when the rest
+    # of the cluster is tight.
+    c2 = classify_outliers([24.12, 24.121, 24.122, 24.123, 294.6])
+    assert c2["severe"] == 1

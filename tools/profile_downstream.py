@@ -1,0 +1,221 @@
+"""Piece-wise TPU profile of the run/patch downstream apply step.
+
+Times each component of one merge_runlogs batch step (the jax-patch /
+jax-runs downstream hot path) as K iterations inside one jitted lax.scan
+minus a no-op scan baseline, exactly like tools/profile_hotpath.py (every
+dispatch on this runtime costs ~25ms round trip; sync is by value fetch).
+
+Usage: python tools/profile_downstream.py [R] [W] [trace] [K] [epoch]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize
+from crdt_benches_tpu.engine.merge import MergeSimulation
+from crdt_benches_tpu.engine.merge_range import (
+    BIGKEY,
+    RunMergeSimulation,
+    _run_batch_fragments,
+)
+from crdt_benches_tpu.engine.downstream import down_packed_init
+from crdt_benches_tpu.engine.downstream_range import (
+    _apply_range_update_batch5,
+)
+from crdt_benches_tpu.ops.idpos import query, snap_rebuild
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=5, warmup=2):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    W = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+    EPOCH = int(sys.argv[5]) if len(sys.argv) > 5 else 8
+
+    trace = load_testing_data(trace_name)
+    tt = tensorize(trace, batch=512)
+    sim = MergeSimulation([tt], base=trace.start_content, batch=W)
+    ps = np.zeros(tt.n_ops, bool)
+    u = 0
+    for _pos, d, ins in trace.iter_patches():
+        ps[u] = True
+        u += d + len(ins)
+    rm = RunMergeSimulation(sim, batch=W, epoch=EPOCH, patch_starts=[ps])
+    C = sim.capacity
+    nb = len(rm.lamport) // W
+    print(
+        f"R={R} W={W} C={C} n_runs={rm.n_runs} n_batches={nb}"
+        f" nbits={rm.nbits} epoch={EPOCH} trace={trace_name} K={K}"
+    )
+
+    # mid-stream batch (device arrays)
+    mid = nb // 2
+    sl = slice(mid * W, (mid + 1) * W)
+    lam = jnp.asarray(rm.lamport[sl])
+    ag = jnp.asarray(rm.agent[sl])
+    s0 = jnp.asarray(rm.slot0[sl])
+    rl = jnp.asarray(rm.rlen[sl])
+    orig = jnp.asarray(rm.origin[sl])
+    key = jnp.where(rl > 0, lam * 1024 + ag, BIGKEY)
+
+    # a plausible mid-stream doc state: first half of slots laid out in id
+    # order (positions are only used as gather/shift fodder — cost is
+    # shape-dependent, not value-dependent)
+    st = down_packed_init(R, C, C // 2)
+    snap = st.snap
+    neg1 = jnp.full((W,), -1, jnp.int32)
+
+    def scan_k(body, init):
+        @jax.jit
+        def run(init):
+            return jax.lax.scan(body, init, None, length=K)[0]
+
+        return lambda: run(init)
+
+    base = timeit(scan_k(lambda c, _: (c + 1, None), jnp.zeros((8, 128))))
+    print(f"no-op scan floor:        {base/K*1e3:8.3f} ms/iter")
+
+    # --- fragments (replica-independent W x W forest) ---
+    def frag_body(carry, _):
+        fa, fr, fs, fl = _run_batch_fragments(key, s0, rl, orig + carry * 0)
+        return carry + fa[0] * 0 + fr[-1] * 0 + fs[0] * 0 + fl[0] * 0, None
+
+    t = (timeit(scan_k(frag_body, jnp.int32(0))) - base) / K
+    print(f"_run_batch_fragments:    {t*1e3:8.3f} ms/batch")
+
+    # --- id query at various level depths ---
+    fa, fr, fs, fl = jax.jit(_run_batch_fragments)(key, s0, rl, orig)
+    from crdt_benches_tpu.ops.idpos import make_level_runs
+
+    bc = lambda x: jnp.broadcast_to(x[None], (R,) + x.shape)
+    lvl = jax.jit(make_level_runs)(
+        bc(jnp.abs(fa) % C), bc(fl), bc(jnp.maximum(fs, 0)), bc(fl > 0)
+    )
+    ids = bc(jnp.concatenate([jnp.maximum(fa, 0)] * 3))[:, : 3 * W]
+
+    for L in (0, EPOCH // 2, EPOCH - 1):
+        levels = [lvl] * L
+
+        def q_body(carry, _):
+            p = query(snap, levels, ids + carry[:, :1] * 0)
+            return carry + p[:, :1] * 0, None
+
+        t = (timeit(scan_k(q_body, ids)) - base) / K
+        print(f"query {L:2d} levels (3W):   {t*1e3:8.3f} ms/batch")
+
+    # --- snap_rebuild ---
+    def sr_body(carry, _):
+        s = snap_rebuild(st.doc + carry[:, :1] * 0)
+        return carry + s[:, :1] * 0, None
+
+    t = (timeit(scan_k(sr_body, snap)) - base) / K
+    print(f"snap_rebuild:            {t*1e3:8.3f} ms   (1 per epoch)")
+
+    # --- full batch apply at various level depths ---
+    for L in (0, EPOCH // 2, EPOCH - 1):
+        levels = [lvl] * L
+
+        def ap_body(carry, _):
+            doc, length, nvis = carry
+            doc, length, nvis, _lv = _apply_range_update_batch5(
+                doc, length, nvis, snap, levels,
+                fa, fr, fs, fl, jnp.ones_like(fa),
+                jnp.concatenate([neg1, neg1]),
+                jnp.concatenate([neg1, neg1]),
+                nbits=rm.nbits,
+            )
+            return (doc, length, nvis), None
+
+        t = (
+            timeit(scan_k(ap_body, (st.doc, st.length, st.nvis))) - base
+        ) / K
+        print(f"apply5 {L:2d} levels:       {t*1e3:8.3f} ms/batch")
+
+    # --- spread block alone (the 5 _mxu_spread calls + cumsums) ---
+    from crdt_benches_tpu.ops.apply2 import _mxu_spread
+
+    dest0 = jnp.broadcast_to(
+        (jnp.arange(2 * W, dtype=jnp.int32) * 37) % C, (R, 2 * W)
+    )
+    ones = jnp.ones((R, 2 * W), jnp.int32)
+
+    def sp_body(carry, _):
+        (s1,) = _mxu_spread(dest0 + carry[:, :1] * 0, [ones], C)
+        (s2,) = _mxu_spread(dest0 + 1, [ones], C)
+        ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+        return carry + ind[:, :1] * 0, None
+
+    t = (timeit(scan_k(sp_body, dest0)) - base) / K
+    print(f"2 spreads + cumsum:      {t*1e3:8.3f} ms/batch")
+
+    # --- 8-chunk spread (the fill/delta block's shape) ---
+    chunks = [ones] * 8
+
+    def sp8_body(carry, _):
+        outs = _mxu_spread(dest0 + carry[:, :1] * 0, chunks, C)
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = acc + o
+        return carry + acc[:, :1] * 0, None
+
+    t = (timeit(scan_k(sp8_body, dest0)) - base) / K
+    print(f"8-chunk spread:          {t*1e3:8.3f} ms/batch")
+
+    # --- fused expansion kernel ---
+    from crdt_benches_tpu.ops.expand_pallas import (
+        fused_apply_nocv_dispatch,
+    )
+
+    combo = jnp.zeros((R, C), jnp.int32).at[:, ::357].set(5)
+    cnt_base = jnp.cumsum(
+        jnp.sum(combo.reshape(R, C // 128, 128) & 1, axis=2), axis=1
+    )
+    cnt_base = cnt_base - cnt_base[:, :1]
+
+    def fx_body(carry, _):
+        d = fused_apply_nocv_dispatch(
+            carry, combo, cnt_base, st.length, nbits=rm.nbits
+        )
+        return d, None
+
+    t = (timeit(scan_k(fx_body, st.doc)) - base) / K
+    print(f"fused expand+fill:       {t*1e3:8.3f} ms/batch")
+
+    # --- argsort of the whole wire (once per merge) ---
+    allkey = jnp.asarray(
+        np.where(rm.rlen > 0, rm.lamport * 1024 + rm.agent, 2**31 - 1)
+    )
+
+    def srt_body(carry, _):
+        p = jnp.argsort(allkey + carry[0] * 0)
+        return carry + p[:1] * 0, None
+
+    t = (timeit(scan_k(srt_body, jnp.zeros(8, jnp.int32))) - base) / K
+    print(f"wire argsort (n_runs):   {t*1e3:8.3f} ms   (1 per merge)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+"""Piece-wise TPU profile of the RANGE replay hot path (the headline).
+
+Times resolve_range_pallas and each component of apply_range_batch as K
+iterations inside one jitted lax.scan minus a no-op scan baseline
+(tools/profile_hotpath.py pattern — dispatch costs ~25ms round trip on
+this runtime, sync by value fetch).
+
+Usage: python tools/profile_range.py [R] [B] [trace] [K]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize_ranges
+from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+from crdt_benches_tpu.ops.resolve_range_pallas import resolve_range_pallas
+from crdt_benches_tpu.ops.apply_range import (
+    _two_level_vis,
+    apply_range_batch,
+    extract_range_tokens,
+)
+from crdt_benches_tpu.ops.apply2 import (
+    LANE,
+    _mxu_spread,
+    count_le_two_level,
+    init_state3,
+)
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=5, warmup=2):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+    trace = load_testing_data(trace_name)
+    rt = tensorize_ranges(trace, batch=B)
+    eng = RangeReplayEngine(rt, n_replicas=R)
+    C = eng.capacity
+    nb = rt.n_batches
+    print(
+        f"R={R} B={B} C={C} n_batches={nb} nbits={eng.nbits}"
+        f" trace={trace_name} K={K} token_caps={eng.token_caps}"
+    )
+
+    mid = nb // 2
+    kind_b, pos_b, rlen_b, slot0_b = rt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    rlen = jnp.asarray(rlen_b[mid])
+    slot0 = jnp.asarray(slot0_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+    tcap = eng.token_caps[min(mid // eng.chunk, len(eng.token_caps) - 1)]
+
+    # a half-full doc
+    st = init_state3(R, C, C // 2)
+
+    def scan_k(body, init):
+        @jax.jit
+        def run(init):
+            return jax.lax.scan(body, init, None, length=K)[0]
+
+        return lambda: run(init)
+
+    base = timeit(scan_k(lambda c, _: (c + 1, None), jnp.zeros((8, 128))))
+    print(f"no-op scan floor:       {base/K*1e3:8.3f} ms/iter")
+
+    # --- range resolver kernel ---
+    def res_body(carry, _):
+        tokens, dints, nused = resolve_range_pallas(
+            kind, pos, rlen, carry, token_cap=tcap
+        )
+        return carry + tokens[0][:, :1].reshape(-1) * 0 + nused[:, 0] * 0, None
+
+    t = (timeit(scan_k(res_body, v0)) - base) / K
+    print(f"range resolver:         {t*1e3:8.3f} ms/batch")
+
+    # --- full apply ---
+    tokens, dints, _ = jax.jit(
+        lambda k, p, r, v: resolve_range_pallas(k, p, r, v, token_cap=tcap)
+    )(kind, pos, rlen, v0)
+    tokens = jax.tree.map(jnp.asarray, tokens)
+    dints = jax.tree.map(jnp.asarray, dints)
+
+    def ap_body(stc, _):
+        return apply_range_batch(stc, tokens, dints, slot0, nbits=eng.nbits), None
+
+    t_ap = (timeit(scan_k(ap_body, st)) - base) / K
+    print(f"apply_range_batch:      {t_ap*1e3:8.3f} ms/batch")
+
+    # --- apply pieces ---
+    # 1. two-level vis recompute
+    def tv_body(carry, _):
+        cvt, tb, tm = _two_level_vis(carry, st.length)
+        return carry + tm[:, :1] * 0, None
+
+    t = (timeit(scan_k(tv_body, st.doc)) - base) / K
+    print(f"  _two_level_vis:       {t*1e3:8.3f} ms")
+
+    # 2. the fused count_le query (2B + T queries)
+    cvt, tile_base, tmax_abs = jax.jit(_two_level_vis)(st.doc, st.length)
+    T = tokens[0].shape[1]
+    q = jnp.broadcast_to(
+        (jnp.arange(2 * B + T, dtype=jnp.int32) * 91) % (C // 2), (R, 2 * B + T)
+    )
+
+    def cq_body(carry, _):
+        r = count_le_two_level(cvt, tile_base, tmax_abs, q + carry[:, :1] * 0)
+        return carry + r[:, :1] * 0, None
+
+    t = (timeit(scan_k(cq_body, q)) - base) / K
+    print(f"  count_le (2B+T q):    {t*1e3:8.3f} ms")
+
+    # 3. extract_range_tokens (token-axis passes)
+    def ex_body(carry, _):
+        live, gvis, cumlen = extract_range_tokens(
+            tokens[0], tokens[1], tokens[2], tokens[3] + carry[:, :1] * 0,
+            v0=st.nvis,
+        )
+        return carry + cumlen[:, :1] * 0, None
+
+    t = (timeit(scan_k(ex_body, tokens[3])) - base) / K
+    print(f"  extract_tokens:       {t*1e3:8.3f} ms")
+
+    # 4. interval spreads: 2 x (R, B) + 2 x (R, T) one-hot spreads + cumsums
+    qb = jnp.broadcast_to(
+        (jnp.arange(B, dtype=jnp.int32) * 197) % (C // 2), (R, B)
+    )
+    ones_b = jnp.ones((R, B), jnp.int32)
+
+    def sp_body(carry, _):
+        (s1,) = _mxu_spread(qb + carry[:, :1] * 0, [ones_b], C)
+        (s2,) = _mxu_spread(qb + 3, [ones_b], C)
+        ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+        return carry + ind[:, :1] * 0, None
+
+    t = (timeit(scan_k(sp_body, qb)) - base) / K
+    print(f"  2 B-spreads + cumsum: {t*1e3:8.3f} ms")
+
+    # 5. the 6-chunk delta spread (R, T) + delta cumsum
+    qt = jnp.broadcast_to(
+        (jnp.arange(T, dtype=jnp.int32) * 137) % (C // 2), (R, T)
+    )
+    ones_t = jnp.ones((R, T), jnp.int32)
+
+    def d6_body(carry, _):
+        outs = _mxu_spread(qt + carry[:, :1] * 0, [ones_t] * 6, C)
+        dd = outs[0] + outs[1] - outs[2] + outs[3] - outs[4] + outs[5]
+        dc = jnp.cumsum(dd, axis=1)
+        return carry + dc[:, :1] * 0, None
+
+    t = (timeit(scan_k(d6_body, qt)) - base) / K
+    print(f"  6-chunk T-spread+cum: {t*1e3:8.3f} ms")
+
+    # 6. expansion kernel
+    from crdt_benches_tpu.ops.expand_pallas import expand_packed
+
+    cntind = jnp.cumsum(
+        jnp.zeros((R, C), jnp.int32).at[:, :: max(C // B, 1)].set(2), axis=1
+    ) | jnp.zeros((R, C), jnp.int32).at[:, :: max(C // B, 1)].set(1)
+
+    def xp_body(carry, _):
+        d = expand_packed(carry, cntind, nbits=eng.nbits)
+        return d, None
+
+    t = (timeit(scan_k(xp_body, st.doc)) - base) / K
+    print(f"  expand_packed:        {t*1e3:8.3f} ms (nbits={eng.nbits})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,309 @@
+"""Finer-grain TPU profile of the range-apply pieces (big arrays passed as
+jit ARGS, not closures — closures ship as constants through the remote
+compile tunnel and blow its request limit).
+
+Usage: python tools/profile_range2.py [R] [B] [trace] [K] [coalesce]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize_ranges
+from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+from crdt_benches_tpu.ops.resolve_range_pallas import resolve_range_pallas
+from crdt_benches_tpu.ops.apply_range import (
+    _two_level_vis,
+    apply_range_batch,
+    extract_range_tokens,
+)
+from crdt_benches_tpu.ops.apply2 import (
+    LANE,
+    _excl_cumsum_small,
+    _mxu_spread,
+    count_le_two_level,
+    init_state3,
+)
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def scan_k(body, K):
+    """jit((init, *consts) -> scan(body over K)) with consts as ARGS."""
+
+    @jax.jit
+    def run(init, *consts):
+        def b(c, _):
+            return body(c, *consts), None
+
+        return jax.lax.scan(b, init, None, length=K)[0]
+
+    return run
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    coalesce = (len(sys.argv) > 5 and sys.argv[5] == "1")
+
+    trace = load_testing_data(trace_name)
+    if coalesce:
+        from crdt_benches_tpu.traces.tensorize import coalesce_patches
+
+        rt = tensorize_ranges(
+            trace, batch=B, coalesce=True,
+            patches=list(coalesce_patches(trace)),
+        )
+    else:
+        rt = tensorize_ranges(trace, batch=B)
+    eng = RangeReplayEngine(rt, n_replicas=R)
+    C = eng.capacity
+    nb = rt.n_batches
+    print(
+        f"R={R} B={B} C={C} n_batches={nb} nbits={eng.nbits}"
+        f" coalesce={coalesce} trace={trace_name} K={K}"
+    )
+
+    mid = nb // 2
+    kind_b, pos_b, rlen_b, slot0_b = rt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    rlen = jnp.asarray(rlen_b[mid])
+    slot0 = jnp.asarray(slot0_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+    tcap = eng.token_caps[min(mid // eng.chunk, len(eng.token_caps) - 1)]
+
+    st = init_state3(R, C, C // 2)
+    base = timeit(lambda: scan_k(lambda c: c + 1, K)(jnp.zeros((8, 128))))
+
+    tokens, dints, _ = jax.jit(
+        lambda k, p, r, v: resolve_range_pallas(k, p, r, v, token_cap=tcap)
+    )(kind, pos, rlen, v0)
+    T = tokens[0].shape[1]
+    print(f"no-op floor: {base/K*1e3:.3f} ms/iter   T={T}")
+
+    def report(name, run, *args):
+        t = (timeit(lambda: run(*args)) - base) / K
+        print(f"{name:28s} {t*1e3:9.3f} ms")
+        return t
+
+    # full apply
+    run_ap = scan_k(
+        lambda stc, tok, di, s0: apply_range_batch(
+            stc, tok, di, s0, nbits=eng.nbits
+        ),
+        K,
+    )
+    report("apply_range_batch", run_ap, st, tokens, dints, slot0)
+
+    # _two_level_vis alone (forced via small output)
+    def tv(doc, length):
+        cvt, tb, tm = _two_level_vis(doc, length)
+        return doc, (
+            cvt[:, ::LANE].astype(jnp.int32) + tb + tm
+        )  # force all three
+
+    run_tv = scan_k(lambda c, ln: tv(c[0], ln)[0] if False else c, K)
+
+    @jax.jit
+    def run_tv2(doc, length):
+        def b(c, _):
+            cvt, tb, tm = _two_level_vis(doc, length)
+            return c + tm[:, :1] * 0 + cvt[:, :1].astype(jnp.int32) * 0, None
+
+        return jax.lax.scan(b, jnp.zeros((R, 1), jnp.int32), None, length=K)[0]
+
+    t = (timeit(lambda: run_tv2(st.doc, st.length)) - base) / K
+    print(f"{'_two_level_vis':28s} {t*1e3:9.3f} ms")
+
+    # vis cumsum variants
+    @jax.jit
+    def cs_a(doc):
+        def b(c, _):
+            vis = jnp.bitwise_and(doc, 1)
+            cv = jnp.cumsum(vis.reshape(R, C // LANE, LANE), axis=2)
+            return c + cv[:, :1, LANE - 1] * 0, None
+
+        return jax.lax.scan(b, jnp.zeros((R, 1), jnp.int32), None, length=K)[0]
+
+    t = (timeit(lambda: cs_a(st.doc)) - base) / K
+    print(f"{'  tile cumsum axis=2':28s} {t*1e3:9.3f} ms")
+
+    @jax.jit
+    def cs_b(doc):
+        def b(c, _):
+            vis = jnp.bitwise_and(doc, 1)
+            cv = jnp.cumsum(vis, axis=1)
+            return c + cv[:, :1] * 0, None
+
+        return jax.lax.scan(b, jnp.zeros((R, 1), jnp.int32), None, length=K)[0]
+
+    t = (timeit(lambda: cs_b(st.doc)) - base) / K
+    print(f"{'  full cumsum axis=1':28s} {t*1e3:9.3f} ms")
+
+    # count_le pieces at Q = 2B + T
+    cvt, tile_base, tmax_abs = jax.jit(_two_level_vis)(st.doc, st.length)
+    Q = 2 * B + T
+    q = jnp.asarray(
+        np.broadcast_to(
+            (np.arange(Q, dtype=np.int32) * 91) % (C // 2), (R, Q)
+        ).copy()
+    )
+
+    @jax.jit
+    def cl_full(cvt, tile_base, tmax_abs, q):
+        def b(c, _):
+            r = count_le_two_level(cvt, tile_base, tmax_abs, q + c[:, :1] * 0)
+            return c + r[:, :1] * 0, None
+
+        return jax.lax.scan(b, q, None, length=K)[0]
+
+    t = (timeit(lambda: cl_full(cvt, tile_base, tmax_abs, q)) - base) / K
+    print(f"{'count_le_two_level':28s} {t*1e3:9.3f} ms")
+
+    nt = C // LANE
+
+    @jax.jit
+    def cl_nfull(tmax_abs, q):
+        def b(c, _):
+            nfull = jnp.sum(
+                (tmax_abs[:, None, :] <= q[:, :, None]).astype(jnp.int32),
+                axis=2,
+            )
+            return c + nfull[:, :1] * 0, None
+
+        return jax.lax.scan(b, q, None, length=K)[0]
+
+    t = (timeit(lambda: cl_nfull(tmax_abs, q)) - base) / K
+    print(f"{'  nfull compare-reduce':28s} {t*1e3:9.3f} ms")
+
+    @jax.jit
+    def cl_rows(cvt, q):
+        tiles = cvt.reshape(R, nt, LANE)
+
+        def b(c, _):
+            tq = (q + c[:, :1] * 0) % nt
+            oh = (
+                jax.lax.broadcasted_iota(jnp.int32, (R, Q, nt), 2)
+                == tq[:, :, None]
+            ).astype(jnp.bfloat16)
+            rows = jnp.einsum(
+                "rbt,rtl->rbl", oh, tiles,
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            return c + rows[:, :1, 0] * 0, None
+
+        return jax.lax.scan(b, q, None, length=K)[0]
+
+    t = (timeit(lambda: cl_rows(cvt, q)) - base) / K
+    print(f"{'  rows one-hot einsum':28s} {t*1e3:9.3f} ms")
+
+    # extract tokens
+    @jax.jit
+    def ext(ttype, ta, tch, tlen, nvis):
+        def b(c, _):
+            live, gvis, cumlen = extract_range_tokens(
+                ttype, ta, tch, tlen + c[:, :1] * 0, v0=nvis
+            )
+            return c + cumlen[:, :1] * 0 + gvis[:, :1] * 0, None
+
+        return jax.lax.scan(b, tlen, None, length=K)[0]
+
+    t = (timeit(lambda: ext(*tokens, st.nvis)) - base) / K
+    print(f"{'extract_range_tokens':28s} {t*1e3:9.3f} ms")
+
+    # spreads
+    qb = jnp.asarray(
+        np.broadcast_to(
+            (np.arange(B, dtype=np.int32) * 197) % (C // 2), (R, B)
+        ).copy()
+    )
+
+    @jax.jit
+    def sp2(qb):
+        ones_b = jnp.ones((R, B), jnp.int32)
+
+        def b(c, _):
+            (s1,) = _mxu_spread(qb + c[:, :1] * 0, [ones_b], C)
+            (s2,) = _mxu_spread(qb + 3, [ones_b], C)
+            ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+            return c + ind[:, :1] * 0, None
+
+        return jax.lax.scan(b, qb, None, length=K)[0]
+
+    t = (timeit(lambda: sp2(qb)) - base) / K
+    print(f"{'2 B-spreads + C-cumsum':28s} {t*1e3:9.3f} ms")
+
+    qt = jnp.asarray(
+        np.broadcast_to(
+            (np.arange(T, dtype=np.int32) * 137) % (C // 2), (R, T)
+        ).copy()
+    )
+
+    @jax.jit
+    def d6(qt):
+        ones_t = jnp.ones((R, T), jnp.int32)
+
+        def b(c, _):
+            outs = _mxu_spread(qt + c[:, :1] * 0, [ones_t] * 6, C)
+            dd = outs[0] + outs[1] - outs[2] + outs[3] - outs[4] + outs[5]
+            dc = jnp.cumsum(dd, axis=1)
+            return c + dc[:, :1] * 0, None
+
+        return jax.lax.scan(b, qt, None, length=K)[0]
+
+    t = (timeit(lambda: d6(qt)) - base) / K
+    print(f"{'6-chunk T-spread + cumsum':28s} {t*1e3:9.3f} ms")
+
+    # expand kernel
+    from crdt_benches_tpu.ops.expand_pallas import expand_packed
+
+    cntind = jnp.asarray(
+        np.cumsum(
+            np.tile(
+                (np.arange(C) % max(C // B, 1) == 0).astype(np.int32) * 2,
+                (R, 1),
+            ),
+            axis=1,
+        )
+        | np.tile(
+            (np.arange(C) % max(C // B, 1) == 0).astype(np.int32), (R, 1)
+        )
+    )
+
+    @jax.jit
+    def xp(doc, cntind):
+        def b(c, _):
+            d = expand_packed(c, cntind, nbits=eng.nbits)
+            return d, None
+
+        return jax.lax.scan(b, doc, None, length=K)[0]
+
+    t = (timeit(lambda: xp(st.doc, cntind)) - base) / K
+    print(f"{'expand_packed':28s} {t*1e3:9.3f} ms (nbits={eng.nbits})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,260 @@
+"""Truncated-pipeline TPU profile of apply_range_batch: stage k runs the
+real apply dataflow up to stage k (everything downstream of the scan-carried
+doc, so XLA cannot hoist), returns the carry doc plus a tiny dependence on
+the stage output.  Successive deltas = per-stage cost.
+
+Usage: python tools/profile_range3.py [R] [B] [trace] [K] [coalesce]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize_ranges
+from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+from crdt_benches_tpu.ops.resolve_range_pallas import resolve_range_pallas
+from crdt_benches_tpu.ops.apply_range import (
+    _BIG,
+    _prev_value,
+    _two_level_vis,
+    extract_range_tokens,
+)
+from crdt_benches_tpu.ops.apply2 import (
+    LANE,
+    _mxu_spread,
+    count_le_two_level,
+    init_state3,
+)
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def staged_apply(state_doc, length, nvis, tokens, dints, slot0_b,
+                 nbits: int, stage: int):
+    """apply_range_batch truncated after `stage`.  Returns (R, 1) int32
+    depending on everything computed so far."""
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    R, C = state_doc.shape
+    T = ttype.shape[1]
+    B = dlo.shape[1]
+    drop = jnp.int32(C + 7)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+
+    vis_bit = jnp.bitwise_and(state_doc, 1)
+    cvt, tile_base, tmax_abs = _two_level_vis(state_doc, length)
+    if stage == 0:
+        return (
+            jnp.sum(tile_base, axis=1, keepdims=True)
+            + jnp.sum(cvt.astype(jnp.int32), axis=1, keepdims=True)
+        )
+
+    has_del = dlo >= 0
+    live, gvis, cumlen = extract_range_tokens(ttype, ta, tch, tlen, v0=nvis)
+    if stage == 1:
+        return (
+            jnp.sum(gvis + cumlen, axis=1, keepdims=True)
+            + jnp.sum(cvt.astype(jnp.int32), axis=1, keepdims=True)
+        )
+
+    allq = count_le_two_level(
+        cvt, tile_base, tmax_abs,
+        jnp.concatenate(
+            [
+                jnp.where(has_del, dlo, 0),
+                jnp.where(has_del, dhi, 0),
+                jnp.where(live, gvis, 0),
+            ],
+            axis=1,
+        ),
+    )
+    lo_phys = allq[:, :B]
+    hi_phys = allq[:, B : 2 * B]
+    gq_phys = allq[:, 2 * B :]
+    if stage == 2:
+        return jnp.sum(allq, axis=1, keepdims=True)
+
+    starts, = _mxu_spread(
+        jnp.where(has_del, lo_phys, drop), [has_del.astype(jnp.int32)], C
+    )
+    stops, = _mxu_spread(
+        jnp.where(has_del, hi_phys + 1, drop), [has_del.astype(jnp.int32)], C
+    )
+    in_del = jnp.cumsum(starts - stops, axis=1) > 0
+    doc = state_doc - (vis_bit & in_del.astype(jnp.int32))
+    if stage == 3:
+        return (
+            jnp.sum(doc, axis=1, keepdims=True)
+            + jnp.sum(allq, axis=1, keepdims=True)
+        )
+
+    at_end = gvis >= nvis[:, None]
+    g_phys = jnp.where(at_end, length[:, None], gq_phys)
+    dest0 = jnp.where(live, g_phys + cumlen, drop)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+    s1, = _mxu_spread(dest0, [live.astype(jnp.int32)], C)
+    s2, = _mxu_spread(dstop, [live.astype(jnp.int32)], C)
+    ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+    cnt = jnp.cumsum(ind, axis=1)
+    if stage == 4:
+        return (
+            jnp.sum(doc, axis=1, keepdims=True) + cnt[:, -1:]
+            + jnp.sum(ind, axis=1, keepdims=True)
+        )
+
+    slot0_t = jnp.where(
+        live,
+        jnp.take(
+            jnp.concatenate([slot0_b, jnp.zeros((1,), jnp.int32)]),
+            jnp.clip(ta, 0, slot0_b.shape[0]),
+        ),
+        0,
+    )
+    delta = jnp.where(live, slot0_t + tch - dest0, 0)
+    prev_live_delta = _prev_value(delta, live)
+    ddelta = jnp.where(live, delta - prev_live_delta, 0)
+    dpos_ = jnp.where(live, dest0, drop)
+    pos_chunks = [
+        jnp.bitwise_and(v, 127)
+        for v in (
+            jnp.where(ddelta > 0, ddelta, 0),
+            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 7),
+            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 14),
+            jnp.where(ddelta < 0, -ddelta, 0),
+            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 7),
+            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 14),
+        )
+    ]
+    p0, p1, p2, n0, n1, n2 = _mxu_spread(dpos_, pos_chunks, C)
+    dd_dense = (
+        p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
+        - n0 - jnp.left_shift(n1, 7) - jnp.left_shift(n2, 14)
+    )
+    delta_cum = jnp.cumsum(dd_dense, axis=1)
+    fill_slot = col + delta_cum
+    fill_dense = jnp.where(ind > 0, jnp.left_shift(fill_slot + 2, 1) | 1, 0)
+    if stage == 5:
+        return (
+            jnp.sum(doc, axis=1, keepdims=True) + cnt[:, -1:]
+            + jnp.sum(fill_dense, axis=1, keepdims=True)
+        )
+
+    cntind = jnp.left_shift(cnt, 1) | ind
+    from crdt_benches_tpu.ops.expand_pallas import expand_packed
+
+    doc = expand_packed(doc, cntind, nbits=nbits)
+    doc = doc + fill_dense
+    n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
+    length2 = length + n_ins
+    beyond = col >= length2[:, None]
+    doc = jnp.where(beyond, jnp.int32(2), doc)
+    return jnp.sum(doc, axis=1, keepdims=True) + length2[:, None]
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+    coalesce = (len(sys.argv) > 5 and sys.argv[5] == "1")
+
+    trace = load_testing_data(trace_name)
+    if coalesce:
+        from crdt_benches_tpu.traces.tensorize import coalesce_patches
+
+        rt = tensorize_ranges(
+            trace, batch=B, coalesce=True,
+            patches=list(coalesce_patches(trace)),
+        )
+    else:
+        rt = tensorize_ranges(trace, batch=B)
+    eng = RangeReplayEngine(rt, n_replicas=R)
+    C = eng.capacity
+    nb = rt.n_batches
+    print(
+        f"R={R} B={B} C={C} n_batches={nb} nbits={eng.nbits}"
+        f" coalesce={coalesce} trace={trace_name} K={K}"
+    )
+
+    mid = nb // 2
+    kind_b, pos_b, rlen_b, slot0_b = rt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    rlen = jnp.asarray(rlen_b[mid])
+    slot0 = jnp.asarray(slot0_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+    tcap = eng.token_caps[min(mid // eng.chunk, len(eng.token_caps) - 1)]
+
+    st = init_state3(R, C, C // 2)
+    tokens, dints, _ = jax.jit(
+        lambda k, p, r, v: resolve_range_pallas(k, p, r, v, token_cap=tcap)
+    )(kind, pos, rlen, v0)
+    T = tokens[0].shape[1]
+
+    @jax.jit
+    def nop(doc):
+        def b(c, _):
+            return c + 1, None
+
+        return jax.lax.scan(b, doc[:, :1], None, length=K)[0]
+
+    base = timeit(lambda: nop(st.doc))
+    print(f"floor: {base/K*1e3:.3f} ms/iter")
+
+    def make(stage):
+        @jax.jit
+        def run(doc, length, nvis, tokens, dints, slot0):
+            def b(c, _):
+                # value-opaque zero: XLA cannot fold it, so the body stays
+                # inside the scan and re-runs every iteration
+                z = jnp.where(c == jnp.int32(-123456789), 1, 0)
+                out = staged_apply(
+                    doc + z, length, nvis, tokens, dints, slot0,
+                    eng.nbits, stage,
+                )
+                return jnp.minimum(c, out), None
+
+            return jax.lax.scan(
+                b, doc[:, :1], None, length=K
+            )[0]
+
+        return lambda: run(st.doc, st.length, st.nvis, tokens, dints, slot0)
+
+    names = [
+        "0 two_level_vis",
+        "1 + extract_tokens",
+        "2 + count_le queries",
+        "3 + del spreads+cumsum",
+        "4 + dest spreads+cnt",
+        "5 + delta spread+fill",
+        "6 + expand (full)",
+    ]
+    prev = 0.0
+    for stage, name in enumerate(names):
+        t = (timeit(make(stage)) - base) / K
+        print(f"{name:28s} {t*1e3:9.3f} ms  (+{(t-prev)*1e3:8.3f})")
+        prev = t
+
+
+if __name__ == "__main__":
+    main()
