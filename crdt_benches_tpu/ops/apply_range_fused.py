@@ -1,0 +1,401 @@
+"""Fully-fused RANGE batch application: one Pallas kernel per batch for
+every capacity-wide pass.
+
+Profiling the XLA range apply (tools/profile_range3.py, R=1024, C=182k)
+put it at ~131 ms/batch against a ~3 ms HBM floor: every stage — the
+per-batch visibility cumsum, the one-hot spreads, four capacity-sized
+cumsums, the fill pass — round-trips (R, C) intermediates through HBM,
+and the spread one-hots materialize at (R, B, C/128) bf16.  This module
+keeps the XLA side to SMALL arrays only (token extraction, two-level
+rank queries, two merged one-hot spread calls with signed +-1 values)
+and runs all capacity-wide work inside one kernel with the arrays VMEM
+-resident:
+
+- **Triangular-matmul prefix sums**: an inclusive 128-lane cumsum is one
+  f32 dot with a (LANE, LANE) upper-triangular ones matrix — the MXU
+  replaces ~21 VPU shift passes per cumsum.  f32 operands/accumulation
+  are exact here because every running value is bounded by 2^24: delete
+  -interval nesting depth <= B, insert-run indicator <= 1, hole count
+  <= C < 2^21, and the painted slot-delta prefix telescopes to the
+  per-run delta itself (|delta| <= 2C < 2^21) — the same bound the
+  3x7-bit chunk encoding of the unfused path guarded.
+- Cross-tile bases by an in-kernel log-shift scan over the (nt, 1) tile
+  totals (12 vregs — negligible).
+- The log-shift expansion, hole fill (slot = position + delta prefix),
+  beyond-length stamping, and the NEXT batch's visibility prefix
+  structure (cv_intile bf16 + vis_tile) all emit from the same kernel,
+  so the engine state is the maintained PackedState4 — no per-batch
+  capacity cumsum anywhere in XLA.
+
+Semantics are identical to ops/apply_range.py apply_range_batch
+(differentially tested); this is the reference CRDTs' update application
+(reference src/main.rs:30-34 hot loop over its range tree) restated in
+MXU/VPU-native primitives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .apply2 import (
+    LANE,
+    PackedState4,
+    _excl_cumsum_small,
+    _mxu_spread,
+    count_le_two_level,
+)
+from .apply_range import _prev_value, extract_range_tokens
+from .expand_pallas import _flat_roll, _roll_ax
+
+#: Mosaic scoped-stack bytes per doc position per replica for
+#: _range_fused_kernel (measured: compiles at C=522k under the 100MB
+#: budget; ~8 live (nt, LANE) f32/i32 arrays plus roll temps).
+RANGE_FUSED_BYTES_PER_POS = 150
+
+
+def range_fused_fits(capacity: int) -> bool:
+    """The ONE VMEM-stack gate for the fused range kernel — callers
+    (engine selection, the batch dispatcher, range_fused itself) must all
+    use this so a capacity near the edge cannot pass one copy of the
+    check and fail another (code-review r4)."""
+    return RANGE_FUSED_BYTES_PER_POS * capacity <= 96 * 2**20
+
+
+def _tile_scan_excl(tot):
+    """Exclusive prefix scan along the tile axis of (Rt, nt, 1) int32 —
+    log-shift over the sublane dimension (tiny: nt/8 vregs)."""
+    Rt, nt, _ = tot.shape
+    inc = tot
+    s = 1
+    while s < nt:
+        sh = _roll_ax(inc, s, 1)
+        tile = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, 1), 1)
+        inc = inc + jnp.where(tile >= s, sh, 0)
+        s *= 2
+    return inc - tot
+
+
+def _tile_cumsum(x_i32, tri):
+    """Within-tile inclusive lane cumsum of (Rt, nt, LANE) int32 via one
+    triangular f32 matmul.  Exact while every within-tile running value
+    stays below 2^24 (callers' bounds in the module docstring)."""
+    Rt, nt, _ = x_i32.shape
+    xf = x_i32.astype(jnp.float32)
+    return jax.lax.dot_general(
+        xf.reshape(Rt * nt, LANE), tri,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(Rt, nt, LANE).astype(jnp.int32)
+
+
+def _flat_cumsum_f32(x_i32, tri):
+    """Inclusive flat cumsum: within-tile triangular matmul + cross-tile
+    sublane scan of the tile totals."""
+    y = _tile_cumsum(x_i32, tri)
+    return y + _tile_scan_excl(y[:, :, LANE - 1 :])
+
+
+def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, ddp_ref, ddn_ref,
+                        newlen_ref, doc_out, cv_ref, vistot_ref,
+                        *, nt: int, nbits: int, Rt: int):
+    """One-batch range application with all capacity-wide work in VMEM.
+
+    Inputs (per grid step, (Rt, nt, LANE) int32 unless noted):
+    - doc: packed pre-batch doc ((slot+2)<<1 | vis)
+    - delpk: packed delete-interval boundary counts — starts in bits
+      0..13, one-past-end stops in bits 14..27 (several ops' intervals
+      may share a boundary, so per-cell counts reach B and get the same
+      chunked treatment as ddp/ddn below)
+    - ind: insert-run boundary deltas (+1 at dest0, -1 at dstop)
+    - ddp/ddn: positive/negative slot-delta differences painted at run
+      starts (prefix of ddp - ddn = the containing run's
+      slot0 + tch - dest0).  Each element < 2^21, so the kernel re-chunks
+      them to 3x7 bits before the triangular matmuls: the MXU truncates
+      dot operands to bf16 and accumulates in tree order, which is only
+      exact when every term (and hence any partial sum up to 128 terms)
+      stays small — the same bound the unfused path's chunked spread
+      relied on.
+    - newlen (Rt, 1, 1): post-batch used length
+    Outputs: new doc, cv_intile (bf16), vis_tile — the maintained
+    visibility prefix structure for the next batch's rank queries.
+    """
+    lane = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 2)
+    col = (
+        jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 1) * LANE + lane
+    )
+    li = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
+    tri = (li <= lj).astype(jnp.float32)
+
+    # ---- deletes: nesting depth > 0 -> clear visible bit ----
+    delpk = delpk_ref[:]
+    depth_w = jnp.zeros((Rt, nt, LANE), jnp.int32)
+    for lo_bit, sign in ((0, 1), (14, -1)):
+        v = jnp.bitwise_and(jnp.right_shift(delpk, lo_bit), (1 << 14) - 1)
+        for k in range(2):
+            chunk = jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
+            depth_w = depth_w + sign * jnp.left_shift(
+                _tile_cumsum(chunk, tri), 7 * k
+            )
+    depth = depth_w + _tile_scan_excl(depth_w[:, :, LANE - 1 :])
+    doc = doc_ref[:]
+    vis = jnp.bitwise_and(doc, 1)
+    doc = doc - (vis & (depth > 0).astype(jnp.int32))
+
+    # ---- insert destinations: run indicator and expansion shift map ----
+    run_ind = (
+        _flat_cumsum_f32(ind_ref[:], tri) > 0
+    ).astype(jnp.int32)
+    cnt = _flat_cumsum_f32(run_ind, tri)
+
+    # ---- expansion y[d] = x[d - cnt[d]] (cnt monotone, 1-Lipschitz) ----
+    maxcnt = jnp.max(cnt[:, :, LANE - 1 :])
+    doc_out[:] = doc
+    for b in reversed(range(nbits)):
+        step = 1 << b
+
+        @pl.when(maxcnt >= step)
+        def _():
+            d = doc_out[:]
+            take = (jnp.bitwise_and(cnt, step) != 0) & (col >= step)
+            doc_out[:] = jnp.where(take, _flat_roll(d, step), d)
+
+    # ---- fill: slot(d) = d + delta(run of d), vis = 1 ----
+    # 7-bit-chunked within-tile cumsums (exact under bf16 MXU operands),
+    # one shared cross-tile scan on the recombined tile totals.
+    dcum_w = jnp.zeros((Rt, nt, LANE), jnp.int32)
+    for ref, sign in ((ddp_ref, 1), (ddn_ref, -1)):
+        v = ref[:]
+        for k in range(3):
+            chunk = jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
+            dcum_w = dcum_w + sign * jnp.left_shift(
+                _tile_cumsum(chunk, tri), 7 * k
+            )
+    dcum = dcum_w + _tile_scan_excl(dcum_w[:, :, LANE - 1 :])
+    fill = jnp.left_shift(col + dcum + 2, 1) | 1
+    doc_out[:] = jnp.where(run_ind != 0, fill, doc_out[:])
+    doc_out[:] = jnp.where(col >= newlen_ref[:], 2, doc_out[:])
+
+    # ---- next batch's visibility prefix structure ----
+    cv_in = _tile_cumsum(jnp.bitwise_and(doc_out[:], 1), tri)
+    cv_ref[:] = cv_in.astype(jnp.bfloat16)
+    vistot_ref[:] = cv_in[:, :, LANE - 1 :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nbits", "replica_tile", "interpret")
+)
+def range_fused(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int,
+                replica_tile: int = 0, interpret: bool = False):
+    """Run the fused range kernel.  All dense args int32[R, C] (C a
+    multiple of 128); new_len int32[R].  Returns (doc', cv_intile bf16,
+    vis_tile)."""
+    R, C = doc.shape
+    nt = C // LANE
+    if not (interpret or range_fused_fits(C)):
+        # interpret mode ignores VMEM budgets, so only the real Mosaic
+        # path enforces the gate.
+        raise NotImplementedError(
+            "range_fused: capacity beyond the VMEM gate; use the XLA path"
+        )
+    Rt = replica_tile
+    if Rt <= 0:
+        Rt = max(1, (96 * 2**20) // (RANGE_FUSED_BYTES_PER_POS * C))
+    Rt = min(Rt, R)
+    while R % Rt:
+        Rt -= 1
+    big = pl.BlockSpec(
+        (Rt, nt, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    small = pl.BlockSpec(
+        (Rt, nt, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    one = pl.BlockSpec(
+        (Rt, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _range_fused_kernel, nt=nt, nbits=nbits, Rt=Rt
+    )
+    r3 = lambda x: x.reshape(R, nt, LANE)
+    doc_o, cv, vt = pl.pallas_call(
+        kernel,
+        grid=(R // Rt,),
+        in_specs=[big, big, big, big, big, one],
+        out_specs=[big, big, small],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((R, nt, LANE), jnp.bfloat16),
+            jax.ShapeDtypeStruct((R, nt, 1), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2**20
+        ),
+        interpret=interpret,
+    )(
+        r3(doc), r3(delpk), r3(ind_d), r3(ddp), r3(ddn),
+        new_len.reshape(R, 1, 1).astype(jnp.int32),
+    )
+    return doc_o.reshape(R, C), cv.reshape(R, C), vt.reshape(R, nt)
+
+
+def range_fused_xla(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int):
+    """XLA fallback with identical semantics (CPU tests, oversized
+    capacities)."""
+    R, C = doc.shape
+    nt = C // LANE
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    deld = jnp.bitwise_and(delpk, (1 << 14) - 1) - jnp.right_shift(
+        delpk, 14
+    )
+    dd = ddp - ddn
+    depth = jnp.cumsum(deld, axis=1)
+    vis = jnp.bitwise_and(doc, 1)
+    doc = doc - (vis & (depth > 0).astype(jnp.int32))
+    run_ind = (jnp.cumsum(ind_d, axis=1) > 0).astype(jnp.int32)
+    cnt = jnp.cumsum(run_ind, axis=1)
+    out = doc
+    for b in reversed(range(nbits)):
+        step = 1 << b
+        take = (jnp.bitwise_and(cnt, step) != 0) & (col >= step)
+        out = jnp.where(take, jnp.roll(out, step, axis=1), out)
+    dcum = jnp.cumsum(dd, axis=1)
+    fill = jnp.left_shift(col + dcum + 2, 1) | 1
+    out = jnp.where(run_ind != 0, fill, out)
+    out = jnp.where(col >= new_len[:, None], 2, out)
+    cv = jnp.cumsum(
+        jnp.bitwise_and(out, 1).reshape(R, nt, LANE), axis=2
+    )
+    return (
+        out,
+        cv.reshape(R, C).astype(jnp.bfloat16),
+        cv[:, :, LANE - 1],
+    )
+
+
+def apply_range_batch4(
+    state: PackedState4,
+    tokens,  # (ttype, ta, tch, tlen) int32[R, T]
+    dints,  # (dlo, dhi, dcount) int32[R, B]
+    slot0_b: jax.Array,  # int32[B]
+    nbits: int,
+    interpret: bool = False,
+) -> PackedState4:
+    """apply_range_batch on the maintained-cv state with the fused
+    kernel: XLA touches only B/T-sized arrays plus two merged one-hot
+    spread calls; every capacity-wide pass runs in range_fused."""
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    R, C = state.doc.shape
+    B = dlo.shape[1]
+    drop = jnp.int32(C + 7)
+
+    tile_base = _excl_cumsum_small(state.vis_tile)
+    tmax_abs = tile_base + state.vis_tile
+
+    has_del = dlo >= 0
+    live, gvis, cumlen = extract_range_tokens(
+        ttype, ta, tch, tlen, v0=state.nvis
+    )
+    allq = count_le_two_level(
+        state.cv_intile, tile_base, tmax_abs,
+        jnp.concatenate(
+            [
+                jnp.where(has_del, dlo, 0),
+                jnp.where(has_del, dhi, 0),
+                jnp.where(live, gvis, 0),
+            ],
+            axis=1,
+        ),
+    )
+    lo_phys = allq[:, :B]
+    hi_phys = allq[:, B : 2 * B]
+    gq_phys = allq[:, 2 * B :]
+
+    at_end = gvis >= state.nvis[:, None]
+    g_phys = jnp.where(at_end, state.length[:, None], gq_phys)
+    dest0 = jnp.where(live, g_phys + cumlen, drop)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+
+    # ---- merged spreads: signed +-1 boundary deltas (collisions sum
+    # exactly — the einsum accumulates in f32 and every product is a
+    # bf16-exact small int; a +1 meeting a -1 is precisely the delta a
+    # prefix-sum consumer wants) ----
+    idxA = jnp.concatenate(
+        [jnp.where(has_del, lo_phys, drop),
+         jnp.where(has_del, hi_phys + 1, drop)], axis=1
+    )
+    pm = has_del.astype(jnp.int32)
+    zb = jnp.zeros_like(pm)
+    deldp, deldn = _mxu_spread(
+        idxA,
+        [jnp.concatenate([pm, zb], axis=1),
+         jnp.concatenate([zb, pm], axis=1)],
+        C,
+    )
+    delpk = deldp | jnp.left_shift(deldn, 14)
+
+    # delta(run) = slot0[ta] + tch - dest0, painted as differences at
+    # run starts (token order == dest order: gaps and cumlen are both
+    # monotone along the token axis)
+    slot0_t = jnp.where(
+        live,
+        jnp.take(
+            jnp.concatenate([slot0_b, jnp.zeros((1,), jnp.int32)]),
+            jnp.clip(ta, 0, slot0_b.shape[0]),
+        ),
+        0,
+    )
+    delta = jnp.where(live, slot0_t + tch - dest0, 0)
+    ddelta = jnp.where(live, delta - _prev_value(delta, live), 0)
+    lv = live.astype(jnp.int32)
+    zeros_t = jnp.zeros_like(lv)
+    idxB = jnp.concatenate([dest0, dstop], axis=1)
+    dp = jnp.where(ddelta > 0, ddelta, 0)
+    dn = jnp.where(ddelta < 0, -ddelta, 0)
+    half = lambda x: jnp.concatenate([x, zeros_t], axis=1)
+    # |ddelta| < 2C < 2^21 travels as 3x7-bit chunks (bf16-exact spread
+    # products, f32-exact accumulation) exactly like the unfused path.
+    ind_d, p0, p1, p2, n0, n1, n2 = _mxu_spread(
+        idxB,
+        [
+            jnp.concatenate([lv, -lv], axis=1),
+            half(jnp.bitwise_and(dp, 127)),
+            half(jnp.bitwise_and(jnp.right_shift(dp, 7), 127)),
+            half(jnp.bitwise_and(jnp.right_shift(dp, 14), 127)),
+            half(jnp.bitwise_and(dn, 127)),
+            half(jnp.bitwise_and(jnp.right_shift(dn, 7), 127)),
+            half(jnp.bitwise_and(jnp.right_shift(dn, 14), 127)),
+        ],
+        C,
+    )
+    ddp_d = p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
+    ddn_d = n0 + jnp.left_shift(n1, 7) + jnp.left_shift(n2, 14)
+
+    n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
+    n_del = jnp.sum(jnp.where(has_del, dcount, 0), axis=1)
+    length2 = state.length + n_ins
+
+    use_pallas = interpret or (
+        jax.default_backend() == "tpu" and range_fused_fits(C)
+    )
+    fn = (
+        functools.partial(range_fused, interpret=interpret)
+        if use_pallas
+        else range_fused_xla
+    )
+    doc, cv, vt = fn(
+        state.doc, delpk, ind_d, ddp_d, ddn_d, length2, nbits=nbits
+    )
+    return PackedState4(
+        doc=doc,
+        cv_intile=cv,
+        vis_tile=vt,
+        length=length2,
+        nvis=state.nvis + n_ins - n_del,
+    )

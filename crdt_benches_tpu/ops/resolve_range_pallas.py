@@ -295,6 +295,13 @@ def resolve_range_pallas(
         out_shape=[jax.ShapeDtypeStruct((R, B), jnp.int32)] * 3
         + [jax.ShapeDtypeStruct((R, T), jnp.int32)] * 4
         + [jax.ShapeDtypeStruct((R, 1), jnp.int32)],
+        # Mosaic's conservative stack accounting rejects Rt=128 under
+        # the default 16MB scoped budget even though live state is a
+        # fraction of it; v5e has 128MB of physical VMEM (the same
+        # raise expand_pallas.apply_fused uses).
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2**20
+        ),
         interpret=interpret,
     )(
         kind.reshape(1, B).astype(jnp.int32),

@@ -1,0 +1,214 @@
+"""Truncated-pipeline profile of apply_range_batch4 (the fused v4 path):
+stage deltas isolate queries / spread A / spread B / kernel.
+
+Usage: python tools/profile_range4.py [R] [B] [trace] [K] [coalesce]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data
+from crdt_benches_tpu.traces.tensorize import tensorize_ranges
+from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+from crdt_benches_tpu.ops.resolve_range_pallas import resolve_range_pallas
+from crdt_benches_tpu.ops.apply_range import _prev_value, extract_range_tokens
+from crdt_benches_tpu.ops.apply2 import (
+    LANE,
+    _excl_cumsum_small,
+    _mxu_spread,
+    count_le_two_level,
+    init_state4,
+)
+from crdt_benches_tpu.ops.apply_range_fused import range_fused
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def staged(state, tokens, dints, slot0_b, nbits, stage):
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    R, C = state.doc.shape
+    B = dlo.shape[1]
+    drop = jnp.int32(C + 7)
+
+    tile_base = _excl_cumsum_small(state.vis_tile)
+    tmax_abs = tile_base + state.vis_tile
+    has_del = dlo >= 0
+    live, gvis, cumlen = extract_range_tokens(ttype, ta, tch, tlen,
+                                              v0=state.nvis)
+    allq = count_le_two_level(
+        state.cv_intile, tile_base, tmax_abs,
+        jnp.concatenate(
+            [jnp.where(has_del, dlo, 0), jnp.where(has_del, dhi, 0),
+             jnp.where(live, gvis, 0)], axis=1,
+        ),
+    )
+    lo_phys = allq[:, :B]
+    hi_phys = allq[:, B : 2 * B]
+    gq_phys = allq[:, 2 * B :]
+    if stage == 0:
+        return jnp.sum(allq, axis=1, keepdims=True)
+
+    at_end = gvis >= state.nvis[:, None]
+    g_phys = jnp.where(at_end, state.length[:, None], gq_phys)
+    dest0 = jnp.where(live, g_phys + cumlen, drop)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+
+    idxA = jnp.concatenate(
+        [jnp.where(has_del, lo_phys, drop),
+         jnp.where(has_del, hi_phys + 1, drop)], axis=1
+    )
+    pm = has_del.astype(jnp.int32)
+    zb = jnp.zeros_like(pm)
+    deldp, deldn = _mxu_spread(
+        idxA,
+        [jnp.concatenate([pm, zb], axis=1),
+         jnp.concatenate([zb, pm], axis=1)], C,
+    )
+    delpk = deldp | jnp.left_shift(deldn, 14)
+    if stage == 1:
+        return jnp.sum(delpk, axis=1, keepdims=True)
+
+    slot0_t = jnp.where(
+        live,
+        jnp.take(
+            jnp.concatenate([slot0_b, jnp.zeros((1,), jnp.int32)]),
+            jnp.clip(ta, 0, slot0_b.shape[0]),
+        ), 0,
+    )
+    delta = jnp.where(live, slot0_t + tch - dest0, 0)
+    ddelta = jnp.where(live, delta - _prev_value(delta, live), 0)
+    lv = live.astype(jnp.int32)
+    zeros_t = jnp.zeros_like(lv)
+    dp = jnp.where(ddelta > 0, ddelta, 0)
+    dn = jnp.where(ddelta < 0, -ddelta, 0)
+    half = lambda x: jnp.concatenate([x, zeros_t], axis=1)
+    idxB = jnp.concatenate([dest0, dstop], axis=1)
+    ind_d, p0, p1, p2, n0, n1, n2 = _mxu_spread(
+        idxB,
+        [jnp.concatenate([lv, -lv], axis=1),
+         half(jnp.bitwise_and(dp, 127)),
+         half(jnp.bitwise_and(jnp.right_shift(dp, 7), 127)),
+         half(jnp.bitwise_and(jnp.right_shift(dp, 14), 127)),
+         half(jnp.bitwise_and(dn, 127)),
+         half(jnp.bitwise_and(jnp.right_shift(dn, 7), 127)),
+         half(jnp.bitwise_and(jnp.right_shift(dn, 14), 127))], C,
+    )
+    ddp_d = p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
+    ddn_d = n0 + jnp.left_shift(n1, 7) + jnp.left_shift(n2, 14)
+    if stage == 2:
+        return (
+            jnp.sum(delpk + ind_d + ddp_d + ddn_d, axis=1, keepdims=True)
+        )
+
+    n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
+    length2 = state.length + n_ins
+    doc, cv, vt = range_fused(
+        state.doc, delpk, ind_d, ddp_d, ddn_d, length2, nbits=nbits
+    )
+    return jnp.sum(doc, axis=1, keepdims=True) + vt[:, -1:]
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    trace_name = sys.argv[3] if len(sys.argv) > 3 else "automerge-paper"
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    coalesce = (len(sys.argv) <= 5 or sys.argv[5] == "1")
+
+    trace = load_testing_data(trace_name)
+    if coalesce:
+        from crdt_benches_tpu.traces.tensorize import coalesce_patches
+
+        rt = tensorize_ranges(trace, batch=B, coalesce=True,
+                              patches=list(coalesce_patches(trace)))
+    else:
+        rt = tensorize_ranges(trace, batch=B)
+    eng = RangeReplayEngine(rt, n_replicas=R)
+    C = eng.capacity
+    nb = rt.n_batches
+    print(f"R={R} B={B} C={C} n_batches={nb} nbits={eng.nbits}"
+          f" coalesce={coalesce} K={K} engine={eng.engine}")
+
+    mid = nb // 2
+    kind_b, pos_b, rlen_b, slot0_b = rt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    rlen = jnp.asarray(rlen_b[mid])
+    slot0 = jnp.asarray(slot0_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+    tcap = eng.token_caps[min(mid // eng.chunk, len(eng.token_caps) - 1)]
+
+    st = init_state4(R, C, C // 2)
+    tokens, dints, _ = jax.jit(
+        lambda k, p, r, v: resolve_range_pallas(k, p, r, v, token_cap=tcap)
+    )(kind, pos, rlen, v0)
+    print("T =", tokens[0].shape[1])
+
+    @jax.jit
+    def nop(doc):
+        def b(c, _):
+            return c + 1, None
+        return jax.lax.scan(b, doc[:, :1], None, length=K)[0]
+
+    base = timeit(lambda: nop(st.doc))
+    print(f"floor: {base/K*1e3:.3f} ms/iter")
+
+    # resolver
+    @jax.jit
+    def res_run(kind, pos, rlen, v0):
+        def b(c, _):
+            tk, di, nu = resolve_range_pallas(
+                kind, pos, rlen, v0 + c[:1] * 0, token_cap=tcap
+            )
+            return jnp.minimum(c, nu[:, 0]), None
+        return jax.lax.scan(b, v0, None, length=K)[0]
+
+    t = (timeit(lambda: res_run(kind, pos, rlen, v0)) - base) / K
+    print(f"{'resolver':26s} {t*1e3:9.3f} ms")
+
+    def make(stage):
+        @jax.jit
+        def run(doc, cv, vt, length, nvis, tokens, dints, slot0):
+            from crdt_benches_tpu.ops.apply2 import PackedState4
+
+            def b(c, _):
+                z = jnp.where(c == jnp.int32(-123456789), 1, 0)
+                stt = PackedState4(doc + z, cv, vt, length, nvis)
+                out = staged(stt, tokens, dints, slot0, eng.nbits, stage)
+                return jnp.minimum(c, out), None
+            return jax.lax.scan(b, doc[:, :1], None, length=K)[0]
+        return lambda: run(st.doc, st.cv_intile, st.vis_tile, st.length,
+                           st.nvis, tokens, dints, slot0)
+
+    names = ["0 extract+queries", "1 + spread A (del)",
+             "2 + spread B (ind/dd)", "3 + fused kernel"]
+    prev = 0.0
+    for stage, name in enumerate(names):
+        t = (timeit(make(stage)) - base) / K
+        print(f"{name:26s} {t*1e3:9.3f} ms  (+{(t-prev)*1e3:8.3f})")
+        prev = t
+
+
+if __name__ == "__main__":
+    main()
