@@ -72,11 +72,11 @@ def replay_ranges(
         k, p, ln, s0 = batch
         for i in range(K):
             tokens, dints, nused = resolve_range_pallas(
-                k[i], p[i], ln[i], st.nvis, interpret=interpret,
+                k[i], p[i], ln[i], s0[i], st.nvis, interpret=interpret,
                 token_cap=token_cap,
             )
             mx = jnp.maximum(mx, jnp.max(nused))
-            st = apply_fn(st, tokens, dints, s0[i], nbits=nbits)
+            st = apply_fn(st, tokens, dints, nbits=nbits)
         return (st, mx), None
 
     (state, max_nused), _ = jax.lax.scan(

@@ -260,7 +260,7 @@ def init_state3(n_replicas: int, capacity: int, n_init: int = 0) -> PackedState:
     )
 
 
-def _mxu_spread(idx, vals_7bit_chunks, C: int):
+def _mxu_spread(idx, vals_7bit_chunks, C: int, cb: int = 512):
     """Batched scatter-add via one-hot MXU matmuls: returns, for each 7-bit
     chunk array v in ``vals_7bit_chunks`` (each int32[R, B] with values in
     [0, 127]), the dense int32[R, C] array with v[r, b] added at position
@@ -269,7 +269,7 @@ def _mxu_spread(idx, vals_7bit_chunks, C: int):
     matmuls are exact.  On this TPU runtime a row-wise scatter-add costs
     ~53ns/row (serialized); the matmul form runs on the MXU at
     R*B*nt*128 MACs per chunk (~0.2ms at R=256, C=182k)."""
-    return _mxu_spread_tc(idx, vals_7bit_chunks, C)[0]
+    return _mxu_spread_tc(idx, vals_7bit_chunks, C, cb=cb)[0]
 
 
 def apply_batch3(
@@ -532,14 +532,20 @@ def _excl_cumsum_small(x):
     return inc - x
 
 
-def _mxu_spread_tc(idx, vals_7bit_chunks, C: int):
+def _mxu_spread_tc(idx, vals_7bit_chunks, C: int, cb: int = 512):
     """_mxu_spread that additionally returns the per-tile index counts
-    (int32[R, nt]) — reused by the fused kernel's cross-tile cnt base."""
+    (int32[R, nt]) — reused by the fused kernel's cross-tile cnt base.
+
+    ``cb`` bounds the one-hot's index-chunk width.  Each chunk iteration
+    ACCUMULATES into the dense outputs — a full (R, C) read+write per
+    iteration — so callers whose value set is a single array should pass
+    ``cb >= B`` for a one-shot spread (the one-hot itself fuses into the
+    convolution and never materializes; XLA trace, r4)."""
     R, B = idx.shape
     nt = C // LANE
     outs = [jnp.zeros((R, C), jnp.int32) for _ in vals_7bit_chunks]
     tcount = jnp.zeros((R, nt), jnp.int32)
-    CB = 512 if B > 512 else B
+    CB = cb if B > cb else B
     for c0 in range(0, B, CB):
         cb = min(CB, B - c0)
         idx_c = jax.lax.slice_in_dim(idx, c0, c0 + cb, axis=1)
@@ -677,7 +683,9 @@ def apply_batch4(
         jax.default_backend() == "tpu"
         and FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20
     ):
-        doc, cv, vt = apply_fused(
+        from .apply_range_fused import apply_fused2
+
+        doc, cv, vt = apply_fused2(
             doc_predel, combo, cnt_base, length, nbits=nbits
         )
     else:

@@ -86,9 +86,8 @@ def extract_range_tokens(ttype, ta, tch, tlen, v0):
 
 def apply_range_batch(
     state: PackedState,
-    tokens,  # (ttype, ta, tch, tlen) int32[R, T]
+    tokens,  # (ttype, ta, tch, tlen) int32[R, T]; TINS ta = slot0
     dints,  # (dlo, dhi, dcount) int32[R, B]
-    slot0_b: jax.Array,  # int32[B] first slot per op (-1 = not an insert)
     nbits: int,
 ) -> PackedState:
     ttype, ta, tch, tlen = tokens
@@ -147,15 +146,9 @@ def apply_range_batch(
     # ---- fill values: slot(d) = d + delta(run of d) ----
     # slot of char k of token i = slot0[ta_i] + tch_i + k, at position
     # dest0_i + k  ->  delta_i = slot0[ta_i] + tch_i - dest0_i.
-    slot0_t = jnp.where(
-        live,
-        jnp.take(
-            jnp.concatenate([slot0_b, jnp.zeros((1,), jnp.int32)]),
-            jnp.clip(ta, 0, slot0_b.shape[0]),
-        ),
-        0,
-    )
-    delta = jnp.where(live, slot0_t + tch - dest0, 0)
+    # TINS tokens carry slot0 directly in ``ta`` (the range resolver
+    # bakes it in; see ops/resolve_range_pallas.py).
+    delta = jnp.where(live, ta + tch - dest0, 0)
     # Per-run constants as cumsum of differences painted at run starts.
     prev_live_delta = _prev_value(delta, live)
     ddelta = jnp.where(live, delta - prev_live_delta, 0)

@@ -59,6 +59,10 @@ from .expand_pallas import _flat_roll, _roll_ax
 RANGE_FUSED_BYTES_PER_POS = 150
 
 
+def _round_up_c(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
 def range_fused_fits(capacity: int) -> bool:
     """The ONE VMEM-stack gate for the fused range kernel — callers
     (engine selection, the batch dispatcher, range_fused itself) must all
@@ -101,7 +105,145 @@ def _flat_cumsum_f32(x_i32, tri):
     return y + _tile_scan_excl(y[:, :, LANE - 1 :])
 
 
-def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, ddp_ref, ddn_ref,
+def _apply_fused2_kernel(doc_ref, combo_ref, newlen_ref,
+                         *rest, nt: int, nbits: int, Rt: int,
+                         emit_cv: bool):
+    """expand_pallas._apply_fused_kernel re-expressed with the
+    triangular-matmul cumsum and NO scratch refs — same measured speed
+    as the original, kept because it shares range_fused's building
+    blocks and the caller-side wrapper self-pads unaligned tile counts
+    (nt % 8 != 0 blows Mosaic compile time up to minutes)."""
+    if emit_cv:
+        doc_out, cv_ref, vistot_ref = rest
+    else:
+        (doc_out,) = rest
+    lane = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 2)
+    col = (
+        jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 1) * LANE + lane
+    )
+    li = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
+    tri = (li <= lj).astype(jnp.float32)
+
+    combo = combo_ref[:]
+    ind = jnp.bitwise_and(combo, 1)
+    # cross-tile base recomputed in-kernel (== the caller's cnt_base by
+    # construction: both are the exclusive prefix of per-tile counts of
+    # combo's low bit); an (Rt, nt, 1) INPUT block spec forced layout
+    # transposes on the XLA side.
+    cnt = _flat_cumsum_f32(ind, tri)
+    maxcnt = jnp.max(cnt[:, :, LANE - 1 :])
+
+    doc_out[:] = doc_ref[:]
+    for b in reversed(range(nbits)):
+        step = 1 << b
+
+        @pl.when(maxcnt >= step)
+        def _():
+            d = doc_out[:]
+            take = (jnp.bitwise_and(cnt, step) != 0) & (col >= step)
+            doc_out[:] = jnp.where(take, _flat_roll(d, step), d)
+
+    doc_out[:] = jnp.where(
+        ind != 0, jnp.right_shift(combo, 1), doc_out[:]
+    )
+    doc_out[:] = jnp.where(col >= newlen_ref[:], 2, doc_out[:])
+    if emit_cv:
+        cv_in = _tile_cumsum(jnp.bitwise_and(doc_out[:], 1), tri)
+        cv_ref[:] = cv_in.astype(jnp.bfloat16)
+        vistot_ref[:] = cv_in[:, :, LANE - 1 :]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nbits", "replica_tile", "interpret", "emit_cv"),
+)
+def apply_fused2(doc_predel, combo, cnt_base, new_len, *, nbits: int,
+                 replica_tile: int = 0, interpret: bool = False,
+                 emit_cv: bool = True):
+    """Drop-in replacement for expand_pallas.apply_fused (same contract:
+    doc_predel/combo int32[R, C], cnt_base int32[R, nt] exclusive
+    cross-tile insert-count prefix, new_len int32[R]; returns doc' or
+    (doc', cv_intile bf16, vis_tile))."""
+    R, C = doc_predel.shape
+    nt = C // LANE
+    if nt % 8 and not interpret:
+        # Unaligned sublane tile counts send Mosaic compilation into
+        # minutes (measured 243s at nt=1425 vs ~1s aligned).  Pad the
+        # capacity axis to the next 8-tile boundary and slice after —
+        # padded doc positions are beyond-length (2), padded combo/base
+        # carry no inserts.
+        Cp = _round_up_c(C, 8 * LANE)
+        pad = Cp - C
+        doc_p = jnp.concatenate(
+            [doc_predel, jnp.full((R, pad), 2, jnp.int32)], axis=1
+        )
+        combo_p = jnp.concatenate(
+            [combo, jnp.zeros((R, pad), jnp.int32)], axis=1
+        )
+        base_p = jnp.concatenate(
+            [cnt_base,
+             jnp.broadcast_to(cnt_base[:, -1:], (R, pad // LANE))],
+            axis=1,
+        )
+        out = apply_fused2(
+            doc_p, combo_p, base_p, new_len, nbits=nbits,
+            replica_tile=replica_tile, interpret=interpret,
+            emit_cv=emit_cv,
+        )
+        if not emit_cv:
+            return out[:, :C]
+        d, cv, vt = out
+        return d[:, :C], cv[:, :C], vt[:, :nt]
+    per_replica = 40 * C  # ~5 live (nt, LANE) i32/f32 arrays + roll temps
+    Rt = replica_tile
+    if Rt <= 0:
+        Rt = max(1, (96 * 2**20) // per_replica)
+    Rt = min(Rt, R)
+    while R % Rt:
+        Rt -= 1
+    big = pl.BlockSpec(
+        (Rt, nt, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    small = pl.BlockSpec(
+        (Rt, nt, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    one = pl.BlockSpec(
+        (Rt, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _apply_fused2_kernel, nt=nt, nbits=nbits, Rt=Rt, emit_cv=emit_cv
+    )
+    r3 = lambda x: x.reshape(R, nt, LANE)
+    out = pl.pallas_call(
+        kernel,
+        grid=(R // Rt,),
+        in_specs=[big, big, one],
+        out_specs=[big, big, small] if emit_cv else [big],
+        out_shape=(
+            [
+                jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32),
+                jax.ShapeDtypeStruct((R, nt, LANE), jnp.bfloat16),
+                jax.ShapeDtypeStruct((R, nt, 1), jnp.int32),
+            ]
+            if emit_cv
+            else [jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32)]
+        ),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2**20
+        ),
+        interpret=interpret,
+    )(
+        r3(doc_predel), r3(combo),
+        new_len.reshape(R, 1, 1).astype(jnp.int32),
+    )
+    if not emit_cv:
+        return out[0].reshape(R, C)
+    doc_o, cv, vt = out
+    return doc_o.reshape(R, C), cv.reshape(R, C), vt.reshape(R, nt)
+
+
+def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, dd_ref,
                         newlen_ref, doc_out, cv_ref, vistot_ref,
                         *, nt: int, nbits: int, Rt: int):
     """One-batch range application with all capacity-wide work in VMEM.
@@ -113,14 +255,13 @@ def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, ddp_ref, ddn_ref,
       may share a boundary, so per-cell counts reach B and get the same
       chunked treatment as ddp/ddn below)
     - ind: insert-run boundary deltas (+1 at dest0, -1 at dstop)
-    - ddp/ddn: positive/negative slot-delta differences painted at run
-      starts (prefix of ddp - ddn = the containing run's
-      slot0 + tch - dest0).  Each element < 2^21, so the kernel re-chunks
-      them to 3x7 bits before the triangular matmuls: the MXU truncates
-      dot operands to bf16 and accumulates in tree order, which is only
-      exact when every term (and hence any partial sum up to 128 terms)
-      stays small — the same bound the unfused path's chunked spread
-      relied on.
+    - dd: signed slot-delta differences painted at run starts (prefix =
+      the containing run's slot0 + tch - dest0).  |element| < 2^21, so
+      the kernel sign-splits and re-chunks to 3x7 bits before the
+      triangular matmuls: the MXU truncates dot operands to bf16 and
+      accumulates in tree order, which is only exact when every term
+      (and hence any partial sum up to 128 terms) stays small — the same
+      bound the unfused path's chunked spread relied on.
     - newlen (Rt, 1, 1): post-batch used length
     Outputs: new doc, cv_intile (bf16), vis_tile — the maintained
     visibility prefix structure for the next batch's rank queries.
@@ -168,10 +309,16 @@ def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, ddp_ref, ddn_ref,
 
     # ---- fill: slot(d) = d + delta(run of d), vis = 1 ----
     # 7-bit-chunked within-tile cumsums (exact under bf16 MXU operands),
-    # one shared cross-tile scan on the recombined tile totals.
+    # one shared cross-tile scan on the recombined tile totals.  The dd
+    # input arrives as one signed dense array (each cell holds a single
+    # token's ddelta, so the in-kernel sign split recovers the
+    # non-negative halves exactly).
+    dd = dd_ref[:]
     dcum_w = jnp.zeros((Rt, nt, LANE), jnp.int32)
-    for ref, sign in ((ddp_ref, 1), (ddn_ref, -1)):
-        v = ref[:]
+    for v, sign in (
+        (jnp.maximum(dd, 0), 1),
+        (jnp.maximum(-dd, 0), -1),
+    ):
         for k in range(3):
             chunk = jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
             dcum_w = dcum_w + sign * jnp.left_shift(
@@ -191,7 +338,7 @@ def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, ddp_ref, ddn_ref,
 @functools.partial(
     jax.jit, static_argnames=("nbits", "replica_tile", "interpret")
 )
-def range_fused(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int,
+def range_fused(doc, delpk, ind_d, dd, new_len, *, nbits: int,
                 replica_tile: int = 0, interpret: bool = False):
     """Run the fused range kernel.  All dense args int32[R, C] (C a
     multiple of 128); new_len int32[R].  Returns (doc', cv_intile bf16,
@@ -226,7 +373,7 @@ def range_fused(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int,
     doc_o, cv, vt = pl.pallas_call(
         kernel,
         grid=(R // Rt,),
-        in_specs=[big, big, big, big, big, one],
+        in_specs=[big, big, big, big, one],
         out_specs=[big, big, small],
         out_shape=[
             jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32),
@@ -238,13 +385,13 @@ def range_fused(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int,
         ),
         interpret=interpret,
     )(
-        r3(doc), r3(delpk), r3(ind_d), r3(ddp), r3(ddn),
+        r3(doc), r3(delpk), r3(ind_d), r3(dd),
         new_len.reshape(R, 1, 1).astype(jnp.int32),
     )
     return doc_o.reshape(R, C), cv.reshape(R, C), vt.reshape(R, nt)
 
 
-def range_fused_xla(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int):
+def range_fused_xla(doc, delpk, ind_d, dd, new_len, *, nbits: int):
     """XLA fallback with identical semantics (CPU tests, oversized
     capacities)."""
     R, C = doc.shape
@@ -253,7 +400,6 @@ def range_fused_xla(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int):
     deld = jnp.bitwise_and(delpk, (1 << 14) - 1) - jnp.right_shift(
         delpk, 14
     )
-    dd = ddp - ddn
     depth = jnp.cumsum(deld, axis=1)
     vis = jnp.bitwise_and(doc, 1)
     doc = doc - (vis & (depth > 0).astype(jnp.int32))
@@ -280,9 +426,8 @@ def range_fused_xla(doc, delpk, ind_d, ddp, ddn, new_len, *, nbits: int):
 
 def apply_range_batch4(
     state: PackedState4,
-    tokens,  # (ttype, ta, tch, tlen) int32[R, T]
+    tokens,  # (ttype, ta, tch, tlen) int32[R, T]; TINS ta = slot0
     dints,  # (dlo, dhi, dcount) int32[R, B]
-    slot0_b: jax.Array,  # int32[B]
     nbits: int,
     interpret: bool = False,
 ) -> PackedState4:
@@ -322,60 +467,53 @@ def apply_range_batch4(
     dest0 = jnp.where(live, g_phys + cumlen, drop)
     dstop = jnp.where(live, dest0 + tlen, drop)
 
-    # ---- merged spreads: signed +-1 boundary deltas (collisions sum
-    # exactly — the einsum accumulates in f32 and every product is a
-    # bf16-exact small int; a +1 meeting a -1 is precisely the delta a
-    # prefix-sum consumer wants) ----
+    # ---- spreads: ONE einsum -> ONE dense output each (XLA trace, r4:
+    # the one-hot fuses into the convolution and never materializes, so
+    # the cost is dense (R, C) writes and combine passes — every extra
+    # chunk einsum or shift-add combine is a full HBM traversal).
+    # Exactness: each operand value is bf16-exact (small ints, and
+    # 7-bit chunks SHIFTED by 2^7k keep the same mantissa), collisions
+    # accumulate in f32 (exact below 2^24).
+    #
+    # delete boundaries: starts count in bits 0..13, one-past-end stops
+    # in bits 14..27 of one dense array (vals 1 and 2^14).
     idxA = jnp.concatenate(
         [jnp.where(has_del, lo_phys, drop),
          jnp.where(has_del, hi_phys + 1, drop)], axis=1
     )
     pm = has_del.astype(jnp.int32)
-    zb = jnp.zeros_like(pm)
-    deldp, deldn = _mxu_spread(
+    (delpk,) = _mxu_spread(
         idxA,
-        [jnp.concatenate([pm, zb], axis=1),
-         jnp.concatenate([zb, pm], axis=1)],
-        C,
+        [jnp.concatenate([pm, pm * (1 << 14)], axis=1)],
+        C, cb=4096,
     )
-    delpk = deldp | jnp.left_shift(deldn, 14)
+
+    # insert-run boundary deltas: +1 at dest0, -1 at dstop.
+    lv = live.astype(jnp.int32)
+    (ind_d,) = _mxu_spread(
+        jnp.concatenate([dest0, dstop], axis=1),
+        [jnp.concatenate([lv, -lv], axis=1)],
+        C, cb=4096,
+    )
 
     # delta(run) = slot0[ta] + tch - dest0, painted as differences at
     # run starts (token order == dest order: gaps and cumlen are both
-    # monotone along the token axis)
-    slot0_t = jnp.where(
-        live,
-        jnp.take(
-            jnp.concatenate([slot0_b, jnp.zeros((1,), jnp.int32)]),
-            jnp.clip(ta, 0, slot0_b.shape[0]),
-        ),
-        0,
-    )
-    delta = jnp.where(live, slot0_t + tch - dest0, 0)
+    # monotone along the token axis).  The three signed 7-bit chunk
+    # levels ride ONE einsum as three index copies with shifted values.
+    # TINS tokens carry slot0 directly in ``ta`` (the range resolver
+    # bakes it in — a take() here serialized per row, ~3.5ms/batch).
+    delta = jnp.where(live, ta + tch - dest0, 0)
     ddelta = jnp.where(live, delta - _prev_value(delta, live), 0)
-    lv = live.astype(jnp.int32)
-    zeros_t = jnp.zeros_like(lv)
-    idxB = jnp.concatenate([dest0, dstop], axis=1)
-    dp = jnp.where(ddelta > 0, ddelta, 0)
-    dn = jnp.where(ddelta < 0, -ddelta, 0)
-    half = lambda x: jnp.concatenate([x, zeros_t], axis=1)
-    # |ddelta| < 2C < 2^21 travels as 3x7-bit chunks (bf16-exact spread
-    # products, f32-exact accumulation) exactly like the unfused path.
-    ind_d, p0, p1, p2, n0, n1, n2 = _mxu_spread(
-        idxB,
-        [
-            jnp.concatenate([lv, -lv], axis=1),
-            half(jnp.bitwise_and(dp, 127)),
-            half(jnp.bitwise_and(jnp.right_shift(dp, 7), 127)),
-            half(jnp.bitwise_and(jnp.right_shift(dp, 14), 127)),
-            half(jnp.bitwise_and(dn, 127)),
-            half(jnp.bitwise_and(jnp.right_shift(dn, 7), 127)),
-            half(jnp.bitwise_and(jnp.right_shift(dn, 14), 127)),
-        ],
-        C,
+    sgn = jnp.where(ddelta < 0, -1, 1)
+    mag = jnp.abs(ddelta)
+    lvl = lambda k: sgn * jnp.left_shift(
+        jnp.bitwise_and(jnp.right_shift(mag, 7 * k), 127), 7 * k
     )
-    ddp_d = p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
-    ddn_d = n0 + jnp.left_shift(n1, 7) + jnp.left_shift(n2, 14)
+    (dd,) = _mxu_spread(
+        jnp.concatenate([dest0, dest0, dest0], axis=1),
+        [jnp.concatenate([lvl(0), lvl(1), lvl(2)], axis=1)],
+        C, cb=4096,
+    )
 
     n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
     n_del = jnp.sum(jnp.where(has_del, dcount, 0), axis=1)
@@ -390,7 +528,7 @@ def apply_range_batch4(
         else range_fused_xla
     )
     doc, cv, vt = fn(
-        state.doc, delpk, ind_d, ddp_d, ddn_d, length2, nbits=nbits
+        state.doc, delpk, ind_d, dd, length2, nbits=nbits
     )
     return PackedState4(
         doc=doc,

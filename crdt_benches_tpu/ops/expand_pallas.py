@@ -165,184 +165,6 @@ def _lane_cumsum(x):
     return c
 
 
-def _apply_fused_body(doc_ref, combo_ref, cntbase_ref, newlen_ref,
-                      doc_out, cnt_scr, *, nt: int, nbits: int, Rt: int):
-    """Shared delete-applied-doc -> expanded+filled-doc body of the fused
-    apply kernels (steps 1-3 of _apply_fused_kernel's docstring)."""
-    lane = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 2)
-    col = (
-        jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 1) * LANE + lane
-    )
-
-    cnt_scr[:] = jnp.bitwise_and(combo_ref[:], 1)
-    for b in range(7):
-        s = 1 << b
-        c = cnt_scr[:]
-        cnt_scr[:] = c + jnp.where(lane >= s, _roll_ax(c, s, 2), 0)
-    cnt_scr[:] = cnt_scr[:] + cntbase_ref[:]
-    maxcnt = jnp.max(cnt_scr[:, :, LANE - 1 :])
-
-    doc_out[:] = doc_ref[:]
-    for b in reversed(range(nbits)):
-        step = 1 << b
-
-        @pl.when(maxcnt >= step)
-        def _():
-            d = doc_out[:]
-            take = (jnp.bitwise_and(cnt_scr[:], step) != 0) & (col >= step)
-            doc_out[:] = jnp.where(take, _flat_roll(d, step), d)
-
-    combo = combo_ref[:]
-    doc_out[:] = jnp.where(
-        jnp.bitwise_and(combo, 1) != 0,
-        jnp.right_shift(combo, 1),
-        doc_out[:],
-    )
-    doc_out[:] = jnp.where(col >= newlen_ref[:], 2, doc_out[:])
-
-
-def _apply_fused_nocv_kernel(doc_ref, combo_ref, cntbase_ref, newlen_ref,
-                             doc_out, cnt_scr,
-                             *, nt: int, nbits: int, Rt: int):
-    """apply_fused without the visibility-prefix emission — for integration
-    paths (downstream/merge) that resolve positions by element id
-    (ops/idpos.py) and never consume cumvis."""
-    _apply_fused_body(
-        doc_ref, combo_ref, cntbase_ref, newlen_ref, doc_out, cnt_scr,
-        nt=nt, nbits=nbits, Rt=Rt,
-    )
-
-
-def _apply_fused_kernel(doc_ref, combo_ref, cntbase_ref, newlen_ref,
-                        doc_out, cv_ref, vistot_ref, cnt_scr,
-                        *, nt: int, nbits: int, Rt: int):
-    """One-kernel batch application on the packed doc (see apply2.apply_batch4):
-
-      1. cnt = flat cumsum of insert-destination indicators (combo's low
-         bit) — computed in-VMEM as per-tile lane cumsum plus the
-         precomputed cross-tile base
-      2. log-shift expansion y[d] = x[d - cnt[d]] on the (deletes already
-         applied) doc — same math as _expand_packed_kernel
-      3. holes (combo low bit set) take their insert fill values
-         (combo >> 1); positions beyond the new length become
-         pack_doc(-1, 0) == 2
-      4. emit the new doc AND its visibility prefix structure (within-tile
-         inclusive cumsum + per-tile totals) so the next batch never runs
-         a capacity-sized cumsum in XLA.
-
-    All mutation is in-place on output/scratch refs to keep Mosaic's
-    scoped-VMEM stack peak at a couple of temporaries.
-    """
-    lane = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 2)
-    _apply_fused_body(
-        doc_ref, combo_ref, cntbase_ref, newlen_ref, doc_out, cnt_scr,
-        nt=nt, nbits=nbits, Rt=Rt,
-    )
-
-    # Visibility cumsum in the int32 scratch (cnt is dead here), emitted
-    # as bf16 (values <= 128, exact — the only consumer is a bf16 einsum).
-    cnt_scr[:] = jnp.bitwise_and(doc_out[:], 1)
-    for b in range(7):
-        s = 1 << b
-        c = cnt_scr[:]
-        cnt_scr[:] = c + jnp.where(lane >= s, _roll_ax(c, s, 2), 0)
-    cv_ref[:] = cnt_scr[:].astype(jnp.bfloat16)
-    vistot_ref[:] = cnt_scr[:, :, LANE - 1 :]
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("nbits", "replica_tile", "interpret", "emit_cv"),
-)
-def apply_fused(doc_predel, combo, cnt_base, new_len, *, nbits: int,
-                replica_tile: int = 0, interpret: bool = False,
-                emit_cv: bool = True):
-    """Fused batch application (+ optional cumvis emission).
-
-    doc_predel: int32[R, C] packed doc with delete indicators already
-    subtracted (C a multiple of 128); combo: int32[R, C] = (fill << 1) | ind
-    where ind marks insert destinations and fill is the packed insert value;
-    cnt_base: int32[R, nt] exclusive cross-tile prefix of per-tile insert
-    counts; new_len: int32[R] post-batch used length.
-    Returns (doc_out[R, C], cv_intile[R, C], vis_tile[R, nt]), or just
-    doc_out with ``emit_cv=False`` — for the id-resolved integration paths
-    (downstream v5, merge) that never consume cumvis and would otherwise
-    pay ~25% extra HBM writes for the (R, C) bf16 prefix structure.
-    """
-    R, C = doc_predel.shape
-    nt = C // LANE
-    # Mosaic's measured stack accounting for this kernel is ~92 bytes per
-    # position per replica (Rt=1, C=182400 -> 16.2MB); budget against the
-    # raised 100MB scoped-vmem limit below.
-    per_replica = FUSED_STACK_BYTES_PER_POS * C
-    if per_replica > 96 * 2**20:
-        raise NotImplementedError(
-            "apply_fused requires the XLA fallback path for this capacity"
-        )
-    Rt = replica_tile
-    if Rt <= 0:
-        Rt = max(1, (96 * 2**20) // per_replica)
-    Rt = min(Rt, R)
-    while R % Rt:
-        Rt -= 1
-    big = pl.BlockSpec(
-        (Rt, nt, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-    )
-    small = pl.BlockSpec(
-        (Rt, nt, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-    )
-    one = pl.BlockSpec(
-        (Rt, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-    )
-    kernel = functools.partial(
-        _apply_fused_kernel if emit_cv else _apply_fused_nocv_kernel,
-        nt=nt, nbits=nbits, Rt=Rt,
-    )
-    r3 = lambda x: x.reshape(R, nt, LANE)
-    out = pl.pallas_call(
-        kernel,
-        grid=(R // Rt,),
-        in_specs=[big, big, small, one],
-        out_specs=(
-            [big, big, small] if emit_cv else big
-        ),
-        out_shape=(
-            [
-                jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32),
-                jax.ShapeDtypeStruct((R, nt, LANE), jnp.bfloat16),
-                jax.ShapeDtypeStruct((R, nt, 1), jnp.int32),
-            ]
-            if emit_cv
-            else jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32)
-        ),
-        scratch_shapes=[pltpu.VMEM((Rt, nt, LANE), jnp.int32)],
-        # Mosaic's conservative stack accounting overshoots the default
-        # 16MB scoped budget at C≈180k even though live temporaries are a
-        # fraction of that; v5e has 128MB of physical VMEM.
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 2**20
-        ),
-        interpret=interpret,
-    )(
-        r3(doc_predel), r3(combo),
-        cnt_base.reshape(R, nt, 1),
-        new_len.reshape(R, 1, 1).astype(jnp.int32),
-    )
-    if not emit_cv:
-        return out.reshape(R, C)
-    doc_o, cv, vt = out
-    return doc_o.reshape(R, C), cv.reshape(R, C), vt.reshape(R, nt)
-
-
-def apply_fused_nocv(doc_predel, combo, cnt_base, new_len, *, nbits: int,
-                     replica_tile: int = 0, interpret: bool = False):
-    """apply_fused with emit_cv=False (returns only doc_out[R, C])."""
-    return apply_fused(
-        doc_predel, combo, cnt_base, new_len, nbits=nbits,
-        replica_tile=replica_tile, interpret=interpret, emit_cv=False,
-    )
-
-
 def apply_fused_nocv_xla(doc_predel, combo, cnt_base, new_len, *, nbits: int):
     """XLA fallback for apply_fused_nocv (CPU / oversized capacities)."""
     out, _, _ = apply_fused_xla(
@@ -355,12 +177,20 @@ def fused_apply_nocv_dispatch(doc_predel, combo, cnt_base, new_len, *,
                               nbits: int):
     """Pick the right no-cumvis fused apply for the platform and capacity:
     monolithic VMEM kernel under the ~1.09M-position gate, the blocked
-    halo kernel above it (TPU), XLA fallback elsewhere."""
+    halo kernel above it (TPU), XLA fallback elsewhere.
+
+    The monolithic path is apply_fused2 (ops/apply_range_fused.py):
+    same math as apply_fused via the triangular-matmul cumsum, no
+    scratch refs, and it self-pads unaligned tile counts (nt % 8 != 0
+    sends Mosaic compile time into minutes)."""
     C = doc_predel.shape[1]
     if jax.default_backend() == "tpu":
         if FUSED_STACK_BYTES_PER_POS * C <= 96 * 2**20:
-            return apply_fused_nocv(
-                doc_predel, combo, cnt_base, new_len, nbits=nbits
+            from .apply_range_fused import apply_fused2
+
+            return apply_fused2(
+                doc_predel, combo, cnt_base, new_len, nbits=nbits,
+                emit_cv=False,
             )
         return apply_fused_blocked(
             doc_predel, combo, cnt_base, new_len, nbits=nbits

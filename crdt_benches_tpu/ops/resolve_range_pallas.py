@@ -57,7 +57,7 @@ def _roll1(x):
     return jnp.concatenate([x[:, -1:], x[:, :-1]], axis=1)
 
 
-def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
+def _kernel(kind_ref, pos_ref, rlen_ref, slot0_ref, v0_ref,
             dlo_ref, dhi_ref, dn_ref,
             ttype_ref, ta_ref, tch_ref, tlen_ref, nused_ref,
             *, B: int, T: int, Rt: int):
@@ -66,6 +66,7 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
     kind_v = kind_ref[:]
     pos_v = pos_ref[:]
     rlen_v = rlen_ref[:]
+    slot0_v = slot0_ref[:]
     v0 = v0_ref[:]  # (Rt, 1)
 
     dlo_ref[:] = jnp.full((Rt, B), -1, jnp.int32)
@@ -89,6 +90,7 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
         k = jnp.sum(kind_v * opm, axis=1, keepdims=True)
         p0 = jnp.sum(pos_v * opm, axis=1, keepdims=True)
         L0 = jnp.sum(rlen_v * opm, axis=1, keepdims=True)
+        s0 = jnp.sum(slot0_v * opm, axis=1, keepdims=True)
 
         is_ins = (k == INSERT) & (L0 > 0)
         p = jnp.clip(p0, 0, total)
@@ -171,7 +173,12 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
         ch_right_del = jnp.where(is_run_t, ch, ch + (pD - pre))
         tta_right_ins = tta_t + jnp.where(is_run_t, off * 4, 0)
         ch_right_ins = jnp.where(is_run_t, ch, ch + off)
-        jj_tins = jj * 4 + TINS
+        # TINS tokens carry the op's FIRST SLOT ID (not the op index):
+        # the apply's fill needs slot0 + tch per token, and baking slot0
+        # in here removes a serializing (R, T) gather from the XLA side
+        # (~3.5ms/batch at R=1024; slot ids < capacity < 2^20 share the
+        # op-index packing range).
+        jj_tins = s0 * 4 + TINS
 
         n0ta = jnp.where(
             is_ins & ~split_ins, jj_tins,
@@ -246,13 +253,15 @@ def _kernel(kind_ref, pos_ref, rlen_ref, v0_ref,
     jax.jit, static_argnames=("replica_tile", "interpret", "token_cap")
 )
 def resolve_range_pallas(
-    kind, pos, rlen, v0, *, replica_tile: int = 64, interpret: bool = False,
-    token_cap: int | None = None,
+    kind, pos, rlen, slot0, v0, *, replica_tile: int = 64,
+    interpret: bool = False, token_cap: int | None = None,
 ):
     """Resolve one batch of range ops for R replicas.
 
-    kind/pos/rlen: int32[B]; v0: int32[R].  Returns
-    (ttype, ta, tch, tlen) int32[R, T] token arrays,
+    kind/pos/rlen/slot0: int32[B]; v0: int32[R].  Returns
+    (ttype, ta, tch, tlen) int32[R, T] token arrays — ``ta`` is the
+    pre-batch RANK for RUN tokens and the op's first SLOT ID for TINS
+    tokens —
     (drank_lo, drank_hi, dcount) int32[R, B] per-op delete intervals,
     and nused int32[R, 1] — the batch's TRUE final token demand.
 
@@ -285,7 +294,7 @@ def resolve_range_pallas(
     out = pl.pallas_call(
         kernel,
         grid=(R // Rt,),
-        in_specs=[bspec(B), bspec(B), bspec(B),
+        in_specs=[bspec(B), bspec(B), bspec(B), bspec(B),
                   pl.BlockSpec((Rt, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=[ospec(B), ospec(B), ospec(B),
@@ -307,6 +316,7 @@ def resolve_range_pallas(
         kind.reshape(1, B).astype(jnp.int32),
         pos.reshape(1, B).astype(jnp.int32),
         rlen.reshape(1, B).astype(jnp.int32),
+        slot0.reshape(1, B).astype(jnp.int32),
         v0.reshape(R, 1).astype(jnp.int32),
     )
     dlo, dhi, dn, ttype, ta, tch, tlen, nused = out
