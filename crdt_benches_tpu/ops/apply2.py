@@ -385,8 +385,8 @@ class PackedState4(NamedTuple):
     consumer is a one-hot bf16 einsum); ``vis_tile[r, t]`` is tile t's
     total.  Together they give absolute cumvis without ever running a
     capacity-sized cumsum in XLA: the fused apply kernel
-    (expand_pallas.apply_fused) re-emits both for the post-batch document
-    each batch.
+    (apply_range_fused.apply_fused2, or expand_pallas.apply_fused_xla off
+    TPU) re-emits both for the post-batch document each batch.
     """
 
     doc: jax.Array  # int32[R, C] packed ((slot+2)<<1)|vis
@@ -614,7 +614,8 @@ def apply_batch4(
     """apply_batch3 with (a) cumvis read from the maintained two-level
     structure instead of a per-batch (R, C) cumsum, and (b) delete-apply +
     expansion + fill + next-batch cumvis emission fused into one Pallas
-    kernel (expand_pallas.apply_fused).  Falls back to plain XLA off-TPU.
+    kernel (apply_range_fused.apply_fused2).  Falls back to plain XLA
+    (expand_pallas.apply_fused_xla) off-TPU.
     """
     R, C = state.doc.shape
     B = slots.shape[0]
@@ -675,7 +676,6 @@ def apply_batch4(
     nbits = max(1, (B).bit_length())
     from .expand_pallas import (
         FUSED_STACK_BYTES_PER_POS,
-        apply_fused,
         apply_fused_xla,
     )
 
