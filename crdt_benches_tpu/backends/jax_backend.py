@@ -21,7 +21,10 @@ from .base import BatchedReplay
 
 class JaxReplayBackend(BatchedReplay):
     def __init__(self, n_replicas: int = 1, batch: int = 512,
-                 layout: str | None = None):
+                 layout: str | None = None, pack: int = 8,
+                 range_engine: str | None = None,
+                 unit_engine: str | None = None,
+                 resolver: str | None = None):
         self.n_replicas = n_replicas
         self.batch = batch
         #: 'auto' (default; overridable via CRDT_ENGINE_LAYOUT) picks the
@@ -29,6 +32,14 @@ class JaxReplayBackend(BatchedReplay):
         #: 'unit' forces the per-char engine (the labeled jax-unit bench
         #: column); 'range' forces the range engine.
         self.layout = layout
+        self.pack = pack
+        #: range-apply pick ('v4' fused kernel / 'v3' XLA per-pass);
+        #: None defers to CRDT_RANGE_APPLY (default v4).
+        self.range_engine = range_engine
+        #: unit-apply pick and unit resolver; None defers to the
+        #: ReplayEngine defaults (CRDT_ENGINE_APPLY / platform auto).
+        self.unit_engine = unit_engine
+        self.resolver = resolver
         self._eng: ReplayEngine | None = None
         self._tt = None
 
@@ -45,6 +56,14 @@ class JaxReplayBackend(BatchedReplay):
     @property
     def replicas(self) -> int:
         return self.n_replicas
+
+    @property
+    def engine(self):
+        """The constructed replay engine (RangeReplayEngine or
+        ReplayEngine); available after :meth:`prepare`."""
+        if self._eng is None:
+            raise RuntimeError("call prepare(trace) first")
+        return self._eng
 
     def prepare(self, trace: TestData) -> None:
         # Layout auto-selection (SURVEY.md section 7 hard-part 4): the edit
@@ -85,11 +104,16 @@ class JaxReplayBackend(BatchedReplay):
                 patches=patches,
             )
             self._eng = RangeReplayEngine(
-                rt, n_replicas=self.n_replicas, pack=8
+                rt, n_replicas=self.n_replicas, pack=self.pack,
+                engine=self.range_engine,
             )
         else:
             self._tt = tensorize(trace, batch=self.batch)
-            self._eng = ReplayEngine(self._tt, n_replicas=self.n_replicas)
+            self._eng = ReplayEngine(
+                self._tt, n_replicas=self.n_replicas,
+                resolver=self.resolver, engine=self.unit_engine,
+                pack=self.pack,
+            )
         self._end_len = len(trace.end_content)
 
     def replay_once(self) -> int:

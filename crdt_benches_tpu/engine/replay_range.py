@@ -106,6 +106,7 @@ class RangeReplayEngine:
         chunk: int = 32,
         pack: int = 4,
         interpret: bool | None = None,
+        engine: str | None = None,
     ):
         import os
 
@@ -121,7 +122,7 @@ class RangeReplayEngine:
         #: (ops/apply_range_fused.py); 'v3' = the per-pass XLA apply
         #: (ops/apply_range.py).  v4 needs the doc to fit the kernel's
         #: VMEM stack budget on TPU; above the gate fall back to v3.
-        self.engine = os.environ.get("CRDT_RANGE_APPLY", "v4")
+        self.engine = engine or os.environ.get("CRDT_RANGE_APPLY", "v4")
         if self.engine == "v4":
             # The fused kernel's cross-tile scan runs sublane-axis shifts
             # over (Rt, nt, 1) tile totals; nt must be a multiple of 8 or

@@ -10,40 +10,71 @@ rather than four separate implementations:
 - both batched over a replica axis and built from the same kernel stack
   (fused Pallas resolver -> packed doc-order apply).
 
-``FlagshipConfig`` pins the tuned defaults the headline benchmark uses.
+``FlagshipConfig()`` with no arguments IS the headline configuration
+bench.py runs: the RLE-coalesced RANGE engine through the fused v4 kernel
+(ops/apply_range_fused.py), 1024 replicas, op batch 1536.  The per-char
+unit engine remains reachable via ``layout="unit"`` — it is the
+differential twin the tests replay against the same oracle, and the
+labeled ``jax-unit`` bench column.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..backends.jax_backend import JaxReplayBackend
 from ..engine.downstream import JaxDownstreamEngine
-from ..engine.replay import ReplayEngine, default_resolver
 from ..traces.loader import TestData, load_testing_data
 from ..traces.tensorize import tensorize
 
 
 @dataclass
 class FlagshipConfig:
-    n_replicas: int = 128  # replica-parallel width (the DP analog)
-    batch: int = 512  # ops per resolver kernel launch
+    """Tuned defaults of the headline benchmark (bench.py knobs:
+    CRDT_BENCH_REPLICAS=1024, CRDT_BENCH_BATCH=1536, auto layout)."""
+
+    n_replicas: int = 1024  # replica-parallel width (the DP analog)
+    batch: int = 1536  # ops per resolver kernel launch
     pack: int = 8  # op batches per scan step
-    engine: str = "v3"  # packed doc-order apply
-    resolver: str | None = None  # None = auto (pallas on TPU)
+    #: 'auto' picks the coalesced range engine when RLE shrinks the op
+    #: stream >= 2x (all four reference traces); 'range'/'unit' force.
+    layout: str = "auto"
+    #: range-path apply: 'v4' = fused Pallas kernel, 'v3' = XLA per-pass
+    #: twin (the auto-fallback above the VMEM gate).  None = env default.
+    range_engine: str | None = "v4"
+    #: unit-path apply generation, used only when layout resolves to
+    #: 'unit' (ReplayEngine: v4 fused / v3 packed / v2 / v1 legacy).
+    unit_engine: str = "v4"
+    resolver: str | None = None  # unit-op resolver (None = auto: pallas on TPU)
+    downstream_engine: str | None = None  # None = CRDT_DOWN_ENGINE (v5)
 
 
-def upstream(trace: TestData | str, cfg: FlagshipConfig | None = None) -> ReplayEngine:
+def backend(cfg: FlagshipConfig | None = None) -> JaxReplayBackend:
+    """The flagship as a bench-table backend (the ``jax`` column)."""
+    cfg = cfg or FlagshipConfig()
+    return JaxReplayBackend(
+        n_replicas=cfg.n_replicas,
+        batch=cfg.batch,
+        layout=None if cfg.layout == "auto" else cfg.layout,
+        pack=cfg.pack,
+        range_engine=cfg.range_engine,
+        unit_engine=cfg.unit_engine,
+        resolver=cfg.resolver,
+    )
+
+
+def upstream(trace: TestData | str, cfg: FlagshipConfig | None = None):
+    """Local-edit replay engine for ``trace`` under ``cfg`` —
+    RangeReplayEngine on the headline path, ReplayEngine when the layout
+    resolves to 'unit'.  Engine selection is delegated to
+    JaxReplayBackend.prepare so the flagship object and the benchmark
+    can never drift apart."""
     cfg = cfg or FlagshipConfig()
     if isinstance(trace, str):
         trace = load_testing_data(trace)
-    tt = tensorize(trace, batch=cfg.batch)
-    return ReplayEngine(
-        tt,
-        n_replicas=cfg.n_replicas,
-        resolver=cfg.resolver or default_resolver(),
-        engine=cfg.engine,
-        pack=cfg.pack,
-    )
+    bk = backend(cfg)
+    bk.prepare(trace)
+    return bk.engine
 
 
 def downstream(
@@ -54,5 +85,5 @@ def downstream(
         trace = load_testing_data(trace)
     tt = tensorize(trace, batch=cfg.batch)
     return JaxDownstreamEngine(
-        tt, n_replicas=cfg.n_replicas, engine=cfg.engine
+        tt, n_replicas=cfg.n_replicas, engine=cfg.downstream_engine
     )

@@ -1,7 +1,7 @@
 """Fully-fused RANGE batch application: one Pallas kernel per batch for
 every capacity-wide pass.
 
-Profiling the XLA range apply (tools/profile_range3.py, R=1024, C=182k)
+Profiling the XLA range apply (tools/profile.py range, R=1024, C=182k)
 put it at ~131 ms/batch against a ~3 ms HBM floor: every stage — the
 per-batch visibility cumsum, the one-hot spreads, four capacity-sized
 cumsums, the fill pass — round-trips (R, C) intermediates through HBM,
@@ -161,10 +161,18 @@ def _apply_fused2_kernel(doc_ref, combo_ref, newlen_ref,
 def apply_fused2(doc_predel, combo, cnt_base, new_len, *, nbits: int,
                  replica_tile: int = 0, interpret: bool = False,
                  emit_cv: bool = True):
-    """Drop-in replacement for expand_pallas.apply_fused (same contract:
-    doc_predel/combo int32[R, C], cnt_base int32[R, nt] exclusive
-    cross-tile insert-count prefix, new_len int32[R]; returns doc' or
-    (doc', cv_intile bf16, vis_tile))."""
+    """Monolithic fused apply (same contract as the dispatchers'
+    blocked/XLA twins: doc_predel/combo int32[R, C], cnt_base int32[R, nt]
+    exclusive cross-tile insert-count prefix, new_len int32[R]; returns
+    doc' or (doc', cv_intile bf16, vis_tile)).
+
+    WARNING: ``cnt_base`` is accepted only for signature parity with
+    apply_fused_blocked / apply_fused_xla and is IGNORED — the kernel
+    recomputes the cross-tile insert-count base from combo's low bit
+    (an (Rt, nt, 1) input block spec forced XLA-side layout transposes).
+    A caller-supplied cnt_base that differs from the exclusive prefix of
+    per-tile popcounts of ``combo & 1`` is silently dropped here while
+    the other two paths would honor it."""
     R, C = doc_predel.shape
     nt = C // LANE
     if nt % 8 and not interpret:
@@ -245,15 +253,17 @@ def apply_fused2(doc_predel, combo, cnt_base, new_len, *, nbits: int,
 
 def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, dd_ref,
                         newlen_ref, doc_out, cv_ref, vistot_ref,
-                        *, nt: int, nbits: int, Rt: int):
+                        *, nt: int, nbits: int, Rt: int, dsh: int = 14):
     """One-batch range application with all capacity-wide work in VMEM.
 
     Inputs (per grid step, (Rt, nt, LANE) int32 unless noted):
     - doc: packed pre-batch doc ((slot+2)<<1 | vis)
     - delpk: packed delete-interval boundary counts — starts in bits
-      0..13, one-past-end stops in bits 14..27 (several ops' intervals
-      may share a boundary, so per-cell counts reach B and get the same
-      chunked treatment as ddp/ddn below)
+      0..dsh-1, one-past-end stops in bits dsh..2*dsh-1 (several ops'
+      intervals may share a boundary, so per-cell counts reach B and get
+      the same chunked treatment as ddp/ddn below).  ``dsh`` is chosen by
+      the producer (_del_stop_shift) so the f32 spread accumulation
+      B*2^dsh + B stays <= 2^24 exact.
     - ind: insert-run boundary deltas (+1 at dest0, -1 at dstop)
     - dd: signed slot-delta differences painted at run starts (prefix =
       the containing run's slot0 + tch - dest0).  |element| < 2^21, so
@@ -277,8 +287,8 @@ def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, dd_ref,
     # ---- deletes: nesting depth > 0 -> clear visible bit ----
     delpk = delpk_ref[:]
     depth_w = jnp.zeros((Rt, nt, LANE), jnp.int32)
-    for lo_bit, sign in ((0, 1), (14, -1)):
-        v = jnp.bitwise_and(jnp.right_shift(delpk, lo_bit), (1 << 14) - 1)
+    for lo_bit, sign in ((0, 1), (dsh, -1)):
+        v = jnp.bitwise_and(jnp.right_shift(delpk, lo_bit), (1 << dsh) - 1)
         for k in range(2):
             chunk = jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
             depth_w = depth_w + sign * jnp.left_shift(
@@ -335,14 +345,36 @@ def _range_fused_kernel(doc_ref, delpk_ref, ind_ref, dd_ref,
     vistot_ref[:] = cv_in[:, :, LANE - 1 :]
 
 
+def _del_stop_shift(B: int) -> int:
+    """Static bit position of the stop-count field in the packed
+    delete-boundary spread.  The spread's f32 einsum accumulates up to B
+    stops (weight 2^dsh) plus B starts (weight 1) into one cell; integer
+    exactness needs B*2^dsh + B <= 2^24, while the field itself must hold
+    counts up to B (2^dsh > B).  dsh=14 preserves the historical packing
+    for every B <= 1024; above that the field narrows to bit_length(B),
+    which satisfies both bounds through B = 4095 exactly (4095 * 4097 =
+    2^24 - 1); B = 4096 is the first failure (ADVICE r4)."""
+    if B <= 1024:
+        return 14
+    sh = B.bit_length()
+    if B * ((1 << sh) + 1) > 1 << 24:
+        raise ValueError(
+            f"delete-boundary spread not f32-exact at batch {B}: "
+            f"{B} * (2^{sh} + 1) > 2^24; cap the op batch at 4095 or "
+            "split the start/stop spreads into separate value arrays"
+        )
+    return sh
+
+
 @functools.partial(
-    jax.jit, static_argnames=("nbits", "replica_tile", "interpret")
+    jax.jit, static_argnames=("nbits", "replica_tile", "interpret", "dsh")
 )
 def range_fused(doc, delpk, ind_d, dd, new_len, *, nbits: int,
-                replica_tile: int = 0, interpret: bool = False):
+                replica_tile: int = 0, interpret: bool = False,
+                dsh: int = 14):
     """Run the fused range kernel.  All dense args int32[R, C] (C a
     multiple of 128); new_len int32[R].  Returns (doc', cv_intile bf16,
-    vis_tile)."""
+    vis_tile).  ``dsh`` must match the producer's _del_stop_shift(B)."""
     R, C = doc.shape
     nt = C // LANE
     if not (interpret or range_fused_fits(C)):
@@ -367,7 +399,7 @@ def range_fused(doc, delpk, ind_d, dd, new_len, *, nbits: int,
         (Rt, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
     )
     kernel = functools.partial(
-        _range_fused_kernel, nt=nt, nbits=nbits, Rt=Rt
+        _range_fused_kernel, nt=nt, nbits=nbits, Rt=Rt, dsh=dsh
     )
     r3 = lambda x: x.reshape(R, nt, LANE)
     doc_o, cv, vt = pl.pallas_call(
@@ -391,14 +423,15 @@ def range_fused(doc, delpk, ind_d, dd, new_len, *, nbits: int,
     return doc_o.reshape(R, C), cv.reshape(R, C), vt.reshape(R, nt)
 
 
-def range_fused_xla(doc, delpk, ind_d, dd, new_len, *, nbits: int):
+def range_fused_xla(doc, delpk, ind_d, dd, new_len, *, nbits: int,
+                    dsh: int = 14):
     """XLA fallback with identical semantics (CPU tests, oversized
     capacities)."""
     R, C = doc.shape
     nt = C // LANE
     col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
-    deld = jnp.bitwise_and(delpk, (1 << 14) - 1) - jnp.right_shift(
-        delpk, 14
+    deld = jnp.bitwise_and(delpk, (1 << dsh) - 1) - jnp.right_shift(
+        delpk, dsh
     )
     depth = jnp.cumsum(deld, axis=1)
     vis = jnp.bitwise_and(doc, 1)
@@ -475,8 +508,12 @@ def apply_range_batch4(
     # 7-bit chunks SHIFTED by 2^7k keep the same mantissa), collisions
     # accumulate in f32 (exact below 2^24).
     #
-    # delete boundaries: starts count in bits 0..13, one-past-end stops
-    # in bits 14..27 of one dense array (vals 1 and 2^14).
+    # delete boundaries: starts count in bits 0..dsh-1, one-past-end
+    # stops in bits dsh..2*dsh-1 of one dense array (vals 1 and 2^dsh).
+    # _del_stop_shift picks dsh so a cell holding up to B stops plus B
+    # starts stays <= 2^24 (f32-exact) — B > 1024 narrows the field
+    # instead of paying a second dense spread output (ADVICE r4).
+    dsh = _del_stop_shift(B)
     idxA = jnp.concatenate(
         [jnp.where(has_del, lo_phys, drop),
          jnp.where(has_del, hi_phys + 1, drop)], axis=1
@@ -484,7 +521,7 @@ def apply_range_batch4(
     pm = has_del.astype(jnp.int32)
     (delpk,) = _mxu_spread(
         idxA,
-        [jnp.concatenate([pm, pm * (1 << 14)], axis=1)],
+        [jnp.concatenate([pm, pm * (1 << dsh)], axis=1)],
         C, cb=4096,
     )
 
@@ -528,7 +565,7 @@ def apply_range_batch4(
         else range_fused_xla
     )
     doc, cv, vt = fn(
-        state.doc, delpk, ind_d, dd, length2, nbits=nbits
+        state.doc, delpk, ind_d, dd, length2, nbits=nbits, dsh=dsh
     )
     return PackedState4(
         doc=doc,

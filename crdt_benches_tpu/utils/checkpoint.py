@@ -13,7 +13,10 @@ class name; plain NumPy, no framework dependency on the read side.
 
 from __future__ import annotations
 
+import ml_dtypes
 import numpy as np
+
+_BF16 = np.dtype(ml_dtypes.bfloat16)
 
 from ..engine.downstream import DownPacked, DownState
 from ..ops.apply import DocState
@@ -30,14 +33,27 @@ _CLASSES = {
 
 
 def save_state(path: str, state) -> None:
-    """Persist a DocState/DownState pytree (device arrays are fetched)."""
+    """Persist a DocState/DownState pytree (device arrays are fetched).
+
+    Non-NumPy-native dtypes need explicit handling: ``np.savez`` writes a
+    bfloat16 array (PackedState4.cv_intile) but ``np.load`` reads it back
+    as an opaque void dtype (``|V2``), silently breaking v4-state resume.
+    Such fields are stored as a uint16 bit-view plus a dtype manifest and
+    re-viewed on load."""
     cls = type(state).__name__
     if cls not in _CLASSES:
         raise TypeError(f"unsupported state type {cls}")
-    arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
+    arrays = {}
+    dtypes = []
+    for f in state._fields:
+        a = np.asarray(getattr(state, f))
+        dtypes.append(str(a.dtype))
+        if a.dtype == _BF16:
+            a = a.view(np.uint16)
+        arrays[f] = a
     np.savez_compressed(
         path, __class__=np.asarray(cls), __fields__=np.asarray(state._fields),
-        **arrays,
+        __dtypes__=np.asarray(dtypes), **arrays,
     )
 
 
@@ -47,4 +63,24 @@ def load_state(path: str):
     with np.load(path) as z:
         cls = _CLASSES[str(z["__class__"])]
         fields = [str(f) for f in z["__fields__"]]
-        return cls(**{f: z[f] for f in fields})
+        dtypes = (
+            [str(d) for d in z["__dtypes__"]]
+            if "__dtypes__" in z else [""] * len(fields)
+        )
+        out = {}
+        for f, d in zip(fields, dtypes):
+            a = z[f]
+            if d == "bfloat16":
+                a = a.view(_BF16)
+            elif a.dtype.kind == "V":
+                # A void field with no dtype manifest is a pre-manifest
+                # checkpoint of a bf16-carrying state: unrecoverable
+                # (np.savez dropped the dtype) — fail loudly here rather
+                # than when jnp.asarray chokes far from the load site.
+                raise ValueError(
+                    f"checkpoint field {f!r} has opaque dtype {a.dtype}: "
+                    "legacy checkpoint saved before the bfloat16 manifest "
+                    "fix; re-create it with the current save_state"
+                )
+            out[f] = a
+        return cls(**out)

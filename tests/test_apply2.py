@@ -59,6 +59,7 @@ def _oracle_replay(trace):
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 7])
 @pytest.mark.parametrize("batch", [16, 64])
+@pytest.mark.slow
 def test_v2_random_streams_vs_oracle(seed, batch):
     trace = synth_trace(seed=seed, n_ops=400, base="doc-order state v2 ")
     tt = tensorize(trace, batch=batch)
@@ -70,6 +71,7 @@ def test_v2_random_streams_vs_oracle(seed, batch):
     assert (np.asarray(st.nvis) == len(want)).all()
 
 
+@pytest.mark.slow
 def test_v2_matches_v1_on_svelte_prefix(svelte_trace):
     tt = tensorize(svelte_trace, batch=256)
     # replay only a prefix cheaply by truncating the tensorized stream
@@ -86,6 +88,7 @@ def test_v2_matches_v1_on_svelte_prefix(svelte_trace):
     assert e2.decode(e2.run()) == e1.decode(e1.run())
 
 
+@pytest.mark.slow
 def test_v2_pack_invariance():
     trace = synth_trace(seed=11, n_ops=300, base="packing")
     tt = tensorize(trace, batch=32)
@@ -122,6 +125,7 @@ def test_expand_pallas_kernel_matches_xla(seed):
 
 
 @pytest.mark.parametrize("seed", [0, 3])
+@pytest.mark.slow
 def test_v3_packed_matches_v2_and_oracle(seed):
     trace = synth_trace(seed=seed, n_ops=350, base="packed state v3 ")
     tt = tensorize(trace, batch=32)
@@ -135,6 +139,7 @@ def test_v3_packed_matches_v2_and_oracle(seed):
 
 
 @pytest.mark.parametrize("batch", [2048])
+@pytest.mark.slow
 def test_v3_large_batch_sort_rank_path(batch):
     # Exercises the argsort dest path (B > 1024) and hierarchical searchsorted.
     trace = synth_trace(seed=21, n_ops=3000, base="large batch " * 4)

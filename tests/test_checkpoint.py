@@ -8,8 +8,10 @@ from crdt_benches_tpu.engine.replay import ReplayEngine
 from crdt_benches_tpu.traces.synth import synth_trace
 from crdt_benches_tpu.traces.tensorize import tensorize
 from crdt_benches_tpu.utils.checkpoint import load_state, save_state
+import pytest
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_mid_replay(tmp_path):
     tt = tensorize(synth_trace(seed=3, n_ops=200, base="checkpointed"),
                    batch=16)
@@ -35,6 +37,7 @@ def test_checkpoint_resume_mid_replay(tmp_path):
     assert eng.decode(st3) == want
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip_downstream(tmp_path):
     from crdt_benches_tpu.engine.downstream import JaxDownstreamEngine
 

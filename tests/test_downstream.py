@@ -29,6 +29,7 @@ def check_downstream(kinds, poss, chs, batch=8, start="", n_replicas=1):
         assert eng.decode(state, replica=r) == want
 
 
+@pytest.mark.slow
 def test_append_only():
     check_downstream([INSERT] * 4, [0, 1, 2, 3], [A, B_, C_, A])
 
@@ -37,6 +38,7 @@ def test_insert_at_head():
     check_downstream([INSERT] * 4, [0, 0, 0, 0], [A, B_, C_, A])
 
 
+@pytest.mark.slow
 def test_inserts_span_batches():
     # 20 ops across 3 batches of 8: interleaved head/tail inserts
     kinds = [INSERT] * 20
@@ -45,6 +47,7 @@ def test_inserts_span_batches():
     check_downstream(kinds, poss, chs)
 
 
+@pytest.mark.slow
 def test_delete_prebatch():
     check_downstream(
         [INSERT, INSERT, INSERT, INSERT, INSERT, INSERT, INSERT, INSERT,
@@ -72,6 +75,7 @@ def test_with_start_content():
     )
 
 
+@pytest.mark.slow
 def test_vmapped_replicas():
     check_downstream(
         [INSERT] * 6 + [DELETE] * 2,
@@ -82,6 +86,7 @@ def test_vmapped_replicas():
 
 
 @pytest.mark.parametrize("seed", range(4))
+@pytest.mark.slow
 def test_random_ops_vs_oracle(seed):
     rng = np.random.default_rng(seed)
     kinds, poss, chs = [], [], []
@@ -101,6 +106,7 @@ def test_random_ops_vs_oracle(seed):
 
 
 @pytest.mark.parametrize("engine", ["v5", "v3", "v1"])
+@pytest.mark.slow
 def test_svelte_trace_byte_identical(svelte_trace, engine):
     tt = tensorize(svelte_trace, batch=512)
     eng = JaxDownstreamEngine(tt, engine=engine)
@@ -113,6 +119,7 @@ def test_svelte_trace_byte_identical(svelte_trace, engine):
 
 @pytest.mark.parametrize("engine", ["v3", "v1"])
 @pytest.mark.parametrize("seed", [3, 11])
+@pytest.mark.slow
 def test_random_ops_all_engines(seed, engine):
     """The non-default engines (positional v3, scatter v1) integrate the
     same random streams byte-identically."""
@@ -139,6 +146,7 @@ def test_random_ops_all_engines(seed, engine):
     assert eng.decode(eng.run()) == want
 
 
+@pytest.mark.slow
 def test_update_wire_size_reported(svelte_trace):
     tt = tensorize(svelte_trace, batch=512)
     upd = generate_updates(tt)

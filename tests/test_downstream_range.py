@@ -28,19 +28,23 @@ def check(patches, start="", batch_ops=4, n_replicas=1, epoch=2):
         assert eng.decode(state, replica=r) == want
 
 
+@pytest.mark.slow
 def test_block_appends():
     check([[0, 0, "hello "], [6, 0, "world"], [0, 0, ">> "]])
 
 
+@pytest.mark.slow
 def test_block_replace():
     check([[0, 0, "abcdefgh"], [2, 3, "XY"], [0, 1, "z"]])
 
 
+@pytest.mark.slow
 def test_same_batch_insert_then_delete_block():
     # insert a block and delete part of it within the same wire batch
     check([[0, 0, "abcdef"], [1, 3, ""], [1, 0, "Q"]], batch_ops=8)
 
 
+@pytest.mark.slow
 def test_delete_spanning_batches():
     check(
         [[0, 0, "abcdefghij"], [0, 0, "123"], [2, 8, "Z"]],
@@ -48,6 +52,7 @@ def test_delete_spanning_batches():
     )
 
 
+@pytest.mark.slow
 def test_multi_replica():
     check(
         [[0, 0, "hello"], [5, 0, " there"], [0, 2, "HE"]],
@@ -56,6 +61,7 @@ def test_multi_replica():
 
 
 @pytest.mark.parametrize("seed", [0, 3, 8])
+@pytest.mark.slow
 def test_random_block_edits_vs_oracle(seed):
     rng = np.random.default_rng(seed)
     patches = []
@@ -80,6 +86,7 @@ def test_random_block_edits_vs_oracle(seed):
     check(patches, batch_ops=8, epoch=4)
 
 
+@pytest.mark.slow
 def test_svelte_trace_byte_identical(svelte_trace):
     eng = JaxRangeDownstreamEngine(svelte_trace, batch_ops=256)
     state = eng.run()

@@ -46,6 +46,7 @@ def check(kinds, poss, chs, batch=8, start=""):
 A, B_, C_ = ord("a"), ord("b"), ord("c")
 
 
+@pytest.mark.slow
 def test_append_only():
     check([INSERT] * 4, [0, 1, 2, 3], [A, B_, C_, A])
 
@@ -72,6 +73,7 @@ def test_delete_batch_insert_same_batch():
     )
 
 
+@pytest.mark.slow
 def test_cross_batch_boundary():
     # batch=2 forces resolution state handoff across batches
     check([INSERT] * 5 + [DELETE] * 2, [0, 0, 1, 3, 2, 1, 1], [A, B_, C_, A, B_, 0, 0], batch=2)
@@ -87,6 +89,7 @@ def test_delete_then_insert_at_same_pos_across_batches():
 
 @pytest.mark.parametrize("seed", range(8))
 @pytest.mark.parametrize("batch", [4, 16, 64])
+@pytest.mark.slow
 def test_random_streams(seed, batch):
     """Property test: random valid unit-op streams, byte-identical replay."""
     rng = np.random.default_rng(seed)
@@ -107,6 +110,7 @@ def test_random_streams(seed, batch):
     check(kinds, poss, chs, batch=batch)
 
 
+@pytest.mark.slow
 def test_svelte_full_trace_byte_identical(svelte_trace):
     """Config 2 of BASELINE.json: sveltecomponent, 1 replica, CPU JAX backend,
     byte-identical final document."""
@@ -115,6 +119,7 @@ def test_svelte_full_trace_byte_identical(svelte_trace):
     assert got == svelte_trace.end_content
 
 
+@pytest.mark.slow
 def test_vmap_replicas_agree(svelte_trace):
     """4 replicas replaying the same trace must all converge byte-identically
     (the de-facto cross-implementation agreement test of the reference,
@@ -127,12 +132,34 @@ def test_vmap_replicas_agree(svelte_trace):
         assert eng.decode(state, replica=r) == svelte_trace.end_content
 
 
+@pytest.mark.slow
 def test_flagship_model_api(svelte_trace):
-    from crdt_benches_tpu.models.flagship import FlagshipConfig, upstream
-
-    cfg = FlagshipConfig(n_replicas=2, batch=256, resolver="scan")
-    eng = upstream(svelte_trace, cfg)
-    st = eng.run()
     import numpy as np
 
+    from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+    from crdt_benches_tpu.models.flagship import FlagshipConfig, upstream
+
+    # Default config IS the headline configuration bench.py runs.
+    dflt = FlagshipConfig()
+    assert (dflt.n_replicas, dflt.batch) == (1024, 1536)
+    assert dflt.layout == "auto" and dflt.range_engine == "v4"
+
+    # Small-shape instance of the same path: auto layout must resolve to
+    # the coalesced range engine with the v4 fused apply on a real trace.
+    cfg = FlagshipConfig(n_replicas=2, batch=256)
+    eng = upstream(svelte_trace, cfg)
+    assert isinstance(eng, RangeReplayEngine)
+    assert eng.engine == "v4"
+    st = eng.run()
     assert (np.asarray(st.nvis) == len(svelte_trace.end_content)).all()
+    assert eng.decode(st, replica=1) == svelte_trace.end_content
+
+    # The unit engine remains reachable as the differential twin.
+    from crdt_benches_tpu.engine.replay import ReplayEngine
+
+    ucfg = FlagshipConfig(n_replicas=2, batch=256, layout="unit",
+                          resolver="scan")
+    ueng = upstream(svelte_trace, ucfg)
+    assert isinstance(ueng, ReplayEngine)
+    ust = ueng.run()
+    assert (np.asarray(ust.nvis) == len(svelte_trace.end_content)).all()

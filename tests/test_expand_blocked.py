@@ -37,6 +37,7 @@ def _mk(rng, R, C, n_ins, nbits):
 
 @pytest.mark.parametrize("seed", [0, 2])
 @pytest.mark.parametrize("block_tiles", [8, 16])
+@pytest.mark.slow
 def test_blocked_matches_xla(seed, block_tiles):
     rng = np.random.default_rng(seed)
     R, C, n_ins = 2, 4096, 60  # nt=32, several blocks
@@ -54,6 +55,7 @@ def test_blocked_matches_xla(seed, block_tiles):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_blocked_dense_shifts_at_boundaries():
     """Inserts clustered right at a block boundary so the halo is
     exercised with near-maximal shifts."""
@@ -154,6 +156,7 @@ def test_blocked_on_silicon_boundary_shifts():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_blocked_pads_indivisible_tile_counts():
     # nt with no usable divisor (e.g. odd) must pad to a block multiple
     # instead of degrading to 1-tile blocks that cannot host the halo.

@@ -31,6 +31,7 @@ EXPECTED_STATS = {
 
 
 @pytest.mark.parametrize("name", list(EXPECTED_STATS))
+@pytest.mark.slow
 def test_stats_match_survey(name):
     trace = load_testing_data(name)
     stats = trace.stats()
@@ -39,6 +40,7 @@ def test_stats_match_survey(name):
     assert len(trace) == EXPECTED_STATS[name]["patches"]
 
 
+@pytest.mark.slow
 def test_all_traces_start_empty_end_ascii():
     for name in EXPECTED_STATS:
         trace = load_testing_data(name)

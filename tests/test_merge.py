@@ -41,6 +41,7 @@ def shuffled_log(log: OpLog, rng) -> OpLog:
                    ("lamport", "agent", "kind", "elem", "origin", "ch")))
 
 
+@pytest.mark.slow
 def test_single_agent_matches_local_replay():
     """With one agent, merging its op log must reproduce its local edit."""
     from crdt_benches_tpu.oracle import replay_unit_ops
@@ -60,6 +61,7 @@ def test_single_agent_matches_local_replay():
     assert got == want
 
 
+@pytest.mark.slow
 def test_two_agents_deterministic_vs_oracle():
     sim = sim_for(seed=0, n_agents=2, n_ops=20)
     state = sim.merge()
@@ -69,6 +71,7 @@ def test_two_agents_deterministic_vs_oracle():
 
 
 @pytest.mark.parametrize("seed", range(5))
+@pytest.mark.slow
 def test_random_agents_vs_oracle(seed):
     sim = sim_for(seed=seed, n_agents=3, n_ops=40)
     got = sim.decode(sim.merge())
@@ -76,6 +79,7 @@ def test_random_agents_vs_oracle(seed):
     assert got == want
 
 
+@pytest.mark.slow
 def test_delivery_order_independence():
     """Fault injection: shuffled delivery must converge to the same doc."""
     sim = sim_for(seed=1, n_agents=3, n_ops=30)
@@ -86,6 +90,7 @@ def test_delivery_order_independence():
         assert got == want
 
 
+@pytest.mark.slow
 def test_duplicated_delivery_idempotent():
     """Fault injection: every update delivered twice -> same doc."""
     sim = sim_for(seed=2, n_agents=2, n_ops=25)
@@ -96,6 +101,7 @@ def test_duplicated_delivery_idempotent():
     assert got == want
 
 
+@pytest.mark.slow
 def test_batch_size_independence():
     """The same op set merged with different batch sizes must agree (batch
     boundaries are an implementation detail, not semantics)."""
@@ -107,6 +113,7 @@ def test_batch_size_independence():
     assert sim16.decode(sim16.merge()) == sim4.decode(sim4.merge())
 
 
+@pytest.mark.slow
 def test_empty_base_concurrent_typing():
     """Two agents typing concurrently from an empty doc: both texts survive
     in full, in a deterministic interleaving."""
@@ -136,6 +143,7 @@ def test_concurrent_delete_same_element():
     assert "b" not in got and "Z" in got
 
 
+@pytest.mark.slow
 def test_sharded_merge_divergent_replicas_converge():
     """8 divergent replicas (one agent each) sharded over the 8-device CPU
     mesh: all_gather the op logs, every replica integrates the union, all

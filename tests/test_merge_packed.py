@@ -16,6 +16,7 @@ from test_merge import make_stream, shuffled_log, sim_for
 
 
 @pytest.mark.parametrize("seed", range(5))
+@pytest.mark.slow
 def test_packed_vs_oracle_and_v1(seed):
     sim = sim_for(seed=seed, n_agents=3, n_ops=40)
     want = merge_oracle(sim.log, "base text", np.asarray(sim.chars))
@@ -24,6 +25,7 @@ def test_packed_vs_oracle_and_v1(seed):
     assert got == want
 
 
+@pytest.mark.slow
 def test_packed_replica_batched():
     sim = sim_for(seed=9, n_agents=2, n_ops=30)
     want = sim.decode(sim.merge())
@@ -45,6 +47,7 @@ def test_packed_replica_batched():
         assert got == want
 
 
+@pytest.mark.slow
 def test_packed_delivery_order_and_duplication():
     sim = sim_for(seed=4, n_agents=3, n_ops=30)
     rng = np.random.default_rng(11)
@@ -56,6 +59,7 @@ def test_packed_delivery_order_and_duplication():
     assert got == want
 
 
+@pytest.mark.slow
 def test_packed_epoch_and_batch_independence():
     rng = np.random.default_rng(6)
     base = "shared"
@@ -68,6 +72,7 @@ def test_packed_epoch_and_batch_independence():
     assert sim8.decode(sim8.merge_packed(epoch=4)) == want
 
 
+@pytest.mark.slow
 def test_packed_deep_chains_single_anchor():
     """Adversarial shape: every agent types at position 0 (deep
     same-anchor sibling chains + long internal runs)."""
@@ -87,6 +92,7 @@ def test_packed_deep_chains_single_anchor():
     assert sim.decode(sim.merge_packed(epoch=4)) == want
 
 
+@pytest.mark.slow
 def test_native_treap_agrees_small():
     """The independent native RGA treap (separate implementation, C++)
     agrees with both the Python oracle and the packed kernel."""
@@ -104,6 +110,7 @@ def test_native_treap_agrees_small():
         assert sim.decode(sim.merge_packed()) == want
 
 
+@pytest.mark.slow
 def test_native_treap_agrees_100k_ops_24_agents():
     """Independent large-scale validation (VERDICT round 1 item 6): >=100k
     ops across dozens of agents, cross-checked against the native treap's
@@ -129,6 +136,7 @@ def test_native_treap_agrees_100k_ops_24_agents():
     assert got == want
 
 
+@pytest.mark.slow
 def test_sharded_packed_merge_converges():
     """8 divergent replicas sharded over the 8-device CPU mesh, merged on
     the packed fast path: union exchange via all_gather, id-resolved

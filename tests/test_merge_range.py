@@ -23,6 +23,7 @@ def _sim(seeds, base, batch=16, n_ops=60):
     return MergeSimulation(streams, base=base, batch=batch)
 
 
+@pytest.mark.slow
 def test_runs_roundtrip_counts():
     sim = _sim([0, 1], base="shared base text here")
     for log in sim.agent_logs:
@@ -37,6 +38,7 @@ def test_runs_roundtrip_counts():
         assert (rl.rlen >= 1).all()
 
 
+@pytest.mark.slow
 def test_no_skip_holds_for_diverged_agents():
     sim = _sim([2, 3, 4], base="the shared base document ")
     assert check_no_skip(
@@ -45,6 +47,7 @@ def test_no_skip_holds_for_diverged_agents():
 
 
 @pytest.mark.parametrize("seeds", [[0, 1], [2, 3, 4], [5, 6, 7, 8]])
+@pytest.mark.slow
 def test_run_merge_matches_unit_merge(seeds):
     base = "concurrent editing from a shared base "
     sim = _sim(seeds, base=base, n_ops=50)
@@ -57,6 +60,7 @@ def test_run_merge_matches_unit_merge(seeds):
     assert (np.asarray(st.nvis) == len(want)).all()
 
 
+@pytest.mark.slow
 def test_run_merge_empty_base():
     sim = _sim([9, 10], base="", n_ops=40)
     want = sim.decode(sim.merge())
@@ -65,6 +69,7 @@ def test_run_merge_empty_base():
     assert rm.decode(st) == want
 
 
+@pytest.mark.slow
 def test_run_merge_batch_epoch_independence():
     sim = _sim([11, 12], base="invariance base ", n_ops=45)
     want = sim.decode(sim.merge())
@@ -73,6 +78,7 @@ def test_run_merge_batch_epoch_independence():
         assert rm.decode(rm.merge()) == want, (batch, epoch)
 
 
+@pytest.mark.slow
 def test_run_merge_traces_prefix(rustcode_trace, seph_trace):
     import dataclasses
 
@@ -88,6 +94,7 @@ def test_run_merge_traces_prefix(rustcode_trace, seph_trace):
     assert rm.decode(st) == want
 
 
+@pytest.mark.slow
 def test_nbits_sized_on_sorted_batches():
     # Interleaved key ranges with uneven run lengths: per-batch char sums
     # must be computed on the SORTED batch layout the device integrates
@@ -108,6 +115,7 @@ def test_nbits_sized_on_sorted_batches():
     assert rm.decode(rm.merge()) == want
 
 
+@pytest.mark.slow
 def test_delete_only_union():
     # A union with zero insert runs must not divide by zero: the base
     # document with deletes folded is the converged result.
@@ -134,6 +142,7 @@ def test_capacity_guard():
         RunMergeSimulation(sim, batch=4)
 
 
+@pytest.mark.slow
 def test_run_downstream_backend_byte_identical():
     # single-writer special case: the run merge as a downstream apply
     from crdt_benches_tpu.engine.merge_range import JaxRunDownstreamBackend
@@ -153,6 +162,7 @@ def test_run_downstream_backend_byte_identical():
     assert b.final_content() == want
 
 
+@pytest.mark.slow
 def test_patch_granularity_downstream_byte_identical():
     """The strict like-for-like wire (granularity='patch'): one update
     per trace patch component, NO cross-patch RLE coalescing — matching

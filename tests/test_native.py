@@ -45,6 +45,7 @@ def test_replay_svelte_byte_identical(svelte_trace, backend):
 
 
 @pytest.mark.parametrize("backend", [CppRope, CppCrdt])
+@pytest.mark.slow
 def test_replay_all_traces_length(request, backend):
     for fixture in ("rustcode_trace", "seph_trace", "automerge_trace"):
         trace = request.getfixturevalue(fixture)
@@ -220,6 +221,7 @@ def test_byte_offset_crdt_backend():
     assert r.content() == "hXllo"
 
 
+@pytest.mark.slow
 def test_byte_offset_crdt_replay_rustcode(rustcode_trace):
     """Full rustcode replay in byte units through the CRDT engine,
     byte-identical to the oracle (stricter than the reference's
@@ -283,6 +285,7 @@ def test_cola_random_differential_lengths():
         assert len(r) == shadow
 
 
+@pytest.mark.slow
 def test_cola_replay_all_traces_length(request):
     """Full four-trace replay through the one-call native path, in UTF-8
     byte units (the runner's EDITS_USE_BYTE_OFFSETS path), asserting the

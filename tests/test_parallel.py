@@ -21,6 +21,7 @@ needs_8 = pytest.mark.skipif(
 
 
 @needs_8
+@pytest.mark.slow
 def test_sharded_replay_converges(svelte_trace):
     """16 replicas over 8 devices replay sveltecomponent's first batches;
     digests agree across devices and match the single-replica engine."""
@@ -58,6 +59,7 @@ def test_sharded_replay_converges(svelte_trace):
 
 
 @needs_8
+@pytest.mark.slow
 def test_sharded_divergence_detected():
     """A tampered replica (one visibility bit flipped after replay) must
     break the cross-device convergence verdict."""
@@ -89,6 +91,7 @@ def test_sharded_divergence_detected():
     assert not bool(np.asarray(converged2))
 
 
+@pytest.mark.slow
 def test_entry_and_dryrun():
     import __graft_entry__ as g
 

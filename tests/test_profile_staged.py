@@ -1,0 +1,61 @@
+"""Lockstep guard for the profiler's staged pipeline replica.
+
+tools/profile.py `range` profiles a truncated copy of
+ops/apply_range_fused.apply_range_batch4 (stages cut after each spread).
+The round-4 profilers rotted against live signature changes because
+nothing executed them in CI; this test pins the stage-3 replica to the
+real function bit-exactly so any future drift fails loudly.
+"""
+
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+
+@pytest.mark.parametrize(
+    "batch", [16, pytest.param(1536, marks=pytest.mark.slow)]
+)
+def test_range_staged_matches_apply_range_batch4(batch):
+    import jax.numpy as jnp
+
+    from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+    from crdt_benches_tpu.ops.apply2 import init_state4
+    from crdt_benches_tpu.ops.apply_range_fused import apply_range_batch4
+    from crdt_benches_tpu.ops.resolve_range_pallas import (
+        resolve_range_pallas,
+    )
+    from crdt_benches_tpu.traces.synth import synth_trace
+    from crdt_benches_tpu.traces.tensorize import tensorize_ranges
+    from tools.profile import _range_staged
+
+    trace = synth_trace(seed=5, n_ops=2 * batch, base="staged lockstep ")
+    rt = tensorize_ranges(trace, batch=batch)
+    eng = RangeReplayEngine(rt, n_replicas=2, interpret=True, chunk=4)
+    kind_b, pos_b, rlen_b, slot0_b = rt.batched()
+
+    st = init_state4(2, eng.capacity, eng.n_init)
+    tokens, dints, _ = jax.jit(resolve_range_pallas,
+                               static_argnames=("interpret",))(
+        jnp.asarray(kind_b[0]), jnp.asarray(pos_b[0]),
+        jnp.asarray(rlen_b[0]), jnp.asarray(slot0_b[0]),
+        st.nvis, interpret=True,
+    )
+
+    want = apply_range_batch4(st, tokens, dints, nbits=eng.nbits,
+                              interpret=True)
+    doc, cv, vt, length2 = _range_staged(
+        st, tokens, dints, eng.nbits, stage=3, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(doc), np.asarray(want.doc))
+    np.testing.assert_array_equal(np.asarray(vt), np.asarray(want.vis_tile))
+    np.testing.assert_array_equal(
+        np.asarray(length2), np.asarray(want.length)
+    )
+    # earlier stages must at least trace/execute (shape-level lockstep)
+    for stage in (0, 1, 2):
+        out = _range_staged(st, tokens, dints, eng.nbits, stage)
+        assert out.shape == (2, 1)

@@ -1,0 +1,118 @@
+"""Quick-tier engine coverage: one tiny oracle-checked case per engine
+family, so `pytest -m "not slow"` exercises every engine's small shapes
+even though the heavy differential suites are marked slow (VERDICT r4
+task 7).  Every test here must stay in the low single-digit seconds on a
+single CPU core — anything bigger belongs in the slow tier.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.oracle import OracleDocument
+from crdt_benches_tpu.traces.synth import synth_trace
+from crdt_benches_tpu.traces.tensorize import tensorize, tensorize_ranges
+
+from test_merge import sim_for
+
+
+def _oracle(trace):
+    doc = OracleDocument.from_str(trace.start_content)
+    for p, d, ins in trace.iter_patches():
+        doc.replace(p, p + d, ins)
+    return doc.content()
+
+
+@pytest.fixture(scope="module")
+def tiny_trace():
+    return synth_trace(seed=21, n_ops=60, base="quick smoke ")
+
+
+@pytest.mark.parametrize("engine", ["v3", "v4"])
+def test_unit_engine(tiny_trace, engine):
+    from crdt_benches_tpu.engine.replay import ReplayEngine
+
+    tt = tensorize(tiny_trace, batch=16)
+    eng = ReplayEngine(tt, n_replicas=2, resolver="scan", engine=engine,
+                       pack=2)
+    st = eng.run()
+    assert eng.decode(st, replica=1) == _oracle(tiny_trace)
+
+
+@pytest.mark.parametrize("engine", ["v3", "v4"])
+def test_range_engine(tiny_trace, engine):
+    from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+
+    rt = tensorize_ranges(tiny_trace, batch=16, coalesce=True)
+    eng = RangeReplayEngine(rt, n_replicas=2, interpret=True, chunk=4,
+                            engine=engine)
+    st = eng.run()
+    assert eng.decode(st, replica=1) == _oracle(tiny_trace)
+
+
+def test_downstream_v5(tiny_trace):
+    from crdt_benches_tpu.engine.downstream import JaxDownstreamEngine
+
+    tt = tensorize(tiny_trace, batch=16)
+    eng = JaxDownstreamEngine(tt, n_replicas=2)
+    st = eng.run()
+    assert eng.decode(st, replica=1) == _oracle(tiny_trace)
+
+
+def test_downstream_range(tiny_trace):
+    from crdt_benches_tpu.engine.downstream_range import (
+        JaxRangeDownstreamEngine,
+    )
+    from crdt_benches_tpu.traces.loader import TestData
+
+    want = _oracle(tiny_trace)
+    trace = TestData(tiny_trace.start_content, want, tiny_trace.txns)
+    eng = JaxRangeDownstreamEngine(trace, n_replicas=1, batch_ops=8,
+                                   epoch=2)
+    assert eng.decode(eng.run()) == want
+
+
+def test_merge_v1_and_packed():
+    from crdt_benches_tpu.engine.merge import merge_oracle
+
+    sim = sim_for(seed=2, n_agents=2, n_ops=12, batch=8)
+    want = merge_oracle(sim.log, "base text", np.asarray(sim.chars))
+    assert sim.decode(sim.merge()) == want
+    assert sim.decode(sim.merge_packed()) == want
+
+
+def test_merge_runs():
+    from crdt_benches_tpu.engine.merge_range import RunMergeSimulation
+
+    sim = sim_for(seed=3, n_agents=2, n_ops=12, batch=8)
+    want = sim.decode(sim.merge())
+    rm = RunMergeSimulation(sim, batch=8, epoch=2)
+    assert rm.decode(rm.merge()) == want
+
+
+def test_checkpoint_roundtrip(tiny_trace, tmp_path):
+    from crdt_benches_tpu.engine.replay import ReplayEngine
+    from crdt_benches_tpu.utils.checkpoint import load_state, save_state
+
+    tt = tensorize(tiny_trace, batch=16)
+    eng = ReplayEngine(tt, n_replicas=1, resolver="scan")
+    st = eng.run_blocking()
+    path = str(tmp_path / "smoke.npz")
+    save_state(path, st)
+    import jax.numpy as jnp
+
+    st2 = type(st)(*(jnp.asarray(x) for x in load_state(path)))
+    assert eng.decode(st2) == _oracle(tiny_trace)
+
+
+def test_resolver_token_cap(tiny_trace):
+    from crdt_benches_tpu.ops.resolve_pallas import resolve_batch_pallas
+
+    tt = tensorize(tiny_trace, batch=16)
+    kind_b, pos_b, _, _ = tt.batched()
+    v = np.full((2,), len(tt.init_chars), np.int32)
+    full = resolve_batch_pallas(kind_b[0], pos_b[0], v, interpret=True)
+    capped = resolve_batch_pallas(
+        kind_b[0], pos_b[0], v, interpret=True, token_cap=128
+    )
+    for f, c in zip(full, capped):
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(c))

@@ -22,6 +22,16 @@ def _oracle(trace):
     return doc.content()
 
 
+@pytest.fixture(params=["v3", "v4"])
+def range_apply(request, monkeypatch):
+    """Run the test under both range-apply engines: v4 (fused kernel,
+    the default) AND v3 (the per-pass XLA apply the driver auto-falls
+    back to on large-capacity TPU runs).  interpret-mode CI otherwise
+    never touches v3 (ADVICE r4)."""
+    monkeypatch.setenv("CRDT_RANGE_APPLY", request.param)
+    return request.param
+
+
 def test_tensorize_ranges_invariants(svelte_trace):
     rt = tensorize_ranges(svelte_trace, batch=256)
     tt = tensorize(svelte_trace, batch=256)
@@ -36,7 +46,8 @@ def test_tensorize_ranges_invariants(svelte_trace):
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 5])
 @pytest.mark.parametrize("batch", [16, 64])
-def test_range_engine_vs_oracle_synth(seed, batch):
+@pytest.mark.slow
+def test_range_engine_vs_oracle_synth(seed, batch, range_apply):
     trace = synth_trace(seed=seed, n_ops=250, base="range engine test ")
     rt = tensorize_ranges(trace, batch=batch)
     eng = RangeReplayEngine(rt, n_replicas=2, interpret=True, chunk=4)
@@ -47,7 +58,8 @@ def test_range_engine_vs_oracle_synth(seed, batch):
     assert (eng.lengths(st) == len(want)).all()
 
 
-def test_range_engine_block_edits():
+@pytest.mark.slow
+def test_range_engine_block_edits(range_apply):
     # Big block inserts/deletes (the rustcode-style workload).
     from crdt_benches_tpu.traces.loader import TestData, TestPatch, TestTxn
 
@@ -81,6 +93,7 @@ def test_range_engine_block_edits():
     assert eng.decode(st) == content
 
 
+@pytest.mark.slow
 def test_range_matches_exploded_v3(svelte_trace):
     # Prefix of the real svelte trace through both engines.
     import dataclasses
@@ -144,7 +157,8 @@ def test_coalesce_oracle_equivalence_synth(seed):
     assert n_coal <= sum(len(t.patches) for t in trace.txns) * 2
 
 
-def test_coalesced_range_engine_byte_identical(svelte_trace):
+@pytest.mark.slow
+def test_coalesced_range_engine_byte_identical(svelte_trace, range_apply):
     rt = tensorize_ranges(svelte_trace, batch=256, coalesce=True)
     rt_plain = tensorize_ranges(svelte_trace, batch=256)
     assert rt.n_ops < rt_plain.n_ops // 2  # the point: far fewer ops
@@ -155,6 +169,30 @@ def test_coalesced_range_engine_byte_identical(svelte_trace):
     assert eng.decode(st, replica=1) == svelte_trace.end_content
 
 
+def test_del_stop_shift_bounds():
+    from crdt_benches_tpu.ops.apply_range_fused import _del_stop_shift
+
+    for B in (1, 16, 512, 1024):
+        assert _del_stop_shift(B) == 14  # historical packing preserved
+    for B in (1025, 1536, 2048, 3000, 4095):
+        sh = _del_stop_shift(B)
+        assert (1 << sh) > B  # field holds counts up to B
+        assert B * ((1 << sh) + 1) <= 1 << 24  # f32-exact accumulation
+    with pytest.raises(ValueError):
+        _del_stop_shift(4096)  # first B where no single packing is exact
+
+
+@pytest.mark.slow
+def test_range_engine_wide_batch_byte_identical():
+    # B > 1024 routes the delete-boundary spread through the narrowed
+    # stop-shift (_del_stop_shift); the headline config runs B=1536.
+    trace = synth_trace(seed=11, n_ops=2600, base="wide batch dsh test ")
+    rt = tensorize_ranges(trace, batch=1536)
+    eng = RangeReplayEngine(rt, n_replicas=1, interpret=True, chunk=4)
+    assert eng.decode(eng.run()) == _oracle(trace)
+
+
+@pytest.mark.slow
 def test_range_token_cap_exact(svelte_trace):
     # The capped resolver must produce byte-identical replay: the host
     # simulation (simulate_range_token_counts) bounds the real token list.

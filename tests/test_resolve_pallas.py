@@ -41,6 +41,7 @@ def _random_stream(rng, n, v0):
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 @pytest.mark.parametrize("v0", [0, 7, 40])
+@pytest.mark.slow
 def test_pallas_matches_scan_resolver(seed, v0):
     rng = np.random.default_rng(seed)
     B = 64
@@ -64,6 +65,7 @@ def test_pallas_matches_scan_resolver(seed, v0):
 
 
 @pytest.mark.parametrize("seed", [0, 5])
+@pytest.mark.slow
 def test_replay_r_scan_resolver_vs_oracle(seed):
     trace = synth_trace(seed=seed, n_ops=300, base="hello pallas world")
     tt = tensorize(trace, batch=32)
@@ -76,6 +78,7 @@ def test_replay_r_scan_resolver_vs_oracle(seed):
     assert eng.decode(st, replica=1) == doc.content()
 
 
+@pytest.mark.slow
 def test_replay_r_chunking_invariant():
     trace = synth_trace(seed=9, n_ops=200, base="chunks")
     tt = tensorize(trace, batch=16)

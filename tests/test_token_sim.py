@@ -51,6 +51,7 @@ def _compare_capped(kind_b, pos_b, n_init):
 
 
 @pytest.mark.parametrize("seed", [0, 5])
+@pytest.mark.slow
 def test_random_streams_capped_equals_uncapped(seed):
     rng = np.random.default_rng(seed)
     B = 64
@@ -60,12 +61,14 @@ def test_random_streams_capped_equals_uncapped(seed):
     )
 
 
+@pytest.mark.slow
 def test_svelte_chunk_capped_equals_uncapped(svelte_trace):
     tt = tensorize(svelte_trace, batch=128)
     kind_b, pos_b, _, _ = tt.batched()
     _compare_capped(kind_b[:4], pos_b[:4], n_init=len(tt.init_chars))
 
 
+@pytest.mark.slow
 def test_simulated_counts_bounded(svelte_trace):
     """Sim never exceeds the kernel's worst case and covers the typing
     regime (~B+2 tokens) the engine relies on."""

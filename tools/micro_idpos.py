@@ -3,7 +3,7 @@
 The merge/downstream integration needs: given B element ids per replica,
 find their current physical positions in the packed doc (R, C).  Candidate
 building blocks measured here on the real chip (same one-scan-K-iters
-methodology as profile_hotpath.py):
+methodology as tools/profile.py):
 
   a) snapshot rebuild, scatter form:   pos_by_slot[doc[p]] = p   (R, C)
   b) snapshot rebuild, argsort form:   argsort of slot keys      (R, C)

@@ -1,0 +1,587 @@
+"""One profiling entry point for the hot paths (replaces the per-round
+profile_* script generations; git history preserves the retired ones).
+
+Subcommands:
+  hotpath     unit-op replay path: resolver / apply_batch{3,4} / sub-pieces
+  range       fused range path: staged apply_range_batch4 pipeline deltas
+  downstream  run/patch downstream apply: fragments / query / apply5 / spreads
+  trace       jax.profiler device trace of a few replay chunks, top ops
+
+Methodology (all subcommands): every dispatch on this runtime costs ~25ms
+round trip, so each component is timed as K iterations inside ONE jitted
+lax.scan, subtracting a no-op scan of the same length; sync is by value
+fetch.  Run on the real chip.
+
+Usage: python tools/profile.py <subcommand> [R] [B] [trace] [K] [extra]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from crdt_benches_tpu.traces.loader import load_testing_data  # noqa: E402
+
+
+def fetch(x):
+    return np.asarray(jax.tree.leaves(x)[-1]).reshape(-1)[0]
+
+
+def timeit(fn, n=5, warmup=2):
+    for _ in range(warmup):
+        fetch(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return (time.perf_counter() - t0) / n
+
+
+def scan_k(body, init, K):
+    @jax.jit
+    def run(init):
+        return jax.lax.scan(body, init, None, length=K)[0]
+
+    return lambda: run(init)
+
+
+def noop_floor(K):
+    return timeit(scan_k(lambda c, _: (c + 1, None), jnp.zeros((8, 128)), K))
+
+
+# --------------------------------------------------------------------------
+# hotpath: the unit-op replay path
+# --------------------------------------------------------------------------
+
+
+def cmd_hotpath(args):
+    from crdt_benches_tpu.engine.replay import ReplayEngine
+    from crdt_benches_tpu.ops.resolve_pallas import resolve_batch_pallas
+    from crdt_benches_tpu.traces.tensorize import tensorize
+
+    R, B, K = args.R, args.B, args.K
+    trace = load_testing_data(args.trace)
+    tt = tensorize(trace, batch=B)
+    eng = ReplayEngine(tt, n_replicas=R)
+    C = eng.capacity
+    n_ops = len(trace)
+    print(f"R={R} B={B} C={C} n_batches={tt.n_batches} trace={args.trace} K={K}")
+
+    mid = tt.n_batches // 2
+    kind_b, pos_b, _, slot_b = tt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    slot = jnp.asarray(slot_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+
+    base = noop_floor(K)
+    print(f"no-op scan floor:      {base/K*1e3:8.3f} ms/iter")
+
+    def res_body(carry, _):
+        r = resolve_batch_pallas(kind, pos, carry, emit_origin=False)
+        return carry + r.del_rank[:, 0] * 0 + r.ins_gvis[:, -1] * 0, None
+
+    t = (timeit(scan_k(res_body, v0, K)) - base) / K
+    print(f"resolver+extract:      {t*1e3:8.3f} ms/batch"
+          f"  -> {t/B*1e9/R:8.1f} ns/op/replica")
+
+    from crdt_benches_tpu.ops.apply2 import (
+        _mxu_spread,
+        apply_batch3,
+        apply_batch4,
+        init_state3,
+        init_state4,
+        rank_to_phys2,
+    )
+
+    resolved = jax.tree.map(
+        jnp.asarray, resolve_batch_pallas(kind, pos, v0, emit_origin=False)
+    )
+    st40 = init_state4(R, C, 0)
+
+    def ap4_body(st, _):
+        return apply_batch4(st, resolved, slot), None
+
+    t = (timeit(scan_k(ap4_body, st40, K)) - base) / K
+    print(f"apply_batch4:          {t*1e3:8.3f} ms/batch"
+          f"  -> {t/B*1e9/R:8.1f} ns/op/replica")
+
+    st0 = init_state3(R, C, 0)
+
+    def ap_body(st, _):
+        return apply_batch3(st, resolved, slot), None
+
+    t = (timeit(scan_k(ap_body, st0, K)) - base) / K
+    print(f"apply_batch3:          {t*1e3:8.3f} ms/batch"
+          f"  -> {t/B*1e9/R:8.1f} ns/op/replica")
+
+    # sub-pieces
+    from crdt_benches_tpu.ops.expand_pallas import expand_packed
+
+    cumvis = jnp.cumsum(jnp.bitwise_and(st0.doc, 1), axis=1)
+    q = jnp.clip(resolved.del_rank, 0, None)
+
+    def cv_body(carry, _):
+        c = jnp.cumsum(jnp.bitwise_and(carry, 1), axis=1)
+        return carry + (c[:, -1:] * 0), None
+
+    t = (timeit(scan_k(cv_body, st0.doc, K)) - base) / K
+    print(f"  cumsum (R,C):        {t*1e3:8.3f} ms")
+
+    def rp_body(carry, _):
+        r = rank_to_phys2(cumvis, q + carry[:, :1] * 0)
+        return carry + r[:, :1] * 0, None
+
+    t = (timeit(scan_k(rp_body, q, K)) - base) / K
+    print(f"  rank_to_phys2 x1:    {t*1e3:8.3f} ms")
+
+    def mx_body(carry, _):
+        (o,) = _mxu_spread(q, [carry * 0 + 1], C)
+        return carry + o[:, :1] * 0, None
+
+    t = (timeit(scan_k(mx_body, q, K)) - base) / K
+    print(f"  mxu_spread 1chunk:   {t*1e3:8.3f} ms")
+
+    cntind = jnp.cumsum(
+        jnp.zeros((R, C), jnp.int32).at[:, ::357].set(1), axis=1
+    )
+
+    def ex_body(carry, _):
+        return expand_packed(carry, cntind, nbits=10), None
+
+    t = (timeit(scan_k(ex_body, st0.doc, K)) - base) / K
+    print(f"  expand_packed:       {t*1e3:8.3f} ms")
+
+    def full():
+        s = eng.run()
+        return s.nvis
+
+    t = timeit(full, n=3, warmup=1)
+    eps = n_ops * R / t
+    print(f"full replay:           {t:8.3f} s"
+          f"  -> {t/n_ops*1e9/R:8.1f} ns/op/replica"
+          f"  -> aggregate {eps/1e6:.2f}M el/s")
+
+
+# --------------------------------------------------------------------------
+# range: staged deltas through the CURRENT apply_range_batch4 pipeline
+# --------------------------------------------------------------------------
+
+
+def _range_staged(state, tokens, dints, nbits, stage, interpret=False):
+    """Truncated replica of ops/apply_range_fused.apply_range_batch4:
+    stage 0 = token extract + rank queries, 1 = + delete-boundary spread,
+    2 = + insert-run/delta spreads, 3 = + fused kernel (stage 3 returns
+    the full (doc, cv, vt, length2) outputs).  Lockstep with the real
+    function is enforced by tests/test_profile_staged.py — stage 3 must
+    reproduce apply_range_batch4 bit-exactly (the r4 profilers rotted
+    against signature changes precisely because nothing checked them)."""
+    from crdt_benches_tpu.ops.apply2 import (
+        _excl_cumsum_small,
+        _mxu_spread,
+        count_le_two_level,
+    )
+    from crdt_benches_tpu.ops.apply_range import (
+        _prev_value,
+        extract_range_tokens,
+    )
+    from crdt_benches_tpu.ops.apply_range_fused import (
+        _del_stop_shift,
+        range_fused,
+    )
+
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    R, C = state.doc.shape
+    B = dlo.shape[1]
+    drop = jnp.int32(C + 7)
+
+    tile_base = _excl_cumsum_small(state.vis_tile)
+    tmax_abs = tile_base + state.vis_tile
+    has_del = dlo >= 0
+    live, gvis, cumlen = extract_range_tokens(
+        ttype, ta, tch, tlen, v0=state.nvis
+    )
+    allq = count_le_two_level(
+        state.cv_intile, tile_base, tmax_abs,
+        jnp.concatenate(
+            [jnp.where(has_del, dlo, 0), jnp.where(has_del, dhi, 0),
+             jnp.where(live, gvis, 0)], axis=1,
+        ),
+    )
+    lo_phys = allq[:, :B]
+    hi_phys = allq[:, B : 2 * B]
+    gq_phys = allq[:, 2 * B :]
+    if stage == 0:
+        return jnp.sum(allq, axis=1, keepdims=True)
+
+    at_end = gvis >= state.nvis[:, None]
+    g_phys = jnp.where(at_end, state.length[:, None], gq_phys)
+    dest0 = jnp.where(live, g_phys + cumlen, drop)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+
+    dsh = _del_stop_shift(B)
+    idxA = jnp.concatenate(
+        [jnp.where(has_del, lo_phys, drop),
+         jnp.where(has_del, hi_phys + 1, drop)], axis=1
+    )
+    pm = has_del.astype(jnp.int32)
+    (delpk,) = _mxu_spread(
+        idxA, [jnp.concatenate([pm, pm * (1 << dsh)], axis=1)], C, cb=4096
+    )
+    if stage == 1:
+        return jnp.sum(delpk, axis=1, keepdims=True)
+
+    lv = live.astype(jnp.int32)
+    (ind_d,) = _mxu_spread(
+        jnp.concatenate([dest0, dstop], axis=1),
+        [jnp.concatenate([lv, -lv], axis=1)], C, cb=4096,
+    )
+    delta = jnp.where(live, ta + tch - dest0, 0)
+    ddelta = jnp.where(live, delta - _prev_value(delta, live), 0)
+    sgn = jnp.where(ddelta < 0, -1, 1)
+    mag = jnp.abs(ddelta)
+    lvl = lambda k: sgn * jnp.left_shift(
+        jnp.bitwise_and(jnp.right_shift(mag, 7 * k), 127), 7 * k
+    )
+    (dd,) = _mxu_spread(
+        jnp.concatenate([dest0, dest0, dest0], axis=1),
+        [jnp.concatenate([lvl(0), lvl(1), lvl(2)], axis=1)], C, cb=4096,
+    )
+    if stage == 2:
+        return jnp.sum(delpk + ind_d + dd, axis=1, keepdims=True)
+
+    n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
+    length2 = state.length + n_ins
+    doc, cv, vt = range_fused(
+        state.doc, delpk, ind_d, dd, length2, nbits=nbits, dsh=dsh,
+        interpret=interpret,
+    )
+    return doc, cv, vt, length2
+
+
+def cmd_range(args):
+    from crdt_benches_tpu.engine.replay_range import RangeReplayEngine
+    from crdt_benches_tpu.ops.apply2 import PackedState4, init_state4
+    from crdt_benches_tpu.ops.resolve_range_pallas import (
+        resolve_range_pallas,
+    )
+    from crdt_benches_tpu.traces.tensorize import (
+        coalesce_patches,
+        tensorize_ranges,
+    )
+
+    R, B, K = args.R, args.B, args.K
+    trace = load_testing_data(args.trace)
+    if args.coalesce:
+        rt = tensorize_ranges(trace, batch=B, coalesce=True,
+                              patches=list(coalesce_patches(trace)))
+    else:
+        rt = tensorize_ranges(trace, batch=B)
+    eng = RangeReplayEngine(rt, n_replicas=R)
+    C = eng.capacity
+    nb = rt.n_batches
+    print(f"R={R} B={B} C={C} n_batches={nb} nbits={eng.nbits}"
+          f" coalesce={args.coalesce} K={K} engine={eng.engine}")
+
+    mid = nb // 2
+    kind_b, pos_b, rlen_b, slot0_b = rt.batched()
+    kind = jnp.asarray(kind_b[mid])
+    pos = jnp.asarray(pos_b[mid])
+    rlen = jnp.asarray(rlen_b[mid])
+    slot0 = jnp.asarray(slot0_b[mid])
+    v0 = jnp.full((R,), int(pos_b[mid].max()) + 1, jnp.int32)
+    tcap = eng.token_caps[min(mid // eng.chunk, len(eng.token_caps) - 1)]
+
+    st = init_state4(R, C, C // 2)
+    tokens, dints, _ = jax.jit(
+        functools.partial(resolve_range_pallas, token_cap=tcap)
+    )(kind, pos, rlen, slot0, v0)
+    print("T =", tokens[0].shape[1])
+
+    base = noop_floor(K)
+    print(f"floor: {base/K*1e3:.3f} ms/iter")
+
+    def res_body(c, _):
+        tk, di, nu = resolve_range_pallas(
+            kind, pos, rlen, slot0, c * 0 + v0, token_cap=tcap
+        )
+        return jnp.minimum(c, nu[:, 0]), None
+
+    t = (timeit(scan_k(res_body, v0, K)) - base) / K
+    print(f"{'resolver':26s} {t*1e3:9.3f} ms")
+
+    def make(stage):
+        @jax.jit
+        def run(doc, cv, vt, length, nvis, tokens, dints):
+            def b(c, _):
+                z = jnp.where(c == jnp.int32(-123456789), 1, 0)
+                stt = PackedState4(doc + z, cv, vt, length, nvis)
+                out = _range_staged(stt, tokens, dints, eng.nbits, stage)
+                if stage == 3:
+                    d, _cv, vtile, _l2 = out
+                    out = jnp.sum(d, axis=1, keepdims=True) + vtile[:, -1:]
+                return jnp.minimum(c, out), None
+            return jax.lax.scan(b, doc[:, :1], None, length=K)[0]
+        return lambda: run(st.doc, st.cv_intile, st.vis_tile, st.length,
+                           st.nvis, tokens, dints)
+
+    names = ["0 extract+queries", "1 + spread A (del)",
+             "2 + spread B (ind/dd)", "3 + fused kernel"]
+    prev = 0.0
+    for stage, name in enumerate(names):
+        t = (timeit(make(stage)) - base) / K
+        print(f"{name:26s} {t*1e3:9.3f} ms  (+{(t-prev)*1e3:8.3f})")
+        prev = t
+
+
+# --------------------------------------------------------------------------
+# downstream: run/patch downstream apply path
+# --------------------------------------------------------------------------
+
+
+def cmd_downstream(args):
+    from crdt_benches_tpu.engine.downstream import down_packed_init
+    from crdt_benches_tpu.engine.downstream_range import (
+        _apply_range_update_batch5,
+    )
+    from crdt_benches_tpu.engine.merge import MergeSimulation
+    from crdt_benches_tpu.engine.merge_range import (
+        BIGKEY,
+        RunMergeSimulation,
+        _run_batch_fragments,
+    )
+    from crdt_benches_tpu.ops.idpos import (
+        make_level_runs,
+        query,
+        snap_rebuild,
+    )
+    from crdt_benches_tpu.traces.tensorize import tensorize
+
+    R, W, K, EPOCH = args.R, args.B, args.K, args.epoch
+    trace = load_testing_data(args.trace)
+    tt = tensorize(trace, batch=512)
+    sim = MergeSimulation([tt], base=trace.start_content, batch=W)
+    ps = np.zeros(tt.n_ops, bool)
+    u = 0
+    for _pos, d, ins in trace.iter_patches():
+        ps[u] = True
+        u += d + len(ins)
+    rm = RunMergeSimulation(sim, batch=W, epoch=EPOCH, patch_starts=[ps])
+    C = sim.capacity
+    nb = len(rm.lamport) // W
+    print(f"R={R} W={W} C={C} n_runs={rm.n_runs} n_batches={nb}"
+          f" nbits={rm.nbits} epoch={EPOCH} trace={args.trace} K={K}")
+
+    mid = nb // 2
+    sl = slice(mid * W, (mid + 1) * W)
+    lam = jnp.asarray(rm.lamport[sl])
+    ag = jnp.asarray(rm.agent[sl])
+    s0 = jnp.asarray(rm.slot0[sl])
+    rl = jnp.asarray(rm.rlen[sl])
+    orig = jnp.asarray(rm.origin[sl])
+    key = jnp.where(rl > 0, lam * 1024 + ag, BIGKEY)
+
+    st = down_packed_init(R, C, C // 2)
+    snap = st.snap
+    neg1 = jnp.full((W,), -1, jnp.int32)
+
+    base = noop_floor(K)
+    print(f"no-op scan floor:        {base/K*1e3:8.3f} ms/iter")
+
+    def frag_body(carry, _):
+        fa, fr, fs, fl = _run_batch_fragments(key, s0, rl, orig + carry * 0)
+        return carry + fa[0] * 0 + fr[-1] * 0 + fs[0] * 0 + fl[0] * 0, None
+
+    t = (timeit(scan_k(frag_body, jnp.int32(0), K)) - base) / K
+    print(f"_run_batch_fragments:    {t*1e3:8.3f} ms/batch")
+
+    fa, fr, fs, fl = jax.jit(_run_batch_fragments)(key, s0, rl, orig)
+    bc = lambda x: jnp.broadcast_to(x[None], (R,) + x.shape)
+    lvl = jax.jit(make_level_runs)(
+        bc(jnp.abs(fa) % C), bc(fl), bc(jnp.maximum(fs, 0)), bc(fl > 0)
+    )
+    ids = bc(jnp.concatenate([jnp.maximum(fa, 0)] * 3))[:, : 3 * W]
+
+    for L in (0, EPOCH // 2, EPOCH - 1):
+        levels = [lvl] * L
+
+        def q_body(carry, _):
+            p = query(snap, levels, ids + carry[:, :1] * 0)
+            return carry + p[:, :1] * 0, None
+
+        t = (timeit(scan_k(q_body, ids, K)) - base) / K
+        print(f"query {L:2d} levels (3W):   {t*1e3:8.3f} ms/batch")
+
+    def sr_body(carry, _):
+        s = snap_rebuild(st.doc + carry[:, :1] * 0)
+        return carry + s[:, :1] * 0, None
+
+    t = (timeit(scan_k(sr_body, snap, K)) - base) / K
+    print(f"snap_rebuild:            {t*1e3:8.3f} ms   (1 per epoch)")
+
+    for L in (0, EPOCH // 2, EPOCH - 1):
+        levels = [lvl] * L
+
+        def ap_body(carry, _):
+            doc, length, nvis = carry
+            doc, length, nvis, _lv = _apply_range_update_batch5(
+                doc, length, nvis, snap, levels,
+                fa, fr, fs, fl, jnp.ones_like(fa),
+                jnp.concatenate([neg1, neg1]),
+                jnp.concatenate([neg1, neg1]),
+                nbits=rm.nbits,
+            )
+            return (doc, length, nvis), None
+
+        t = (
+            timeit(scan_k(ap_body, (st.doc, st.length, st.nvis), K)) - base
+        ) / K
+        print(f"apply5 {L:2d} levels:       {t*1e3:8.3f} ms/batch")
+
+    from crdt_benches_tpu.ops.apply2 import _mxu_spread
+
+    dest0 = jnp.broadcast_to(
+        (jnp.arange(2 * W, dtype=jnp.int32) * 37) % C, (R, 2 * W)
+    )
+    ones = jnp.ones((R, 2 * W), jnp.int32)
+
+    def sp_body(carry, _):
+        (s1,) = _mxu_spread(dest0 + carry[:, :1] * 0, [ones], C)
+        (s2,) = _mxu_spread(dest0 + 1, [ones], C)
+        ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+        return carry + ind[:, :1] * 0, None
+
+    t = (timeit(scan_k(sp_body, dest0, K)) - base) / K
+    print(f"2 spreads + cumsum:      {t*1e3:8.3f} ms/batch")
+
+    from crdt_benches_tpu.ops.expand_pallas import fused_apply_nocv_dispatch
+
+    combo = jnp.zeros((R, C), jnp.int32).at[:, ::357].set(5)
+    cnt_base = jnp.cumsum(
+        jnp.sum(combo.reshape(R, C // 128, 128) & 1, axis=2), axis=1
+    )
+    cnt_base = cnt_base - cnt_base[:, :1]
+
+    def fx_body(carry, _):
+        d = fused_apply_nocv_dispatch(
+            carry, combo, cnt_base, st.length, nbits=rm.nbits
+        )
+        return d, None
+
+    t = (timeit(scan_k(fx_body, st.doc, K)) - base) / K
+    print(f"fused expand+fill:       {t*1e3:8.3f} ms/batch")
+
+    allkey = jnp.asarray(
+        np.where(rm.rlen > 0, rm.lamport * 1024 + rm.agent, 2**31 - 1)
+    )
+
+    def srt_body(carry, _):
+        p = jnp.argsort(allkey + carry[0] * 0)
+        return carry + p[:1] * 0, None
+
+    t = (timeit(scan_k(srt_body, jnp.zeros(8, jnp.int32), K)) - base) / K
+    print(f"wire argsort (n_runs):   {t*1e3:8.3f} ms   (1 per merge)")
+
+
+# --------------------------------------------------------------------------
+# trace: jax.profiler device trace -> top ops
+# --------------------------------------------------------------------------
+
+
+def cmd_trace(args):
+    import glob
+    import gzip
+    import json
+    import os
+    from collections import defaultdict
+
+    from crdt_benches_tpu.engine.replay import (
+        ReplayEngine,
+        replay_batches_r4,
+    )
+    from crdt_benches_tpu.ops.apply2 import init_state4
+    from crdt_benches_tpu.traces.tensorize import tensorize
+
+    R, B, n_chunks = args.R, args.B, args.K
+    trace = load_testing_data(args.trace)
+    tt = tensorize(trace, batch=B)
+    eng = ReplayEngine(tt, n_replicas=R)
+    print(f"R={R} B={B} C={eng.capacity} chunks={len(eng.chunks)}")
+
+    st = init_state4(R, eng.capacity, eng.n_init)
+    for kind, pos, slot in eng.chunks[:2]:
+        st = replay_batches_r4(
+            st, kind, pos, slot, resolver=eng.resolver, pack=eng.pack
+        )
+    np.asarray(st.nvis)
+
+    logdir = "/tmp/jaxtrace"
+    os.system(f"rm -rf {logdir}")
+    jax.profiler.start_trace(logdir)
+    for kind, pos, slot in eng.chunks[2 : 2 + n_chunks]:
+        st = replay_batches_r4(
+            st, kind, pos, slot, resolver=eng.resolver, pack=eng.pack
+        )
+    np.asarray(st.nvis)
+    jax.profiler.stop_trace()
+
+    files = glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True)
+    print(files)
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    for f in files:
+        with gzip.open(f, "rt") as fh:
+            data = json.load(fh)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            dur = ev.get("dur", 0) / 1e3  # ms
+            if not name or dur <= 0:
+                continue
+            agg[name] += dur
+            cnt[name] += 1
+    items = sorted(agg.items(), key=lambda kv: -kv[1])
+    print(f"\ntop ops by total time (ms) over {n_chunks} chunks of "
+          f"{eng.chunk} batches:")
+    for name, ms in items[:40]:
+        print(f"  {ms:10.2f} ms  x{cnt[name]:5d}  {name[:110]}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    defaults = {
+        "hotpath": (128, 512, 32),
+        "range": (1024, 512, 8),
+        "downstream": (64, 512, 16),
+        "trace": (128, 512, 4),
+    }
+    for name, (dR, dB, dK) in defaults.items():
+        p = sub.add_parser(name)
+        p.add_argument("R", nargs="?", type=int, default=dR)
+        p.add_argument("B", nargs="?", type=int, default=dB,
+                       help="op batch (W for downstream)")
+        p.add_argument("trace", nargs="?", default="automerge-paper")
+        p.add_argument("K", nargs="?", type=int, default=dK,
+                       help="iters per scan (chunks for trace)")
+        if name == "range":
+            p.add_argument("coalesce", nargs="?", type=int, default=1)
+        if name == "downstream":
+            p.add_argument("epoch", nargs="?", type=int, default=8)
+    args = ap.parse_args()
+    {"hotpath": cmd_hotpath, "range": cmd_range,
+     "downstream": cmd_downstream, "trace": cmd_trace}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()
