@@ -99,7 +99,8 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
         return BenchResult(
             "upstream", trace_name, b.NAME, elements, times, replicas=replicas
         )
-    if backend in ("jax-pos", "jax-range", "jax-runs", "jax-patch"):
+    if backend in ("jax-pos", "jax-range", "jax-runs", "jax-patch",
+                   "jax-unitwire"):
         return None  # downstream-only variants
     raise ValueError(f"unknown backend {backend!r}")
 
@@ -138,7 +139,8 @@ def run_downstream(trace_name: str, backend: str, samples: int,
         times = measure(iter_fn, warmup=warmup, samples=samples,
                         min_sample_time=0.05)
         return BenchResult("downstream", trace_name, backend, elements, times)
-    if backend in ("jax", "jax-pos", "jax-range", "jax-runs", "jax-patch"):
+    if backend in ("jax", "jax-pos", "jax-range", "jax-runs", "jax-patch",
+                   "jax-unitwire"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
             from ..engine.downstream_range import JaxRangeDownstreamBackend
@@ -156,6 +158,10 @@ def run_downstream(trace_name: str, backend: str, samples: int,
         elif backend == "jax-patch":
             b = JaxRunDownstreamBackend(
                 n_replicas=replicas, granularity="patch"
+            )
+        elif backend == "jax-unitwire":
+            b = JaxRunDownstreamBackend(
+                n_replicas=replicas, granularity="unit"
             )
         else:
             b = JaxDownstreamBackend(
@@ -495,7 +501,8 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
         down, _ = CppCrdtDownstream.upstream_updates(trace)
         down.apply_all_native()
         return down.content() == want
-    if backend in ("jax", "jax-pos", "jax-range", "jax-runs", "jax-patch"):
+    if backend in ("jax", "jax-pos", "jax-range", "jax-runs", "jax-patch",
+                   "jax-unitwire"):
         try:
             from ..engine.downstream import JaxDownstreamBackend
             from ..engine.downstream_range import JaxRangeDownstreamBackend
@@ -513,6 +520,10 @@ def verify_downstream(trace_name: str, backend: str, replicas: int,
         elif backend == "jax-patch":
             b = JaxRunDownstreamBackend(
                 n_replicas=replicas, granularity="patch"
+            )
+        elif backend == "jax-unitwire":
+            b = JaxRunDownstreamBackend(
+                n_replicas=replicas, granularity="unit"
             )
         else:
             b = JaxDownstreamBackend(
@@ -676,7 +687,7 @@ def main(argv=None) -> int:
                     _report(r)
             if backend in (
                 "cpp-crdt", "jax", "jax-pos", "jax-range", "jax-runs",
-                "jax-patch",
+                "jax-patch", "jax-unitwire",
             ) and (not args.filter or args.filter in "downstream"):
                 r = run_downstream(trace, backend, args.samples, args.warmup,
                                    replicas=args.replicas, batch=args.batch)

@@ -544,6 +544,30 @@ class RunMergeSimulation:
             st = delete_fold(st, *self._dev_del)
         return st
 
+    def merge_flat(self, n_replicas: int = 1) -> DownPacked:
+        """Timed region of the ONE-SHOT schedule: the whole wire
+        integrates in a single fused pass (engine/downstream_flat.py —
+        segmented sort + pointer-doubling list rank), then the delete
+        fold.  Same wire tensors, same preconditions, same final state
+        as :meth:`merge`; no sequential batch loop."""
+        from .downstream_flat import flatten_runs
+
+        if not self.fast_ok:
+            raise ValueError(
+                "run-atomic precondition violated; use the unit merge"
+            )
+        lam, ag, s0, rl, orig = self._dev
+        key = jnp.where(rl > 0, lam * MAX_AGENTS + ag, BIGKEY)
+        st = flatten_runs(
+            key, s0, rl, orig,
+            n_base=self.sim.n_base, capacity=self.sim.capacity,
+            n_elems=self.sim.n_base + int(self.rlen.sum()),
+            n_replicas=n_replicas,
+        )
+        if self._dev_del is not None:
+            st = delete_fold(st, *self._dev_del)
+        return st
+
     def decode(self, state: DownPacked, replica: int = 0) -> str:
         from ..ops.apply2 import PackedState, decode_state3
 
@@ -587,17 +611,30 @@ class JaxRunDownstreamBackend:
         #: 'patch' = one wire update per trace patch component, NO
         #: cross-patch coalescing — the reference's own generation
         #: granularity (one update per patch, src/rope.rs:196-220), the
-        #: strict like-for-like downstream cell (VERDICT r3 weak #1).
-        if granularity not in ("coalesced", "patch"):
+        #: strict like-for-like downstream cell (VERDICT r3 weak #1);
+        #: 'unit' = one wire update per UNIT op (every run length 1) —
+        #: the v5 engine's wire granularity, finer than the reference's.
+        if granularity not in ("coalesced", "patch", "unit"):
             raise ValueError(f"unknown granularity {granularity!r}")
         self.granularity = granularity
+        #: apply schedule: 'flat' (default) = one-shot fused integration
+        #: (engine/downstream_flat.py); 'batched' = the epoch/batch scan
+        #: (merge_runlogs).  Same wire, same final state either way.
+        self.schedule = os.environ.get("CRDT_DOWN_SCHEDULE", "flat")
+        if self.schedule not in ("flat", "batched"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
         self._rm: RunMergeSimulation | None = None
 
     @property
     def NAME(self) -> str:
         plat = jax.devices()[0].platform
         tag = f"-r{self.n_replicas}" if self.n_replicas > 1 else ""
-        kind = "runs" if self.granularity == "coalesced" else "patch"
+        kind = {"coalesced": "runs", "patch": "patch",
+                "unit": "unitwire"}[self.granularity]
+        # the schedule changes the timed algorithm (one-shot flatten vs
+        # the r4 batched scan) — bench ids must stay distinguishable
+        # across rounds (code-review r5)
+        kind += "-flat" if self.schedule == "flat" else ""
         return f"jax-{plat}{tag}-{kind}"
 
     @property
@@ -620,6 +657,8 @@ class JaxRunDownstreamBackend:
                 u += d + len(ins)
             assert u == tt.n_ops
             patch_starts = [ps]
+        elif self.granularity == "unit":
+            patch_starts = [np.ones(tt.n_ops, bool)]
         self._rm = RunMergeSimulation(
             sim, batch=self.batch, epoch=self.epoch,
             patch_starts=patch_starts,
@@ -627,8 +666,15 @@ class JaxRunDownstreamBackend:
         assert self._rm.fast_ok  # single writer: always holds
         self._end_len = len(trace.end_content)
 
+    def _merge(self) -> DownPacked:
+        fn = (
+            self._rm.merge_flat if self.schedule == "flat"
+            else self._rm.merge
+        )
+        return fn(n_replicas=self.n_replicas)
+
     def replay_once(self) -> int:
-        state = self._rm.merge(n_replicas=self.n_replicas)
+        state = self._merge()
         lengths = np.asarray(state.nvis)  # device -> host sync point
         assert (lengths == self._end_len).all(), (
             f"length mismatch: {lengths} != {self._end_len}"
@@ -636,6 +682,6 @@ class JaxRunDownstreamBackend:
         return int(lengths.reshape(-1)[0])
 
     def final_content(self) -> str:
-        state = self._rm.merge(n_replicas=self.n_replicas)
+        state = self._merge()
         jax.block_until_ready(state)
         return self._rm.decode(state)

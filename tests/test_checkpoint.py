@@ -49,3 +49,40 @@ def test_checkpoint_roundtrip_downstream(tmp_path):
     st2 = load_state(path)
     for f in state._fields:
         assert (np.asarray(getattr(state, f)) == getattr(st2, f)).all()
+
+
+def test_checkpoint_bf16_state4_roundtrip(tmp_path):
+    """PackedState4 carries a bfloat16 field (cv_intile): np.savez alone
+    loses the dtype (loads as void |V2) — the dtype manifest must bring
+    it back bit-exactly (round-5 fix)."""
+    import ml_dtypes
+
+    from crdt_benches_tpu.ops.apply2 import init_state4
+
+    st = init_state4(2, 256, 7)
+    path = str(tmp_path / "s4.npz")
+    save_state(path, st)
+    st2 = load_state(path)
+    assert np.asarray(st2.cv_intile).dtype == np.dtype(ml_dtypes.bfloat16)
+    for f in st._fields:
+        a, b = np.asarray(getattr(st, f)), np.asarray(getattr(st2, f))
+        assert a.dtype == b.dtype and (a == b).all(), f
+
+
+def test_checkpoint_legacy_void_fails_loudly(tmp_path):
+    """A pre-manifest checkpoint with a bf16 field must raise a clear
+    error instead of returning opaque void arrays."""
+    import pytest
+
+    from crdt_benches_tpu.ops.apply2 import init_state4
+
+    st = init_state4(1, 128, 0)
+    path = str(tmp_path / "legacy.npz")
+    # simulate the old save format: raw arrays, no __dtypes__ manifest
+    arrays = {f: np.asarray(getattr(st, f)) for f in st._fields}
+    np.savez_compressed(
+        path, __class__=np.asarray("PackedState4"),
+        __fields__=np.asarray(st._fields), **arrays,
+    )
+    with pytest.raises(ValueError, match="legacy checkpoint"):
+        load_state(path)

@@ -1,0 +1,65 @@
+"""One-shot flatten integration (engine/downstream_flat.py): differential
+vs the batched run merge and the v1 unit merge, plus the downstream
+backend at all three wire granularities."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.engine.merge_range import (
+    JaxRunDownstreamBackend,
+    RunMergeSimulation,
+)
+
+from test_merge import sim_for
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+@pytest.mark.parametrize("agents", [1, 2, 5])
+def test_flat_matches_v1_merge(seed, agents):
+    sim = sim_for(seed=seed, n_agents=agents, n_ops=30, batch=8)
+    want = sim.decode(sim.merge())
+    rm = RunMergeSimulation(sim, batch=8, epoch=2)
+    if not rm.fast_ok:
+        pytest.skip("no-skip precondition fails for this stream")
+    got = rm.decode(rm.merge_flat(n_replicas=2), replica=1)
+    assert got == want
+
+
+def test_flat_empty_and_base_only():
+    from crdt_benches_tpu.engine.downstream_flat import flatten_runs
+    import jax.numpy as jnp
+
+    # base only, no runs: document = start content
+    key = jnp.full((4,), 2**31 - 1, jnp.int32)
+    z = jnp.zeros((4,), jnp.int32)
+    st = flatten_runs(
+        key, z - 1, z, z - 2,
+        n_base=3, capacity=128, n_elems=3, n_replicas=2,
+    )
+    snap = np.asarray(st.snap)
+    assert (snap[:, :3] == [0, 1, 2]).all()
+    assert (np.asarray(st.nvis) == 3).all()
+
+
+@pytest.mark.parametrize("granularity", ["patch", "unit", "coalesced"])
+@pytest.mark.slow
+def test_flat_backend_svelte_byte_identical(svelte_trace, granularity):
+    from crdt_benches_tpu.oracle import replay_trace
+
+    want = replay_trace(svelte_trace)
+    b = JaxRunDownstreamBackend(n_replicas=2, granularity=granularity)
+    b.prepare(svelte_trace)
+    assert b.schedule == "flat"
+    assert b.final_content() == want
+
+
+@pytest.mark.slow
+def test_flat_schedule_env_fallback(svelte_trace, monkeypatch):
+    # CRDT_DOWN_SCHEDULE=batched must still route through merge_runlogs
+    from crdt_benches_tpu.oracle import replay_trace
+
+    monkeypatch.setenv("CRDT_DOWN_SCHEDULE", "batched")
+    b = JaxRunDownstreamBackend(n_replicas=1, granularity="patch")
+    assert b.schedule == "batched"
+    b.prepare(svelte_trace)
+    assert b.final_content() == replay_trace(svelte_trace)
