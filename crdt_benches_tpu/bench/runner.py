@@ -100,8 +100,8 @@ def run_upstream(trace_name: str, backend: str, samples: int, warmup: int,
             "upstream", trace_name, b.NAME, elements, times, replicas=replicas
         )
     if backend in ("jax-pos", "jax-range", "jax-runs", "jax-patch",
-                   "jax-unitwire"):
-        return None  # downstream-only variants
+                   "jax-unitwire", "jax-flat"):
+        return None  # downstream/merge-only variants
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -407,6 +407,38 @@ def run_merge(config: str, backend: str, samples: int, warmup: int,
             "merge", config, f"jax-{plat}{tag}-range", elements, times,
             replicas=replicas,
         )
+    if backend == "jax-flat":
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine.downstream_flat import make_flat_merge
+        from ..utils.digest import doc_digest_packed
+
+        # one-shot unit-granularity merge: exact for ANY delivered
+        # stream (unit runs make the no-skip precondition vacuous),
+        # including the adversarial duplicated/shuffled delivery the
+        # run-granular cell must refuse.  make_flat_merge does the
+        # untimed wire translation + guards; the timed region is its
+        # returned callable (device dedup/sort/integrate) + digest.
+        merge_once = make_flat_merge(sim, delivered, n_replicas=replicas)
+        digest_r = jax.jit(
+            jax.vmap(doc_digest_packed, in_axes=(0, 0, None))
+        )
+
+        def iter_fn():
+            st = merge_once()
+            d = digest_r(st.doc, st.length, sim.chars)
+            assert bool(
+                np.asarray(jnp.all(jnp.min(d, 0) == jnp.max(d, 0)))
+            ), "replicas diverged"
+
+        times = measure(iter_fn, warmup=warmup, samples=samples)
+        plat = jax.devices()[0].platform
+        tag = f"-r{replicas}" if replicas > 1 else ""
+        return BenchResult(
+            "merge", config, f"jax-{plat}{tag}-flat", elements, times,
+            replicas=replicas,
+        )
     return None
 
 
@@ -545,7 +577,10 @@ def verify_merge(config: str, merge_ops: int, batch: int,
     must equal the independent native treap's (engine/merge.py
     native_merge_content), at the same schedule the timed cell uses.
     ``engine``: 'unit' = packed unit-op merge; 'range' = run-granular
-    merge (engine/merge_range.py)."""
+    merge (engine/merge_range.py); 'flat' = one-shot flatten
+    (engine/downstream_flat.py)."""
+    import numpy as np
+
     from ..backends.native import native_available
     from ..engine.merge import native_merge_content
 
@@ -553,6 +588,12 @@ def verify_merge(config: str, merge_ops: int, batch: int,
         return None
     sim = _merge_sim(config, merge_ops, batch)
     delivered = _delivered_log(sim, config, merge_ops)
+    if engine == "flat":
+        from ..engine.downstream_flat import make_flat_merge
+
+        st = make_flat_merge(sim, delivered, n_replicas=replicas)()
+        want = native_merge_content(sim, delivered)
+        return sim.decode(st) == want
     if engine == "range":
         if config == "adversarial":
             return None
@@ -634,7 +675,7 @@ def main(argv=None) -> int:
                         failures.append((group, trace, backend))
         if not args.filter or args.filter in "merge":
             for config in args.merge_configs.split(","):
-                for engine in ("unit", "range"):
+                for engine in ("unit", "range", "flat"):
                     ok = verify_merge(
                         config, args.merge_ops, args.batch, args.replicas,
                         args.epoch, engine=engine,

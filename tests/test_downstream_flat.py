@@ -63,3 +63,32 @@ def test_flat_schedule_env_fallback(svelte_trace, monkeypatch):
     assert b.schedule == "batched"
     b.prepare(svelte_trace)
     assert b.final_content() == replay_trace(svelte_trace)
+
+def _flat_unit_merge(sim, delivered, R=2):
+    from crdt_benches_tpu.engine.downstream_flat import make_flat_merge
+
+    return make_flat_merge(sim, delivered, n_replicas=R)()
+
+
+@pytest.mark.parametrize("seed", [0, 2, 5])
+@pytest.mark.parametrize("agents", [1, 2, 5])
+def test_flat_unit_log_duplicated_shuffled_delivery(seed, agents):
+    """The adversarial fault model: every op delivered 3x, shuffled.
+    flatten_unit_log must dedup on device and match the v1 merge (unit
+    runs make the no-skip precondition vacuous — exact for ANY log)."""
+    from crdt_benches_tpu.engine.merge import OpLog
+
+    from test_merge import shuffled_log
+
+    sim = sim_for(seed=seed, n_agents=agents, n_ops=30, batch=8)
+    want = sim.decode(sim.merge())
+    rng = np.random.default_rng(seed + 41)
+    delivered = shuffled_log(OpLog.concat([sim.log] * 3), rng)
+    got = sim.decode(_flat_unit_merge(sim, delivered))
+    assert got == want
+
+
+def test_flat_unit_log_plain_union():
+    sim = sim_for(seed=9, n_agents=3, n_ops=25, batch=8)
+    want = sim.decode(sim.merge())
+    assert sim.decode(_flat_unit_merge(sim, sim.log)) == want
