@@ -579,8 +579,6 @@ def verify_merge(config: str, merge_ops: int, batch: int,
     ``engine``: 'unit' = packed unit-op merge; 'range' = run-granular
     merge (engine/merge_range.py); 'flat' = one-shot flatten
     (engine/downstream_flat.py)."""
-    import numpy as np
-
     from ..backends.native import native_available
     from ..engine.merge import native_merge_content
 

@@ -129,24 +129,23 @@ class RangeReplayEngine:
             # Mosaic's unaligned sublane copies blow up compilation.
             lane = max(lane, 8 * 128)
         self.capacity = _round_up(max(rt.capacity, 1), lane)
-        if self.engine == "v4" and not interpret:
-            from ..ops.apply_range_fused import range_fused_fits
-
-            # Gate on the ROUNDED capacity the kernel actually sees.
-            if (
-                jax.default_backend() == "tpu"
-                and not range_fused_fits(self.capacity)
-            ):
-                self.engine = "v3"
+        # v4 no longer downgrades to v3 above the monolithic VMEM gate:
+        # apply_range_batch4 dispatches to the halo-blocked kernel there
+        # (ops/apply_range_fused.py range_fused_blocked, round-5).
+        # CRDT_RANGE_APPLY=v3 still forces the per-pass XLA apply.
         # Arithmetic-range preconditions of the packed spread paths: the
-        # run-delta spread carries |ddelta| <= 2*capacity in 3x7-bit
-        # chunks (< 2^21), so capacity must stay below 2^20 — fail loudly
-        # on oversized traces instead of silently truncating (ADVICE
-        # round 1).
-        if self.capacity >= 1 << 20:
+        # run-delta spread carries |ddelta| <= 2*capacity in
+        # ddelta_levels(capacity) 7-bit chunk levels (adaptive — 3 below
+        # 2^20, round-5 widening), and the fused kernel's shifted level
+        # accumulation stays int32-exact while 128 * 2 * capacity < 2^31,
+        # i.e. capacity <= 2^22 (ops/apply_range_fused.py kernel note).
+        # Fail loudly beyond instead of silently truncating (ADVICE r1).
+        if self.capacity > 1 << 22:
             raise ValueError(
-                f"capacity {self.capacity} >= 2^20 exceeds the packed-spread"
-                " arithmetic range (|ddelta| <= 2*capacity chunks)"
+                f"capacity {self.capacity} > 2^22 exceeds the fused range"
+                " kernel's int32 level-accumulation bound; use the unit"
+                " engine (proven to 2^21) or raise the bound with a"
+                " two-piece level reconstruction"
             )
         self.n_init = len(rt.init_chars)
         self.pack = pack

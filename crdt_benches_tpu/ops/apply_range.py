@@ -36,6 +36,14 @@ from .resolve import RUN, TINS
 _BIG = np.int32(1 << 30)
 
 
+def ddelta_levels(C: int) -> int:
+    """Number of 7-bit chunk levels needed to carry a signed per-run
+    slot-delta difference (|ddelta| <= 2C) through the one-hot spreads
+    and the fused kernel's in-kernel re-chunking.  3 for every capacity
+    below 2^20 (the historical packing); grows adaptively above."""
+    return max(3, -(-(2 * int(C)).bit_length() // 7))
+
+
 def _two_level_vis(doc, length):
     """Per-batch two-level visible-rank structure from the packed doc:
     (cv_intile bf16[R, C] within-tile inclusive cumsum — values <= 128,
@@ -153,21 +161,23 @@ def apply_range_batch(
     prev_live_delta = _prev_value(delta, live)
     ddelta = jnp.where(live, delta - prev_live_delta, 0)
     dpos_ = jnp.where(live, dest0, drop)
+    # |ddelta| <= 2C: derive the 7-bit chunk count from the static
+    # capacity (3 levels covered only C < 2^20 — round-5 widening; each
+    # level's values are bf16-exact shifted small ints and every cell
+    # receives at most one contribution, so exactness is per-level).
+    dlv = ddelta_levels(C)
+    dp = jnp.where(ddelta > 0, ddelta, 0)
+    dn = jnp.where(ddelta < 0, -ddelta, 0)
     pos_chunks = [
-        jnp.bitwise_and(v, 127)
-        for v in (
-            jnp.where(ddelta > 0, ddelta, 0),
-            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 7),
-            jnp.right_shift(jnp.where(ddelta > 0, ddelta, 0), 14),
-            jnp.where(ddelta < 0, -ddelta, 0),
-            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 7),
-            jnp.right_shift(jnp.where(ddelta < 0, -ddelta, 0), 14),
-        )
+        jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
+        for v in (dp, dn)
+        for k in range(dlv)
     ]
-    p0, p1, p2, n0, n1, n2 = _mxu_spread(dpos_, pos_chunks, C)
-    dd_dense = (
-        p0 + jnp.left_shift(p1, 7) + jnp.left_shift(p2, 14)
-        - n0 - jnp.left_shift(n1, 7) - jnp.left_shift(n2, 14)
+    outs = _mxu_spread(dpos_, pos_chunks, C)
+    dd_dense = sum(
+        jnp.left_shift(outs[k], 7 * k) for k in range(dlv)
+    ) - sum(
+        jnp.left_shift(outs[dlv + k], 7 * k) for k in range(dlv)
     )
     delta_cum = jnp.cumsum(dd_dense, axis=1)
     fill_slot = col + delta_cum

@@ -211,3 +211,89 @@ def test_range_token_cap_exact(svelte_trace):
         assert eng2.decode(eng2.run()) == svelte_trace.end_content
     finally:
         del os.environ["CRDT_ENGINE_TOKENSIM"]
+
+
+def _random_blocked_inputs(seed, R=2, C=2048, L=1500):
+    """Plausible dense inputs for the fused range kernels: disjoint
+    delete intervals, disjoint insert runs with increasing destinations,
+    consistent dd deltas (the apply_range_batch4 producer's invariants)."""
+    rng = np.random.default_rng(seed)
+    doc = np.full((R, C), 2, np.int32)
+    for r in range(R):
+        vis = rng.random(L) < 0.8
+        doc[r, :L] = ((np.arange(L) + 2) << 1) | vis.astype(np.int32)
+    delpk = np.zeros((R, C), np.int32)
+    for r in range(R):
+        pos = np.sort(rng.choice(L, 6, replace=False))
+        for i in range(0, 6, 2):
+            delpk[r, pos[i]] += 1
+            delpk[r, pos[i + 1] + 1] += 1 << 14
+    ind_d = np.zeros((R, C), np.int32)
+    dd = np.zeros((R, C), np.int32)
+    newlen = np.full(R, L, np.int32)
+    for r in range(R):
+        dests = np.sort(rng.choice(np.arange(50, L, 37), 5, replace=False))
+        total = 0
+        prev_delta = 0
+        for d0 in dests:
+            ln = int(rng.integers(1, 9))
+            dest = d0 + total
+            ind_d[r, dest] += 1
+            ind_d[r, dest + ln] -= 1
+            delta = (1600 + total) - dest
+            dd[r, dest] = delta - prev_delta
+            prev_delta = delta
+            total += ln
+        newlen[r] = L + total
+    return doc, delpk, ind_d, dd, newlen
+
+
+@pytest.mark.parametrize("seed", [0, 3, 8])
+def test_range_fused_blocked_matches_xla(seed):
+    """The halo-blocked kernel (capacities beyond the monolithic VMEM
+    gate, round-5) must reproduce the XLA twin bit-exactly, including
+    the emitted cv/vis_tile maintenance structure."""
+    from crdt_benches_tpu.ops.apply_range_fused import (
+        range_fused_blocked,
+        range_fused_xla,
+    )
+
+    args = [jnp.asarray(x) for x in _random_blocked_inputs(seed)]
+    want = range_fused_xla(*args, nbits=4, dsh=14)
+    got = range_fused_blocked(
+        *args, nbits=4, dsh=14, block_tiles=8, interpret=True
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(
+            np.asarray(w).astype(np.float32),
+            np.asarray(g).astype(np.float32),
+        )
+
+
+@pytest.mark.slow
+def test_range_engine_above_old_capacity_ceiling(range_apply):
+    """Capacity > 2^20 (the retired r4 ValueError bound): the widened
+    ddelta levels must keep the replay byte-identical (both engines)."""
+    from crdt_benches_tpu.traces.loader import TestData, TestPatch, TestTxn
+
+    rng = np.random.default_rng(23)
+    content = ""
+    txns = []
+    total = 0
+    while total < 1_100_000:
+        pos = int(rng.integers(0, len(content) + 1))
+        n = int(rng.integers(2000, 12000))
+        ins = "".join(
+            chr(97 + int(c)) for c in rng.integers(0, 26, n)
+        )
+        txns.append([[pos, 0, ins]])
+        content = content[:pos] + ins + content[pos:]
+        total += n
+    trace = TestData(
+        "", content,
+        [TestTxn("", [TestPatch(*p) for p in t]) for t in txns],
+    )
+    rt = tensorize_ranges(trace, batch=32)
+    assert rt.capacity > 1 << 20
+    eng = RangeReplayEngine(rt, n_replicas=1, interpret=True, chunk=4)
+    assert eng.decode(eng.run()) == content
