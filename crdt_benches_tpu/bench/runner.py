@@ -617,6 +617,13 @@ def main(argv=None) -> int:
     ap.add_argument("--traces", default=",".join(TRACES))
     ap.add_argument("--backends", default="cpp-rope,cpp-crdt,cpp-cola,jax")
     ap.add_argument("--filter", default="", help="substring filter on group")
+    ap.add_argument(
+        "--only", default="",
+        help="substring filter on the FULL bench id 'group/trace/backend' "
+             "(e.g. 'downstream/rustcode/jax-patch' or just "
+             "'automerge-paper/jax') — the whole-id filtering Criterion's "
+             "CLI offers via BenchmarkId (reference src/main.rs:27)",
+    )
     ap.add_argument("--samples", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--replicas", type=int, default=1)
@@ -714,10 +721,17 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
+    def want(group: str, trace: str, backend: str) -> bool:
+        return (
+            not args.only or args.only in f"{group}/{trace}/{backend}"
+        )
+
     results: list[BenchResult] = []
     for trace in args.traces.split(","):
         for backend in args.backends.split(","):
-            if not args.filter or args.filter in "upstream":
+            if (not args.filter or args.filter in "upstream") and want(
+                "upstream", trace, backend
+            ):
                 r = run_upstream(trace, backend, args.samples, args.warmup,
                                  args.replicas, args.batch,
                                  profile_dir=args.profile)
@@ -727,16 +741,24 @@ def main(argv=None) -> int:
             if backend in (
                 "cpp-crdt", "jax", "jax-pos", "jax-range", "jax-runs",
                 "jax-patch", "jax-unitwire",
-            ) and (not args.filter or args.filter in "downstream"):
+            ) and (not args.filter or args.filter in "downstream") and want(
+                "downstream", trace, backend
+            ):
                 r = run_downstream(trace, backend, args.samples, args.warmup,
                                    replicas=args.replicas, batch=args.batch)
                 if r:
                     results.append(r)
                     _report(r)
 
-    if args.filter and args.filter in "merge":
+    if (args.filter and args.filter in "merge") or (
+        args.only and args.only.startswith("merge")
+    ):
+        # an --only merge/... selection must reach the merge loop even
+        # without --filter merge (code-review r5)
         for config in args.merge_configs.split(","):
             for backend in args.backends.split(","):
+                if not want("merge", config, backend):
+                    continue
                 r = run_merge(config, backend, args.samples, args.warmup,
                               args.replicas, args.batch, args.merge_ops,
                               epoch=args.epoch)

@@ -133,19 +133,23 @@ class RangeReplayEngine:
         # apply_range_batch4 dispatches to the halo-blocked kernel there
         # (ops/apply_range_fused.py range_fused_blocked, round-5).
         # CRDT_RANGE_APPLY=v3 still forces the per-pass XLA apply.
-        # Arithmetic-range preconditions of the packed spread paths: the
-        # run-delta spread carries |ddelta| <= 2*capacity in
-        # ddelta_levels(capacity) 7-bit chunk levels (adaptive — 3 below
-        # 2^20, round-5 widening), and the fused kernel's shifted level
-        # accumulation stays int32-exact while 128 * 2 * capacity < 2^31,
-        # i.e. capacity <= 2^22 (ops/apply_range_fused.py kernel note).
-        # Fail loudly beyond instead of silently truncating (ADVICE r1).
+        # Arithmetic-range preconditions of the packed spread paths,
+        # conservatively gated at the TIGHTEST bound any selected path
+        # carries: the MONOLITHIC fused kernel's shifted ddelta level
+        # accumulation is int32-exact only while 128 * 2 * capacity
+        # < 2^31 (capacity <= 2^22), and the producer's one-cell f32
+        # spread accumulation needs 2 * capacity < 2^24 (<= 2^23).  The
+        # halo-blocked kernel itself is int32-exact beyond that, but it
+        # shares the producer and the interpret/CPU paths share the
+        # monolithic math, so raising this guard requires auditing those
+        # two bounds, not the blocked kernel (code-review r5).  Fail
+        # loudly instead of silently truncating (ADVICE r1).
         if self.capacity > 1 << 22:
             raise ValueError(
-                f"capacity {self.capacity} > 2^22 exceeds the fused range"
-                " kernel's int32 level-accumulation bound; use the unit"
-                " engine (proven to 2^21) or raise the bound with a"
-                " two-piece level reconstruction"
+                f"capacity {self.capacity} > 2^22 exceeds the monolithic"
+                " fused kernel's int32 level-accumulation bound (the"
+                " blocked kernel is exact but the shared producer caps at"
+                " 2^23); use the unit engine or split the ddelta spread"
             )
         self.n_init = len(rt.init_chars)
         self.pack = pack

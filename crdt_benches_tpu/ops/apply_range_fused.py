@@ -204,10 +204,14 @@ def apply_fused2(doc_predel, combo, cnt_base, new_len, *, nbits: int,
             return out[:, :C]
         d, cv, vt = out
         return d[:, :C], cv[:, :C], vt[:, :nt]
-    per_replica = 40 * C  # ~5 live (nt, LANE) i32/f32 arrays + roll temps
+    # ~6 live (nt, LANE) i32/f32 arrays + roll temps; the r4 estimate of
+    # 40 B/pos compiled to a 100.16M stack at Rt=64, C=32k (observed on
+    # the r5 upstream matrix — 164K over the 100M limit), so size against
+    # the measured ~49 B/pos with an 88M budget
+    per_replica = 49 * C
     Rt = replica_tile
     if Rt <= 0:
-        Rt = max(1, (96 * 2**20) // per_replica)
+        Rt = max(1, (88 * 2**20) // per_replica)
     Rt = min(Rt, R)
     while R % Rt:
         Rt -= 1
@@ -584,8 +588,10 @@ def range_fused_blocked(doc, delpk, ind_d, dd, new_len, *, nbits: int,
                         interpret: bool = False):
     """range_fused for capacities beyond the monolithic VMEM gate: same
     contract ((doc', cv_intile bf16, vis_tile)), blocked along C with a
-    left halo of ceil(2**nbits / 128) + 1 tiles.  VMEM per grid step
-    ~ 7 * (block + halo) * 128 * 4 bytes, independent of C."""
+    left halo of ceil(2**nbits / 128) + 1 tiles.  VMEM per grid step is
+    RANGE_BLOCKED_BYTES_PER_TILE * (block + halo) — measured ~24 live
+    (1, window, LANE) i32 buffers, i.e. ~12.3KB per window tile —
+    independent of C."""
     R, C = doc.shape
     nt = C // LANE
     # halo = the expansion's max leftward reach (2**nbits positions),

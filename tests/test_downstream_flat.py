@@ -13,7 +13,9 @@ from crdt_benches_tpu.engine.merge_range import (
 from test_merge import sim_for
 
 
-@pytest.mark.parametrize("seed", [0, 3, 7])
+@pytest.mark.parametrize(
+    "seed", [0] + [pytest.param(x, marks=pytest.mark.slow) for x in (3, 7)]
+)
 @pytest.mark.parametrize("agents", [1, 2, 5])
 def test_flat_matches_v1_merge(seed, agents):
     sim = sim_for(seed=seed, n_agents=agents, n_ops=30, batch=8)
@@ -70,7 +72,9 @@ def _flat_unit_merge(sim, delivered, R=2):
     return make_flat_merge(sim, delivered, n_replicas=R)()
 
 
-@pytest.mark.parametrize("seed", [0, 2, 5])
+@pytest.mark.parametrize(
+    "seed", [0] + [pytest.param(x, marks=pytest.mark.slow) for x in (2, 5)]
+)
 @pytest.mark.parametrize("agents", [1, 2, 5])
 def test_flat_unit_log_duplicated_shuffled_delivery(seed, agents):
     """The adversarial fault model: every op delivered 3x, shuffled.

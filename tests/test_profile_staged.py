@@ -16,9 +16,8 @@ import pytest
 sys.path.insert(0, ".")
 
 
-@pytest.mark.parametrize(
-    "batch", [16, pytest.param(1536, marks=pytest.mark.slow)]
-)
+@pytest.mark.slow
+@pytest.mark.parametrize("batch", [16, 1536])
 def test_range_staged_matches_apply_range_batch4(batch):
     import jax.numpy as jnp
 

@@ -248,7 +248,9 @@ def _random_blocked_inputs(seed, R=2, C=2048, L=1500):
     return doc, delpk, ind_d, dd, newlen
 
 
-@pytest.mark.parametrize("seed", [0, 3, 8])
+@pytest.mark.parametrize(
+    "seed", [0] + [pytest.param(x, marks=pytest.mark.slow) for x in (3, 8)]
+)
 def test_range_fused_blocked_matches_xla(seed):
     """The halo-blocked kernel (capacities beyond the monolithic VMEM
     gate, round-5) must reproduce the XLA twin bit-exactly, including

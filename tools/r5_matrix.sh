@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-5 bench matrix (serialized TPU job — ONE tpu client at a time on
+# this box).  Finer-grained invocations so a single slow/failing cell
+# cannot take out the rest of the matrix; artifacts land incrementally in
+# bench_results/{down_r5,merge_traces_r5,merge_adv10m_r5,up_r5*}.json.
+# Byte verification runs as separate --verify-only passes at small
+# replica counts (identical code paths; every TIMED iteration asserts
+# final lengths regardless, the reference's in-loop oracle).
+# Run with: nohup bash tools/r5_matrix.sh > /tmp/r5matrix.log 2>&1 &
+set -x
+cd /root/repo
+
+run() { timeout 3000 python -m crdt_benches_tpu.bench.runner "$@" || true; }
+
+# 1) downstream timed matrix: every wire granularity incl. the round-5
+#    one-shot flat engines
+run --filter downstream \
+    --backends cpp-crdt,jax,jax-range,jax-runs,jax-patch,jax-unitwire \
+    --replicas 64 --samples 5 --save-baseline down_r5
+
+# 2) merge cells timed
+run --filter merge --backends cpp-crdt,jax,jax-range,jax-flat \
+    --merge-configs traces --replicas 64 --samples 5 \
+    --save-baseline merge_traces_r5
+run --filter merge --backends cpp-crdt,jax,jax-flat \
+    --merge-configs adversarial --merge-ops 10000000 \
+    --replicas 64 --samples 5 --save-baseline merge_adv10m_r5
+
+# 3) upstream timed matrix, per trace (isolates any OOM at r1024)
+for t in automerge-paper sveltecomponent seph-blog1; do
+  run --filter upstream --traces "$t" \
+      --backends cpp-rope,cpp-crdt,cpp-cola,jax,jax-unit \
+      --replicas 1024 --samples 5 --save-baseline "up_r5_$t"
+done
+# rustcode's unit layout at r1024 exceeds HBM (523k-slot capacity);
+# r512 is the committed configuration (same as r3)
+run --filter upstream --traces rustcode \
+    --backends cpp-rope,cpp-crdt,cpp-cola,jax,jax-unit \
+    --replicas 512 --samples 5 --save-baseline up_r5_rustcode
+
+# 4) byte-verification passes (small replicas, same code paths)
+run --filter downstream \
+    --backends cpp-crdt,jax,jax-range,jax-runs,jax-patch,jax-unitwire \
+    --replicas 4 --verify-only
+run --filter merge --backends none --merge-configs traces \
+    --replicas 4 --verify-only
+run --filter merge --backends none --merge-configs adversarial \
+    --merge-ops 10000000 --replicas 4 --verify-only
+run --filter upstream --backends cpp-rope,cpp-crdt,cpp-cola,jax,jax-unit \
+    --replicas 4 --verify-only
+
+# 5) the Criterion-analog HTML report over everything committed
+python -m crdt_benches_tpu.bench.report || true
