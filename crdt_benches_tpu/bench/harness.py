@@ -67,6 +67,18 @@ class BenchResult:
         return max(self.samples)
 
     @property
+    def p50(self) -> float:
+        return quantiles(self.samples)["p50"]
+
+    @property
+    def p95(self) -> float:
+        return quantiles(self.samples)["p95"]
+
+    @property
+    def p99(self) -> float:
+        return quantiles(self.samples)["p99"]
+
+    @property
     def outliers(self) -> dict:
         """Tukey classification of this cell's final kept samples."""
         return classify_outliers(self.samples)
@@ -85,6 +97,7 @@ class BenchResult:
             stddev=self.stddev,
             min=self.best,
             max=self.worst,
+            **quantiles(self.samples),
             elements_per_sec=self.elements_per_sec,
             outliers=self.outliers,
         )
@@ -103,6 +116,16 @@ def _quantile(sorted_s: list[float], p: float) -> float:
     f = math.floor(k)
     c = min(f + 1, n - 1)
     return sorted_s[f] + (sorted_s[c] - sorted_s[f]) * (k - f)
+
+
+def quantiles(samples, ps=(0.5, 0.95, 0.99)) -> dict[str, float]:
+    """Linear-interpolated quantiles as a {"p50": ..., "p95": ..., ...}
+    table (the serve family's per-batch latency report; same
+    interpolation as the Tukey fences above)."""
+    if not samples:
+        raise ValueError("quantiles of an empty sample list")
+    s = sorted(samples)
+    return {f"p{100 * p:g}": _quantile(s, p) for p in ps}
 
 
 def classify_outliers(samples: list[float]) -> dict:

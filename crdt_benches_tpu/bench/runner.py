@@ -11,6 +11,16 @@ Usage:
   python -m crdt_benches_tpu.bench.runner --traces sveltecomponent \
       --backends cpp-rope,cpp-crdt,jax --replicas 8 --samples 5 \
       [--save-baseline NAME] [--baseline NAME] [--filter upstream]
+
+Families:
+  classic (default) — the per-trace replay matrix above
+  serve             — the multi-tenant document-fleet engine (serve/):
+      python -m crdt_benches_tpu.bench.runner --family serve \
+          --serve-docs 4096 --serve-mix mixed --serve-mesh 8
+      Bench ids are serve/<mix>/<fleet-size>; the run reports fleet
+      patches/sec + p50/p95/p99 per-batch latency, byte-verifies a
+      per-capacity-class doc sample against the oracle, and writes
+      bench_results/serve_<mix>_<docs>.json.
 """
 
 from __future__ import annotations
@@ -612,8 +622,58 @@ def verify_merge(config: str, merge_ops: int, batch: int,
     return sim.decode(state) == want
 
 
+def run_serve(args) -> int:
+    """The serve family: build/drain a document fleet (serve/bench.py),
+    verify a per-class sample against the oracle, persist the artifact.
+    Exits nonzero on a verification mismatch."""
+    from ..serve.bench import ensure_virtual_devices, run_serve_bench
+
+    mesh_devices = ensure_virtual_devices(args.serve_mesh)
+    r, info = run_serve_bench(
+        mix=args.serve_mix,
+        n_docs=args.serve_docs,
+        batch=args.serve_batch,
+        classes=args.serve_classes,
+        slots=args.serve_slots,
+        seed=args.serve_seed,
+        arrival_span=args.serve_arrival_span,
+        mesh_devices=mesh_devices,
+        verify_sample=args.serve_verify_sample,
+        log=lambda m: print(m, file=sys.stderr),
+    )
+    print(
+        f"{r.bench_id}: {r.elements_per_sec:,.0f} patches/s "
+        f"(batch latency p50 {r.extra['batch_latency']['p50'] * 1e3:.1f}ms "
+        f"/ p99 {r.extra['batch_latency']['p99'] * 1e3:.1f}ms)"
+    )
+    return 0 if info["verify_ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--family", default="classic", choices=("classic", "serve"),
+        help="'classic' = the per-trace replay matrix; 'serve' = the "
+             "multi-tenant document-fleet engine (serve/)",
+    )
+    ap.add_argument("--serve-docs", type=int, default=4096)
+    ap.add_argument("--serve-mix", default="mixed",
+                    help="workload mix name (serve/workload.py MIXES)")
+    ap.add_argument("--serve-batch", type=int, default=64,
+                    help="unit ops per doc per scheduling round")
+    ap.add_argument("--serve-classes", default="256,1024,4096,8192,49152",
+                    help="capacity classes (slots per doc, ascending; the "
+                         "largest must hold the biggest workload doc — "
+                         "'mixed' hosts rustcode windows at ~43.7k slots)")
+    ap.add_argument("--serve-slots", default="2048,512,128,32,16",
+                    help="resident rows per capacity class")
+    ap.add_argument("--serve-mesh", type=int, default=0,
+                    help="shard docs over N (virtual CPU) mesh devices")
+    ap.add_argument("--serve-seed", type=int, default=0)
+    ap.add_argument("--serve-arrival-span", type=int, default=8)
+    ap.add_argument("--serve-verify-sample", type=int, default=8,
+                    help="docs byte-verified vs the oracle, spread "
+                         "across every capacity class")
     ap.add_argument("--traces", default=",".join(TRACES))
     ap.add_argument("--backends", default="cpp-rope,cpp-crdt,cpp-cola,jax")
     ap.add_argument("--filter", default="", help="substring filter on group")
@@ -657,6 +717,9 @@ def main(argv=None) -> int:
         help="run --verify checks without timing anything",
     )
     args = ap.parse_args(argv)
+
+    if args.family == "serve":
+        return run_serve(args)
 
     if args.verify or args.verify_only:
         failures = []

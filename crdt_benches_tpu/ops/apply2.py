@@ -281,9 +281,15 @@ def apply_batch3(
     clears, the insert-destination indicator, and the insert fills are
     spread to dense (R, C) arrays with exact one-hot MXU matmuls
     (_mxu_spread) and combined with vector adds.
+
+    ``slots`` may be int32[B] (one op stream replayed by every row — the
+    replica-parallel engines) or int32[R, B] (a different op stream per
+    row — the serve/ document-fleet pool, where each lane is an
+    independent document and ``resolved`` came from a per-row vmapped
+    resolve_batch).
     """
     R, C = state.doc.shape
-    B = slots.shape[0]
+    B = slots.shape[-1]
     drop = jnp.int32(C + 7)
     col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
     valid = col < state.length[:, None]
@@ -333,7 +339,9 @@ def apply_batch3(
 
     # Insert destinations: indicator + packed fill values in 7-bit chunks,
     # all from the same one-hot pair.
-    slots_b = jnp.broadcast_to(slots[None, :], (R, B))
+    slots_b = jnp.broadcast_to(
+        slots[None, :] if slots.ndim == 1 else slots, (R, B)
+    )
     fill = jnp.where(
         is_ins, pack_doc(slots_b, resolved.ins_alive.astype(jnp.int32)), 0
     )

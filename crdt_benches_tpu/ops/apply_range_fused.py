@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from .pallas_compat import pltpu  # CompilerParams shim for jax 0.4
 
 from .apply2 import (
     LANE,

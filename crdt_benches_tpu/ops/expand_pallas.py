@@ -25,7 +25,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from .pallas_compat import pltpu  # CompilerParams shim for jax 0.4
 
 LANE = 128
 

@@ -38,8 +38,15 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-BIG = jnp.int32(2**30)
+# Host-side on purpose (np, not jnp): a module-scope DEVICE scalar is
+# created inside whatever trace context is live at first import — the
+# serve runner imports engines lazily from inside jitted regions on this
+# jax version, and the leaked tracer kills __graft_entry__.dryrun_multichip.
+# A committed device constant also forces the slow dispatch path on the
+# axon tunnel (README environment notes).
+BIG = np.int32(2**30)
 
 
 class Level(NamedTuple):

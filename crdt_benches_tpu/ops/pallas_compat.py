@@ -1,0 +1,17 @@
+"""Shared Pallas-TPU import with cross-version compat.
+
+The kernels target the current pallas API (``pltpu.CompilerParams``);
+jax <= 0.4.x still names it ``TPUCompilerParams`` (the rename landed in
+0.5).  Every Pallas module imports ``pltpu`` from here so the shim lives
+in exactly one place.
+"""
+
+from __future__ import annotations
+
+from jax.experimental import pallas as pl  # noqa: F401  (re-export)
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+__all__ = ["pl", "pltpu"]

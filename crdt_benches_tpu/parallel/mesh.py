@@ -46,6 +46,16 @@ def replica_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs), (AXIS,))
 
 
+def fleet_sharding(mesh: Mesh) -> NamedSharding:
+    """Docs-over-mesh layout for the serve/ document fleet: the leading
+    axis of every DocPool bucket array (one lane per *independent
+    document*, unlike the replica stacks above) splits over the mesh's
+    replica axis.  Resolve/apply are row-local, so the vmapped fleet
+    step partitions under jit with zero collectives — the serving analog
+    of the replica-parallel sharding this module was built for."""
+    return NamedSharding(mesh, P(AXIS))
+
+
 def _local_replay_step(state: DocState, kind, pos, slot) -> DocState:
     """One op-batch step for a single replica (resolve + apply)."""
     resolved = resolve_batch(kind, pos, state.nvis)

@@ -1,0 +1,43 @@
+"""serve/ — multi-tenant document-fleet serving engine.
+
+The reference (and every other engine in this repo) replays ONE document —
+possibly vmapped into many replicas *of the same document*.  This package
+hosts N **independent** documents in a small number of batched device
+states and drives them with a mixed multi-tenant workload, the defining
+shape of real CRDT deployments (server-side multi-document hosting, as
+surveyed in "Approaches to Conflict-free Replicated Data Types",
+arxiv 2310.18220):
+
+- :mod:`.pool`       — ``DocPool``: documents bucketed by capacity class,
+  one ``PackedState`` stack per class (rows = docs, not replicas), with
+  admit/evict that round-trips cold docs through ``utils/checkpoint.py``
+  and a vmapped per-row resolve+apply step;
+- :mod:`.scheduler`  — ``FleetScheduler``: admission + batching; drains
+  per-doc op queues into fixed-shape device batches (idle lanes padded
+  with no-ops), promotes docs between buckets as they outgrow capacity,
+  reports queue depth / occupancy;
+- :mod:`.workload`   — multi-tenant generator interleaving the four real
+  traces (as prefixes) plus ``traces/synth.py`` streams across N
+  simulated sessions with a configurable arrival mix;
+- :mod:`.bench`      — the ``serve`` bench family (fleet patches/sec +
+  p50/p95/p99 per-batch latency), wired into ``bench/runner.py`` under
+  ``--family serve`` with bench ids ``serve/<mix>/<fleet-size>``.
+
+Correctness gate: sampled docs from every capacity bucket finish
+byte-identical to ``oracle/text_oracle.py`` replaying the same per-doc
+stream (tests/test_serve.py, and the in-run verify of the bench family).
+"""
+
+from .pool import DocPool
+from .scheduler import FleetScheduler, ServeStats, prepare_streams
+from .workload import BANDS, MIXES, build_fleet
+
+__all__ = [
+    "DocPool",
+    "FleetScheduler",
+    "ServeStats",
+    "prepare_streams",
+    "BANDS",
+    "MIXES",
+    "build_fleet",
+]

@@ -1,0 +1,212 @@
+"""The ``serve`` bench family: fleet throughput + per-batch latency.
+
+Bench id scheme: ``serve/<mix>/<fleet-size>`` (group/trace/backend slots
+of ``bench/harness.py BenchResult``).  Reported numbers:
+
+- **fleet throughput**: trace patches applied across the whole fleet per
+  second of drain wall time (the ``Throughput::Elements`` analog, with
+  element = one patch, summed over every tenant document);
+- **per-batch latency**: p50/p95/p99 over per-round wall times (one
+  round = one fixed-shape device batch per active capacity class,
+  including scheduling, admissions/evictions, H2D and the blocking
+  fence — honest serving latency, not just kernel time).
+
+Correctness gate (in-run, not optional): a sample of docs spanning every
+capacity class that hosted documents is decoded and byte-compared
+against ``oracle/text_oracle.py`` replaying the same per-doc stream; a
+mismatch fails the run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from ..bench.harness import BenchResult, quantiles, save_results
+from ..oracle.text_oracle import replay_trace
+from ..traces.tensorize import PAD
+from .pool import DocPool
+from .scheduler import FleetScheduler, prepare_streams
+from .workload import build_fleet
+
+
+def ensure_virtual_devices(n: int) -> int:
+    """Best-effort: make ``n`` virtual host CPU devices available for
+    the docs-over-mesh path.  Must run before the JAX *backend*
+    initializes (merely having ``jax`` imported is fine — this image's
+    sitecustomize imports it into every process); the same dance as
+    tests/conftest.py: force the host device count via XLA_FLAGS, then
+    pin the platform config to cpu before first device use.  Skipped
+    when the caller explicitly selected a non-CPU platform; if the
+    backend is already live with fewer devices, falls back (returns the
+    usable device count)."""
+    if n <= 1:
+        return 1
+    env_plat = os.environ.get("JAX_PLATFORMS", "")
+    if env_plat in ("", "cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:  # backend already initialized
+            pass
+    else:
+        import jax
+    avail = len(jax.devices())
+    if avail < n:
+        print(
+            f"serve: wanted {n} mesh devices, have {avail}; "
+            f"{'using ' + str(avail) if avail > 1 else 'mesh disabled'}",
+            file=sys.stderr,
+        )
+    return min(n, avail)
+
+
+def _parse_int_tuple(s: str | tuple) -> tuple[int, ...]:
+    if isinstance(s, tuple):
+        return s
+    return tuple(int(x) for x in str(s).split(",") if x)
+
+
+def run_serve_bench(
+    mix="mixed",
+    n_docs: int = 4096,
+    batch: int = 64,
+    classes=(256, 1024, 4096, 8192, 49152),
+    slots=(2048, 512, 128, 32, 16),
+    seed: int = 0,
+    arrival_span: int = 8,
+    mesh_devices: int = 0,
+    verify_sample: int = 8,
+    bands: dict | None = None,
+    spool_dir: str | None = None,
+    results_dir: str | None = None,
+    save_name: str | None = None,
+    log=print,
+) -> tuple[BenchResult, dict]:
+    """Build the fleet, drain it once, verify a per-class doc sample
+    against the oracle, and persist the artifact.  Returns
+    (BenchResult, info) with ``info["verify_ok"]``."""
+    classes = _parse_int_tuple(classes)
+    slots = _parse_int_tuple(slots)
+    mix_name = mix if isinstance(mix, str) else "custom"
+
+    mesh = None
+    if mesh_devices > 1:
+        from ..parallel.mesh import replica_mesh
+
+        mesh = replica_mesh(mesh_devices)
+
+    log(f"serve: building fleet n_docs={n_docs} mix={mix_name} seed={seed}")
+    sessions = build_fleet(
+        n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands
+    )
+    pool = DocPool(classes=classes, slots=slots, mesh=mesh,
+                   spool_dir=spool_dir)
+    streams = prepare_streams(sessions, pool, batch=batch)
+    total_ops = sum(s.remaining for s in streams.values())
+    log(
+        f"serve: {len(sessions)} docs, {total_ops} unit ops, "
+        f"classes={classes} slots={slots} batch={batch} "
+        f"mesh={mesh_devices if mesh else 'off'}"
+    )
+
+    # Warm every bucket's compiled step with an all-PAD batch so the
+    # first serving round doesn't absorb XLA compile time (criterion's
+    # warmup; latency quantiles then reflect steady-state serving).
+    for cls in classes:
+        b = pool.buckets[cls]
+        pool.step(cls, np.full((b.R, batch), PAD, np.int32),
+                  np.zeros((b.R, batch), np.int32),
+                  np.full((b.R, batch), -1, np.int32))
+        b.steps = 0
+    pool.block()
+
+    sched = FleetScheduler(pool, streams, batch=batch)
+    stats = sched.run()
+    assert sched.done, "scheduler stopped with pending work"
+    lat = quantiles(stats.round_latencies)
+    throughput = stats.patches / stats.wall_time
+    log(
+        f"serve: drained in {stats.wall_time:.2f}s over {stats.rounds} "
+        f"rounds -> {throughput:,.0f} patches/s; batch latency "
+        f"p50 {lat['p50'] * 1e3:.1f}ms p95 {lat['p95'] * 1e3:.1f}ms "
+        f"p99 {lat['p99'] * 1e3:.1f}ms; evictions {stats.evictions} "
+        f"restores {stats.restores} promotions {stats.promotions}"
+    )
+
+    # ---- per-class byte verification against the oracle ----
+    by_class: dict[int, list[int]] = {}
+    for s in sessions:
+        rec = pool.docs[s.doc_id]
+        final_cls = rec.cls or pool.class_for(max(rec.length, 1))
+        by_class.setdefault(final_cls, []).append(s.doc_id)
+    used_classes = sorted(by_class)
+    per_class = max(1, -(-verify_sample // len(used_classes)))
+    rng = np.random.default_rng(seed + 1)
+    sample: list[int] = []
+    for cls in used_classes:
+        ids = by_class[cls]
+        pick = rng.choice(ids, size=min(per_class, len(ids)), replace=False)
+        sample.extend(int(x) for x in pick)
+    failures = []
+    session_of = {s.doc_id: s for s in sessions}
+    for doc_id in sample:
+        want = replay_trace(session_of[doc_id].trace)
+        got = pool.decode(doc_id)
+        if got != want:
+            failures.append(doc_id)
+    verify_ok = not failures
+    log(
+        f"serve: verified {len(sample)} docs across classes "
+        f"{used_classes}: " + ("all byte-identical to oracle" if verify_ok
+                               else f"MISMATCH on docs {failures}")
+    )
+
+    occ = float(np.mean(stats.occupancy)) if stats.occupancy else 0.0
+    qd = stats.queue_depth or [0]
+    r = BenchResult(
+        group="serve",
+        trace=mix_name,
+        backend=str(n_docs),
+        elements=stats.patches,
+        samples=[stats.wall_time],
+        replicas=1,
+        extra={
+            "family": "serve",
+            "fleet_docs": n_docs,
+            "batch": batch,
+            "classes": list(classes),
+            "slots": list(slots),
+            "mesh_devices": mesh_devices if mesh else 0,
+            "rounds": stats.rounds,
+            "unit_ops": stats.ops,
+            "patches_per_sec": throughput,
+            "batch_latency": lat,
+            "occupancy_mean": occ,
+            "queue_depth_mean": float(np.mean(qd)),
+            "queue_depth_max": int(np.max(qd)),
+            "evictions": stats.evictions,
+            "restores": stats.restores,
+            "promotions": stats.promotions,
+            "admissions": stats.admissions,
+            "docs_per_class": {
+                str(c): len(v) for c, v in sorted(by_class.items())
+            },
+            "verified_docs": sorted(sample),
+            "verify_ok": verify_ok,
+        },
+    )
+    kw = {"results_dir": results_dir} if results_dir else {}
+    path = save_results([r], save_name or f"serve_{mix_name}_{n_docs}", **kw)
+    log(f"serve: wrote {path}")
+    pool.close()  # verification done: drop an owned spool directory
+    return r, {"verify_ok": verify_ok, "path": path, "stats": stats}

@@ -1,0 +1,335 @@
+"""DocPool: N independent documents in a few batched device states.
+
+Every replay engine in this repo batches over a *replica* axis — R copies
+of the same document consuming the same op stream.  The pool re-purposes
+that axis as a **document** axis: each row of a ``PackedState`` stack is a
+different document with its own ``length``/``nvis`` lane, its own slot-id
+space, and its own op stream.  Per-row independence is exactly what the
+unit-op machinery already provides:
+
+- ``ops/resolve.py resolve_batch`` is written per-document and jit/vmap
+  compatible, so ``vmap(resolve_batch)`` over (kind[R, B], pos[R, B],
+  nvis[R]) resolves a *different* op batch per row;
+- ``ops/apply2.py apply_batch3`` (the packed v3 apply) already consumes
+  per-row resolved batches — it only needed per-row ``slots`` support.
+
+Documents are bucketed by **capacity class** (e.g. 256 / 1024 / 4096
+slots): a small doc must not pay a 4096-wide apply pass, so each class is
+its own (R_class, C_class) stack.  Docs are admitted into a free row of
+their class, **promoted** to the next class when their slot need outgrows
+the current one (capacity need is host-known: n_init + cumulative insert
+count, so promotion never requires a device sync), and **evicted** to a
+checkpoint spool (``utils/checkpoint.py`` .npz round-trip) when their
+bucket is full — cold docs rehydrate into *any* free row later.
+
+The optional ``mesh`` shards every bucket's row (document) axis over the
+``parallel/mesh.py`` replica mesh axis — the docs-over-mesh layout.  All
+per-row work in resolve/apply is row-local, so the step partitions with
+zero collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.apply2 import LANE, PackedState, apply_batch3
+from ..ops.resolve import resolve_batch
+from ..traces.tensorize import PAD
+from ..utils.checkpoint import load_state, save_state
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def fleet_step(state: PackedState, kind, pos, slot) -> PackedState:
+    """One op batch per resident doc: per-row resolve, one batched apply.
+
+    ``kind``/``pos``/``slot``: int32[R, B], row r = the next B ops of the
+    doc in row r (``kind == PAD`` everywhere for idle rows — a no-op end
+    to end, the fixed-shape padding the scheduler relies on).
+    """
+    resolved = jax.vmap(resolve_batch)(kind, pos, state.nvis)
+    return apply_batch3(state, resolved, slot)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_row(state: PackedState, row, doc, length, nvis) -> PackedState:
+    return PackedState(
+        doc=state.doc.at[row].set(doc),
+        length=state.length.at[row].set(length),
+        nvis=state.nvis.at[row].set(nvis),
+    )
+
+
+@jax.jit
+def _read_row(state: PackedState, row):
+    return state.doc[row], state.length[row], state.nvis[row]
+
+
+def _fresh_row_np(C: int, n_init: int) -> np.ndarray:
+    """A fresh document row: slots 0..n_init-1 visible in order, the rest
+    the beyond-length coding ``pack_doc(-1, 0) == 2`` (matches
+    ``ops/apply2.py init_state3`` for one replica)."""
+    idx = np.arange(C, dtype=np.int32)
+    return np.where(idx < n_init, ((idx + 2) << 1) | 1, 2).astype(np.int32)
+
+
+def decode_row_np(doc: np.ndarray, length: int, nvis: int,
+                  chars: np.ndarray) -> str:
+    """Host-side decode of one packed doc row (the numpy twin of
+    ``ops/apply2.py decode_state3`` for a single row — off the hot path,
+    used for verification and spool inspection)."""
+    order = (doc[:length] >> 1) - 2
+    vis = (doc[:length] & 1).astype(bool)
+    slots = order[vis]
+    assert len(slots) == nvis, f"decode: {len(slots)} visible != nvis {nvis}"
+    return "".join(chr(int(c)) for c in chars[slots])
+
+
+@dataclass
+class DocRecord:
+    """Host-side bookkeeping for one document (no device syncs needed to
+    schedule it: length/capacity evolve deterministically with the
+    stream, so the scheduler promotes/admits from host state alone)."""
+
+    doc_id: int
+    n_init: int
+    capacity_need: int  # n_init + total inserts of the full stream
+    chars: np.ndarray  # int32[capacity_need] slot -> codepoint
+    length: int = 0  # host mirror of device length (slots used)
+    cls: int | None = None  # resident capacity class (None = cold)
+    row: int | None = None
+    spool: str | None = None  # checkpoint path when evicted
+    last_sched: int = -1  # round counter, for LRU eviction
+
+
+class Bucket:
+    """One capacity class: a PackedState stack whose rows are docs."""
+
+    def __init__(self, C: int, R: int, sharding=None):
+        self.C = C
+        self.R = R
+        state = PackedState(
+            doc=jnp.full((R, C), 2, jnp.int32),
+            length=jnp.zeros(R, jnp.int32),
+            nvis=jnp.zeros(R, jnp.int32),
+        )
+        if sharding is not None:
+            state = jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+        self.state = state
+        self.rows: list[int | None] = [None] * R  # row -> doc_id
+        self.free: list[int] = list(range(R - 1, -1, -1))
+        self.steps = 0
+
+
+class DocPool:
+    """The document fleet: buckets + admit/evict/promote + vmapped step.
+
+    ``classes``: ascending capacity classes, each a multiple of 128 (the
+    packed kernels tile by LANE).  ``slots``: resident rows per class.
+    ``mesh``: optional ``parallel/mesh.py`` mesh; every bucket's row axis
+    is then sharded over the mesh's replica axis (slots must divide by
+    the mesh size).
+    """
+
+    def __init__(
+        self,
+        classes: tuple[int, ...] = (256, 1024, 4096, 8192, 49152),
+        slots: tuple[int, ...] = (2048, 512, 128, 32, 16),
+        mesh=None,
+        spool_dir: str | None = None,
+    ):
+        if len(classes) != len(slots):
+            raise ValueError("classes and slots must have equal length")
+        if list(classes) != sorted(set(classes)):
+            raise ValueError(f"classes must be ascending/unique: {classes}")
+        for c in classes:
+            if c % LANE:
+                raise ValueError(f"capacity class {c} not a multiple of {LANE}")
+        self._sharding = None
+        if mesh is not None:
+            from ..parallel.mesh import fleet_sharding
+
+            n_dev = mesh.devices.size
+            for r in slots:
+                if r % n_dev:
+                    raise ValueError(
+                        f"bucket slots {r} not divisible by mesh size {n_dev}"
+                    )
+            self._sharding = fleet_sharding(mesh)
+        self.classes = tuple(classes)
+        self.buckets = {
+            c: Bucket(c, r, self._sharding) for c, r in zip(classes, slots)
+        }
+        self.docs: dict[int, DocRecord] = {}
+        self._owns_spool = spool_dir is None
+        self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="crdt_serve_")
+        os.makedirs(self.spool_dir, exist_ok=True)
+        # counters (reported by the scheduler / bench)
+        self.evictions = 0
+        self.restores = 0
+        self.promotions = 0
+        self.fresh_admits = 0
+
+    # ---- registration / class arithmetic ----
+
+    def register(self, doc_id: int, n_init: int, capacity_need: int,
+                 chars: np.ndarray) -> DocRecord:
+        if capacity_need > self.classes[-1]:
+            raise ValueError(
+                f"doc {doc_id}: capacity need {capacity_need} exceeds the "
+                f"largest class {self.classes[-1]}"
+            )
+        rec = DocRecord(
+            doc_id=doc_id, n_init=n_init, capacity_need=capacity_need,
+            chars=np.asarray(chars, np.int32), length=n_init,
+        )
+        self.docs[doc_id] = rec
+        return rec
+
+    def class_for(self, need: int) -> int:
+        for c in self.classes:
+            if need <= c:
+                return c
+        raise ValueError(f"slot need {need} exceeds largest class")
+
+    def residents(self, cls: int) -> list[tuple[int, int]]:
+        """(doc_id, row) pairs currently resident in class ``cls``."""
+        b = self.buckets[cls]
+        return [(d, r) for r, d in enumerate(b.rows) if d is not None]
+
+    # ---- row movement (all host round-trips: off the vmapped hot path) ----
+
+    def _pull_row(self, rec: DocRecord) -> PackedState:
+        b = self.buckets[rec.cls]
+        doc, length, nvis = _read_row(b.state, rec.row)
+        return PackedState(
+            doc=np.asarray(doc)[None],
+            length=np.asarray(length)[None],
+            nvis=np.asarray(nvis)[None],
+        )
+
+    def _free_row(self, rec: DocRecord) -> None:
+        b = self.buckets[rec.cls]
+        b.rows[rec.row] = None
+        b.free.append(rec.row)
+        rec.cls = rec.row = None
+
+    def _install(self, rec: DocRecord, cls: int, doc_row: np.ndarray,
+                 length: int, nvis: int) -> tuple[int, int]:
+        b = self.buckets[cls]
+        if not b.free:
+            raise RuntimeError(
+                f"bucket c{cls} full — scheduler must evict before admit"
+            )
+        row = b.free.pop()
+        if len(doc_row) < b.C:  # promotion / spooled-at-smaller-class pad
+            doc_row = np.concatenate(
+                [doc_row, np.full(b.C - len(doc_row), 2, np.int32)]
+            )
+        b.state = _write_row(
+            b.state, jnp.int32(row), jnp.asarray(doc_row),
+            jnp.int32(length), jnp.int32(nvis),
+        )
+        b.rows[row] = rec.doc_id
+        rec.cls, rec.row = cls, row
+        return cls, row
+
+    def evict(self, doc_id: int) -> str:
+        """Round-trip a resident doc out to the checkpoint spool
+        (``utils/checkpoint.py`` .npz) and free its row."""
+        rec = self.docs[doc_id]
+        if rec.cls is None:
+            raise ValueError(f"doc {doc_id} is not resident")
+        st = self._pull_row(rec)
+        path = os.path.join(self.spool_dir, f"doc{doc_id}.npz")
+        save_state(path, st)
+        rec.spool = path
+        self._free_row(rec)
+        self.evictions += 1
+        return path
+
+    def admit(self, doc_id: int, need: int) -> tuple[int, int]:
+        """Make ``doc_id`` resident in the class covering ``need`` slots
+        (promoting a doc resident in a smaller class, rehydrating a
+        spooled doc, or installing a fresh one).  The target bucket must
+        have a free row — eviction policy lives in the scheduler.
+        Returns (class, row)."""
+        rec = self.docs[doc_id]
+        cls = self.class_for(max(need, rec.length, 1))
+        if rec.cls is not None:
+            if rec.cls >= cls:
+                return rec.cls, rec.row  # already resident, big enough
+            st = self._pull_row(rec)  # promotion to a larger class
+            self._free_row(rec)
+            self.promotions += 1
+            return self._install(
+                rec, cls, np.asarray(st.doc[0]),
+                int(st.length[0]), int(st.nvis[0]),
+            )
+        if rec.spool is not None:
+            st = load_state(rec.spool)
+            os.unlink(rec.spool)  # rehydrated: keep the spool bounded
+            rec.spool = None
+            self.restores += 1
+            return self._install(
+                rec, cls, np.asarray(st.doc[0]),
+                int(st.length[0]), int(st.nvis[0]),
+            )
+        self.fresh_admits += 1
+        return self._install(
+            rec, cls, _fresh_row_np(cls, rec.n_init), rec.n_init, rec.n_init
+        )
+
+    # ---- the hot path ----
+
+    def step(self, cls: int, kind: np.ndarray, pos: np.ndarray,
+             slot: np.ndarray) -> None:
+        """Apply one (R, B) op batch to class ``cls`` (row r = ops for the
+        doc resident in row r; PAD rows are no-ops)."""
+        b = self.buckets[cls]
+        args = [jnp.asarray(a) for a in (kind, pos, slot)]
+        if self._sharding is not None:
+            args = [jax.device_put(a, self._sharding) for a in args]
+        b.state = fleet_step(b.state, *args)
+        b.steps += 1
+
+    def block(self) -> None:
+        """Fence all outstanding bucket steps (honest per-round timing)."""
+        for b in self.buckets.values():
+            b.state.doc.block_until_ready()
+
+    # ---- decode / verify (off the hot path) ----
+
+    def decode(self, doc_id: int) -> str:
+        """The doc's visible content, whether resident or spooled."""
+        rec = self.docs[doc_id]
+        if rec.cls is not None:
+            st = self._pull_row(rec)
+        elif rec.spool is not None:
+            st = load_state(rec.spool)
+        else:
+            raise ValueError(f"doc {doc_id} was never admitted")
+        return decode_row_np(
+            np.asarray(st.doc[0]), int(st.length[0]), int(st.nvis[0]),
+            rec.chars,
+        )
+
+    def occupancy(self) -> dict[int, float]:
+        return {
+            c: 1.0 - len(b.free) / b.R for c, b in self.buckets.items()
+        }
+
+    def close(self) -> None:
+        """Delete the spool directory if this pool created it (a caller
+        who passed spool_dir owns its lifecycle).  Spooled docs become
+        undecodable afterwards — call only once served docs are done."""
+        if self._owns_spool and os.path.isdir(self.spool_dir):
+            import shutil
+
+            shutil.rmtree(self.spool_dir, ignore_errors=True)

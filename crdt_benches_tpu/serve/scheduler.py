@@ -1,0 +1,269 @@
+"""Admission + batching scheduler for the document fleet.
+
+Drains per-doc op queues into fixed-shape device batches: every round,
+each capacity class gets one (R, B) unit-op batch — row r carries the
+next ≤B ops of the doc resident in row r, idle rows are padded with
+``kind == PAD`` no-ops — and the pool applies it in one vmapped step.
+
+Policy (deterministic, host-only — no device syncs on the decision path):
+
+- **round-robin fairness**: active docs are served in FIFO order and
+  rotate to the back after being scheduled, so a huge doc cannot starve
+  the fleet;
+- **class selection per chunk**: a doc's capacity need after its next
+  chunk is host-known (n_init + cumulative inserts), so promotion to a
+  larger class happens *before* the chunk that would overflow — the
+  device never sees an over-capacity insert;
+- **eviction**: when a selected doc's target bucket has no free row, the
+  scheduler evicts a resident that is not scheduled this round —
+  finished docs first, then least-recently-scheduled — through the
+  pool's checkpoint spool.  A selected set never exceeds the bucket's
+  row count, so a victim always exists.
+- **arrival**: each doc becomes active at its session's arrival round
+  (the workload's arrival staggering), modeling sessions joining a live
+  server rather than a cold batch job.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..traces.tensorize import INSERT, PAD, tensorize
+from .pool import DocPool
+
+
+@dataclass
+class DocStream:
+    """One doc's pending op queue (host-side, read-only arrays + cursor)."""
+
+    doc_id: int
+    kind: np.ndarray  # int32[N] unit ops (unpadded)
+    pos: np.ndarray
+    slot: np.ndarray
+    ins_cum: np.ndarray  # int32[N] inclusive cumulative INSERT count
+    n_patches: int
+    arrival: int = 0
+    cursor: int = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self.kind) - self.cursor
+
+    def need_after(self, n_init: int, take: int) -> int:
+        """Slot capacity needed once the next ``take`` ops are applied."""
+        end = self.cursor + take
+        return n_init + (int(self.ins_cum[end - 1]) if end else 0)
+
+
+def prepare_streams(sessions, pool: DocPool, batch: int = 64
+                    ) -> dict[int, DocStream]:
+    """Tensorize every session's trace, register the docs with the pool,
+    and return the per-doc op queues.  Sessions sharing an identical
+    trace object (the workload caches trace prefixes) share the
+    tensorized arrays — the queues only differ in cursor state."""
+    streams: dict[int, DocStream] = {}
+    cache: dict[int, tuple] = {}  # id(trace) -> (tt, chars)
+    for s in sessions:
+        hit = cache.get(id(s.trace))
+        if hit is None:
+            tt = tensorize(s.trace, batch=1)
+            chars = np.zeros(tt.capacity, np.int32)
+            chars[: len(tt.init_chars)] = tt.init_chars
+            ins = tt.kind == INSERT
+            chars[tt.slot[ins]] = tt.ch[ins]
+            hit = cache[id(s.trace)] = (tt, chars)
+        tt, chars = hit
+        n = tt.n_ops
+        pool.register(
+            s.doc_id, n_init=len(tt.init_chars),
+            capacity_need=tt.capacity, chars=chars,
+        )
+        streams[s.doc_id] = DocStream(
+            doc_id=s.doc_id,
+            kind=tt.kind[:n], pos=tt.pos[:n], slot=tt.slot[:n],
+            ins_cum=np.cumsum(tt.kind[:n] == INSERT).astype(np.int32),
+            n_patches=tt.n_patches,
+            arrival=getattr(s, "arrival", 0),
+        )
+    return streams
+
+
+@dataclass
+class ServeStats:
+    """One drain's telemetry (the serve family's report surface)."""
+
+    round_latencies: list[float] = field(default_factory=list)
+    occupancy: list[float] = field(default_factory=list)  # per round
+    queue_depth: list[int] = field(default_factory=list)  # per round
+    rounds: int = 0
+    ops: int = 0
+    patches: int = 0
+    evictions: int = 0
+    restores: int = 0
+    promotions: int = 0
+    admissions: int = 0
+    wall_time: float = 0.0
+
+
+class FleetScheduler:
+    def __init__(self, pool: DocPool, streams: dict[int, DocStream],
+                 batch: int = 64):
+        self.pool = pool
+        self.streams = streams
+        self.batch = batch
+        self.round = 0
+        # FIFO of doc ids not yet arrived or with pending ops, in
+        # arrival order (stable for determinism).
+        self._rr = deque(sorted(
+            streams, key=lambda d: (streams[d].arrival, d)
+        ))
+        self.stats = ServeStats(
+            patches=sum(s.n_patches for s in streams.values())
+        )
+
+    # ---- one round ----
+
+    def _select(self) -> tuple[dict[int, list], int]:
+        """Pick this round's lanes: {class: [(stream, take)]}, bounded by
+        each bucket's row count, in round-robin order.  Returns the plan
+        and the number of active docs left waiting (queue depth)."""
+        plan: dict[int, list] = {c: [] for c in self.pool.classes}
+        waiting = 0
+        scheduled: list[int] = []
+        deferred: list[int] = []
+        while self._rr:
+            doc_id = self._rr.popleft()
+            st = self.streams[doc_id]
+            if st.remaining == 0:
+                continue  # drained: drop from the rotation for good
+            if st.arrival > self.round:
+                deferred.append(doc_id)
+                continue
+            take = min(self.batch, st.remaining)
+            rec = self.pool.docs[doc_id]
+            cls = self.pool.class_for(
+                max(st.need_after(rec.n_init, take), rec.length, 1)
+            )
+            b = self.pool.buckets[cls]
+            if len(plan[cls]) >= b.R:
+                waiting += 1
+                deferred.append(doc_id)
+                continue
+            plan[cls].append((st, take))
+            scheduled.append(doc_id)
+        # rotation: scheduled docs go to the back; deferred keep order.
+        self._rr.extend(deferred)
+        self._rr.extend(scheduled)
+        return plan, waiting
+
+    def _place(self, cls: int, lanes: list, selected_all: set[int]) -> None:
+        """Make every selected doc resident in ``cls``, evicting
+        not-selected residents when the bucket is full."""
+        selected = {st.doc_id for st, _ in lanes}
+        b = self.pool.buckets[cls]
+        for st, take in lanes:
+            rec = self.pool.docs[st.doc_id]
+            if rec.cls == cls:
+                continue
+            if not b.free:
+                victim = self._pick_victim(cls, selected, selected_all)
+                self.pool.evict(victim)
+            self.pool.admit(st.doc_id, st.need_after(rec.n_init, take))
+            self.stats.admissions += 1
+
+    def _pick_victim(self, cls: int, selected: set[int],
+                     selected_all: set[int]) -> int:
+        """Eviction victim in ``cls``: finished docs first, then the
+        least recently scheduled pending doc not selected this round.
+        Docs scheduled in ANY class this round (e.g. a resident about to
+        promote out of ``cls``) are spared when possible — evicting one
+        would turn its direct promotion into a spool round-trip — but
+        remain the liveness fallback: only this class's own selected set
+        is guaranteed to leave a candidate."""
+        candidates = [
+            d for d, _row in self.pool.residents(cls) if d not in selected
+        ]
+        if not candidates:
+            raise RuntimeError(
+                f"bucket c{cls}: no eviction candidate "
+                "(selected set exceeds bucket rows?)"
+            )
+        preferred = [d for d in candidates if d not in selected_all]
+        return min(
+            preferred or candidates,
+            key=lambda d: (
+                self.streams[d].remaining > 0,  # finished docs first
+                self.pool.docs[d].last_sched,
+                d,
+            ),
+        )
+
+    def run_round(self) -> bool:
+        """One scheduling round.  Returns False when no work remains."""
+        plan, waiting = self._select()
+        lanes_used = sum(len(v) for v in plan.values())
+        if lanes_used == 0:
+            if any(
+                s.remaining and s.arrival > self.round
+                for s in self.streams.values()
+            ):
+                self.round += 1  # idle tick: waiting on arrivals
+                return True
+            return False
+        selected_all = {
+            st.doc_id for lanes in plan.values() for st, _ in lanes
+        }
+        t0 = time.perf_counter()
+        for cls, lanes in plan.items():
+            if not lanes:
+                continue
+            self._place(cls, lanes, selected_all)
+            b = self.pool.buckets[cls]
+            B = self.batch
+            kind = np.full((b.R, B), PAD, np.int32)
+            pos = np.zeros((b.R, B), np.int32)
+            slot = np.full((b.R, B), -1, np.int32)
+            for st, take in lanes:
+                rec = self.pool.docs[st.doc_id]
+                r, c0 = rec.row, st.cursor
+                kind[r, :take] = st.kind[c0:c0 + take]
+                pos[r, :take] = st.pos[c0:c0 + take]
+                slot[r, :take] = st.slot[c0:c0 + take]
+            self.pool.step(cls, kind, pos, slot)
+            for st, take in lanes:
+                rec = self.pool.docs[st.doc_id]
+                st.cursor += take
+                rec.length = rec.n_init + int(st.ins_cum[st.cursor - 1])
+                rec.last_sched = self.round
+                self.stats.ops += take
+        self.pool.block()
+        dt = time.perf_counter() - t0
+        self.stats.round_latencies.append(dt)
+        total_lanes = sum(b.R for b in self.pool.buckets.values())
+        self.stats.occupancy.append(lanes_used / total_lanes)
+        self.stats.queue_depth.append(waiting)
+        self.round += 1
+        return True
+
+    def run(self, max_rounds: int | None = None) -> ServeStats:
+        """Drain every queue (or stop after ``max_rounds``)."""
+        t0 = time.perf_counter()
+        n = 0
+        while self.run_round():
+            n += 1
+            if max_rounds is not None and n >= max_rounds:
+                break
+        self.stats.wall_time += time.perf_counter() - t0
+        self.stats.rounds = len(self.stats.round_latencies)
+        self.stats.evictions = self.pool.evictions
+        self.stats.restores = self.pool.restores
+        self.stats.promotions = self.pool.promotions
+        return self.stats
+
+    @property
+    def done(self) -> bool:
+        return all(s.remaining == 0 for s in self.streams.values())

@@ -1,0 +1,194 @@
+"""Multi-tenant workload generation for the document fleet.
+
+Interleaves the four real editing traces with ``traces/synth.py`` random
+streams across N simulated sessions.  A full real trace needs up to
+~260k slots — far beyond any pool class, and a serving fleet hosts many
+small-to-medium docs, not one giant one — so real-trace sessions replay
+a **folded prefix window**:
+
+- leading patches that alone would blow the slot budget (rustcode opens
+  with a 42k-char file paste, seph-blog1 with a 4k one) are *folded*
+  into ``start_content`` via the oracle — init slots cost no unit ops,
+  they materialize directly in the fresh document row;
+- the following patches form the edit stream, truncated so the doc's
+  total slot need (init chars + window inserts) fits the band's budget.
+
+Positions stay exactly the original trace's, so the oracle replay of
+the window over the folded start is byte-for-byte ground truth.
+
+The **mix** is a weight table over size *bands*; each band pins a stream
+source ("synth" with an op-count range, or a real-trace budget) so
+documents land across every pool capacity class.  Sessions get a
+staggered **arrival round**, modeling tenants joining a live server.
+
+Real-trace windows are cached per (trace, band): all sessions of one
+band edit the same template document (many users editing from a shared
+starting point); synthetic sessions are all distinct (seeded per doc).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..oracle.text_oracle import OracleDocument
+from ..traces.loader import TRACES, TestData, TestTxn, load_testing_data
+from ..traces.synth import synth_trace
+
+#: band -> (source, sizing).
+#: "synth": (lo, hi) op-count range per doc.
+#: "trace": (slot_budget, window_ins_cap) — the doc's total slot need
+#: (init + window inserts) stays <= slot_budget, and the edit window is
+#: additionally capped at window_ins_cap inserted chars (None = only the
+#: budget caps it) so huge-class docs don't dominate drain time.
+BANDS: dict[str, tuple[str, object]] = {
+    "synth-small": ("synth", (24, 160)),
+    "synth-medium": ("synth", (320, 900)),
+    "synth-large": ("synth", (1400, 3400)),
+    "trace-small": ("trace", (240, None)),
+    "trace-medium": ("trace", (1000, None)),
+    "trace-large": ("trace", (3900, None)),
+    "trace-xl": ("trace", (8000, 1600)),
+    "trace-huge": ("trace", (49000, 1200)),
+}
+
+#: mix name -> {band: weight}.  "mixed" is the headline multi-tenant
+#: blend; "synth"/"traces" isolate the two stream sources.
+MIXES: dict[str, dict[str, float]] = {
+    "mixed": {
+        "synth-small": 0.36, "synth-medium": 0.12, "synth-large": 0.05,
+        "trace-small": 0.20, "trace-medium": 0.12, "trace-large": 0.07,
+        "trace-xl": 0.05, "trace-huge": 0.03,
+    },
+    "synth": {
+        "synth-small": 0.60, "synth-medium": 0.28, "synth-large": 0.12,
+    },
+    "traces": {
+        "trace-small": 0.35, "trace-medium": 0.25, "trace-large": 0.20,
+        "trace-xl": 0.12, "trace-huge": 0.08,
+    },
+}
+
+
+@dataclass
+class Session:
+    """One simulated tenant: a doc id, its edit stream, and when it
+    joins the fleet (in scheduler rounds)."""
+
+    doc_id: int
+    band: str
+    source: str  # "synth" or a real trace name
+    trace: TestData
+    arrival: int = 0
+
+
+@functools.lru_cache(maxsize=8)
+def _full_trace(name: str) -> TestData:
+    return load_testing_data(name)
+
+
+@functools.lru_cache(maxsize=64)
+def trace_prefix(name: str, slot_budget: int,
+                 window_cap: int | None = None) -> TestData:
+    """A real-trace session document: fold leading patches into
+    ``start_content`` until the next patch fits the budget, then take
+    the longest following patch window whose slot need (start chars +
+    window inserts) stays within ``slot_budget`` (and, if given, whose
+    window inserts stay within ``window_cap``).  ``end_content`` is left
+    empty — the oracle defines truth for partial replays (same
+    convention as traces/synth.py).  Raises if the trace cannot fit the
+    budget at any fold point."""
+    full = _full_trace(name)
+    patches = list(full.iter_patches())
+    doc = OracleDocument.from_str(full.start_content)
+    fold = 0
+    while fold <= len(patches):
+        n_init = len(doc)
+        if n_init <= slot_budget and fold < len(patches):
+            need = n_init
+            window = []
+            win_ins = 0
+            for p in patches[fold:]:
+                need += len(p.ins)
+                win_ins += len(p.ins)
+                if need > slot_budget or (
+                    window_cap is not None and win_ins > window_cap
+                ):
+                    break
+                window.append(p)
+            if window:
+                return TestData(doc.content(), "", [TestTxn("", window)])
+        if fold == len(patches):
+            break
+        p = patches[fold]
+        doc.replace(p.pos, p.pos + p.del_count, p.ins)
+        fold += 1
+    raise ValueError(
+        f"{name}: no patch window fits slot budget {slot_budget}"
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _fitting_traces(slot_budget: int, window_cap: int | None) -> tuple:
+    """Real traces that can provide a window for this budget.  Folding
+    is bounded by how far the opening pastes reach; every budget >= 240
+    admits at least automerge-paper (pure keystrokes from empty)."""
+    fits = []
+    for name in TRACES:
+        try:
+            trace_prefix(name, slot_budget, window_cap)
+        except ValueError:
+            continue
+        fits.append(name)
+    if not fits:
+        raise ValueError(f"no trace fits slot budget {slot_budget}")
+    return tuple(fits)
+
+
+def build_fleet(
+    n_docs: int,
+    mix: str | dict[str, float] = "mixed",
+    seed: int = 0,
+    arrival_span: int = 8,
+    bands: dict | None = None,
+) -> list[Session]:
+    """N sessions drawn from the mix's band weights, with arrival rounds
+    staggered uniformly over ``arrival_span`` rounds.  ``mix`` is a name
+    from MIXES or an explicit {band: weight} table; ``bands`` overrides
+    the band sizing table (tests use tiny bands)."""
+    weights = MIXES[mix] if isinstance(mix, str) else dict(mix)
+    table = BANDS if bands is None else bands
+    names = sorted(weights)
+    w = np.asarray([weights[b] for b in names], float)
+    if not np.all(w >= 0) or w.sum() <= 0:
+        raise ValueError(f"bad mix weights {weights}")
+    w = w / w.sum()
+    rng = np.random.default_rng(seed)
+    band_of = rng.choice(len(names), size=n_docs, p=w)
+    arrivals = (
+        rng.integers(0, arrival_span, size=n_docs)
+        if arrival_span > 1 else np.zeros(n_docs, int)
+    )
+    sessions: list[Session] = []
+    trace_rr = 0
+    for doc_id in range(n_docs):
+        band = names[int(band_of[doc_id])]
+        source, sizing = table[band]
+        if source == "synth":
+            lo, hi = sizing
+            n_ops = int(rng.integers(lo, hi + 1))
+            trace = synth_trace(seed=int(rng.integers(1 << 31)), n_ops=n_ops)
+            src = "synth"
+        else:
+            budget, cap = sizing
+            fits = _fitting_traces(int(budget), cap)
+            src = fits[trace_rr % len(fits)]
+            trace_rr += 1
+            trace = trace_prefix(src, int(budget), cap)
+        sessions.append(Session(
+            doc_id=doc_id, band=band, source=src, trace=trace,
+            arrival=int(arrivals[doc_id]),
+        ))
+    return sessions

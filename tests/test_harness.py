@@ -5,11 +5,15 @@ mild/severe outlier analysis; VERDICT r3 missing #1)."""
 
 import itertools
 
+import pytest
+
 from crdt_benches_tpu.bench.harness import (
     BenchResult,
     SampleList,
+    _quantile,
     classify_outliers,
     measure,
+    quantiles,
 )
 
 
@@ -101,6 +105,39 @@ def test_benchresult_persists_outlier_record():
     assert d["min"] == 24.08 and d["max"] == 24.13
     assert d["outliers"]["severe"] == 0
     assert r.worst == 24.13
+
+
+def test_quantile_linear_interpolation():
+    # 1..100: p50 sits exactly between the 50th and 51st order stats;
+    # p95/p99 interpolate at k = p*(n-1) (the serve family's latency
+    # quantiles must match numpy's default 'linear' method)
+    s = [float(x) for x in range(1, 101)]
+    assert _quantile(s, 0.5) == pytest.approx(50.5)
+    assert _quantile(s, 0.95) == pytest.approx(95.05)
+    assert _quantile(s, 0.99) == pytest.approx(99.01)
+    assert _quantile(s, 0.0) == 1.0 and _quantile(s, 1.0) == 100.0
+    import numpy as np
+
+    for p in (0.5, 0.9, 0.95, 0.99):
+        assert _quantile(s, p) == pytest.approx(float(np.quantile(s, p)))
+
+
+def test_quantiles_table_and_benchresult_properties():
+    q = quantiles(list(range(1, 101)))
+    assert set(q) == {"p50", "p95", "p99"}
+    assert q["p50"] <= q["p95"] <= q["p99"]
+    # order-independent, single-sample degenerate case, empty rejects
+    assert quantiles([3.0, 1.0, 2.0]) == quantiles([1.0, 2.0, 3.0])
+    assert quantiles([7.0]) == {"p50": 7.0, "p95": 7.0, "p99": 7.0}
+    with pytest.raises(ValueError):
+        quantiles([])
+    r = BenchResult("serve", "mixed", "16", 100,
+                    [float(x) for x in range(1, 101)])
+    assert (r.p50, r.p95, r.p99) == (
+        pytest.approx(50.5), pytest.approx(95.05), pytest.approx(99.01)
+    )
+    d = r.to_dict()
+    assert d["p50"] == r.p50 and d["p95"] == r.p95 and d["p99"] == r.p99
 
 
 def test_classify_relative_floor_on_tight_clusters():

@@ -1,0 +1,247 @@
+"""serve/ document-fleet engine: byte-exact multi-tenant serving.
+
+Every test's ground truth is oracle/text_oracle.py replaying the same
+per-doc stream — the correctness gate of the serve subsystem: documents
+hosted in shared bucketed device states, churned through checkpoint
+eviction/restore and capacity-class promotion, must finish byte-identical
+to an uninterrupted single-doc replay.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, prepare_streams
+from crdt_benches_tpu.serve.workload import (
+    Session,
+    build_fleet,
+    trace_prefix,
+)
+
+#: tiny band table: docs span both test classes (128 / 512) while the
+#: whole fleet stays a few thousand unit ops.
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+def _drain(sessions, pool, batch=16):
+    streams = prepare_streams(sessions, pool, batch=batch)
+    sched = FleetScheduler(pool, streams, batch=batch)
+    stats = sched.run()
+    assert sched.done
+    return stats
+
+
+def test_fleet_all_docs_byte_identical_under_churn(tmp_path):
+    """24 docs through 12 rows: admission churn (evict + restore) and
+    medium docs promoted 128 -> 512 mid-replay, every doc oracle-exact."""
+    sessions = build_fleet(
+        24, mix=TINY_MIX, seed=3, arrival_span=3, bands=TINY_BANDS
+    )
+    pool = DocPool(classes=(128, 512), slots=(8, 4),
+                   spool_dir=str(tmp_path))
+    stats = _drain(sessions, pool)
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace), (
+            f"doc {s.doc_id} ({s.band}) diverged from oracle"
+        )
+    # the point of the sizing: the policies actually ran
+    assert stats.evictions > 0 and stats.restores > 0
+    assert stats.promotions > 0
+    assert stats.rounds == len(stats.round_latencies)
+    scratch = DocPool(classes=(512,), slots=(4,),
+                      spool_dir=str(tmp_path / "scratch"))
+    assert stats.ops == sum(
+        len(st.kind) for st in
+        prepare_streams(sessions, scratch, batch=16).values()
+    )
+    assert all(0.0 < o <= 1.0 for o in stats.occupancy)
+
+
+def test_real_trace_prefix_sessions_oracle(tmp_path):
+    """Folded real-trace windows (incl. sveltecomponent's pasted opener
+    folded into start_content) serve byte-exactly next to synth docs."""
+    tr_small = trace_prefix("automerge-paper", 240)
+    tr_med = trace_prefix("sveltecomponent", 1000)
+    assert len(tr_med.start_content) > 0  # the fold actually happened
+    sessions = build_fleet(
+        4, mix=TINY_MIX, seed=11, arrival_span=1, bands=TINY_BANDS
+    )
+    nxt = len(sessions)
+    sessions += [
+        Session(doc_id=nxt, band="trace-small", source="automerge-paper",
+                trace=tr_small),
+        Session(doc_id=nxt + 1, band="trace-medium",
+                source="sveltecomponent", trace=tr_med),
+    ]
+    pool = DocPool(classes=(256, 1024), slots=(4, 2),
+                   spool_dir=str(tmp_path))
+    _drain(sessions, pool)
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+
+
+def test_checkpoint_roundtrip_evict_into_different_row(tmp_path):
+    """The satellite case: evict a doc mid-replay through the checkpoint
+    spool, restore it into a DIFFERENT bucket row, finish the replay —
+    byte-identical to an uninterrupted replay of the same stream."""
+    from crdt_benches_tpu.traces.synth import synth_trace
+
+    traces = [synth_trace(seed=100 + i, n_ops=80) for i in range(3)]
+    sessions = [
+        Session(doc_id=i, band="synth-small", source="synth", trace=t)
+        for i, t in enumerate(traces)
+    ]
+    pool = DocPool(classes=(128,), slots=(2,), spool_dir=str(tmp_path))
+    streams = prepare_streams(sessions, pool, batch=16)
+    sched = FleetScheduler(pool, streams, batch=16)
+
+    # run a couple of rounds, then force doc 0 out mid-replay
+    sched.run(max_rounds=2)
+    rec0 = pool.docs[0]
+    assert streams[0].cursor > 0 and streams[0].remaining > 0
+    if rec0.cls is None:  # ensure doc 0 is resident so we can evict it
+        if not pool.buckets[128].free:
+            pool.evict(pool.residents(128)[0][0])
+        pool.admit(0, need=rec0.length)
+    row_before = rec0.row
+    spool = pool.evict(0)
+    assert os.path.exists(spool) and rec0.spool == spool
+    assert rec0.cls is None
+
+    # occupy the freed row with a non-resident doc (the free list is
+    # LIFO, so it lands exactly in doc 0's old row), then make room in
+    # the OTHER row — doc 0 must rehydrate into a different slot
+    other = next(d for d in (1, 2) if pool.docs[d].cls is None)
+    assert pool.admit(other, need=pool.docs[other].length)[1] == row_before
+    for d, _row in pool.residents(128):
+        if pool.docs[d].row != row_before:
+            pool.evict(d)
+    cls, row_after = pool.admit(0, need=rec0.length)
+    assert (cls, row_after) != (128, row_before), (
+        "test setup: doc 0 restored into its old slot; churn not exercised"
+    )
+
+    sched.run()  # drain the rest
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+    assert pool.restores >= 1
+
+
+def test_mesh_fleet_matches_unsharded(tmp_path):
+    """Docs-over-mesh: the same fleet sharded over the 8 virtual CPU
+    devices (parallel/mesh.py) decodes identically to the single-device
+    run, and both match the oracle."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    from crdt_benches_tpu.parallel.mesh import replica_mesh
+
+    sessions = build_fleet(
+        12, mix={"synth-small": 1.0}, seed=5, arrival_span=2,
+        bands=TINY_BANDS,
+    )
+
+    def run(mesh, sub):
+        pool = DocPool(classes=(128,), slots=(8,), mesh=mesh,
+                       spool_dir=str(tmp_path / sub))
+        _drain(sessions, pool)
+        return {s.doc_id: pool.decode(s.doc_id) for s in sessions}
+
+    plain = run(None, "plain")
+    sharded = run(replica_mesh(8), "mesh")
+    assert plain == sharded
+    for s in sessions:
+        assert plain[s.doc_id] == replay_trace(s.trace)
+
+
+def test_pool_rejects_bad_config(tmp_path):
+    with pytest.raises(ValueError):
+        DocPool(classes=(100,), slots=(4,))  # not a LANE multiple
+    with pytest.raises(ValueError):
+        DocPool(classes=(512, 128), slots=(2, 2))  # not ascending
+    pool = DocPool(classes=(128,), slots=(2,), spool_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        pool.register(0, n_init=0, capacity_need=4096,
+                      chars=np.zeros(4096, np.int32))  # beyond largest
+
+
+def test_build_fleet_deterministic_and_weighted():
+    a = build_fleet(40, mix=TINY_MIX, seed=9, bands=TINY_BANDS)
+    b = build_fleet(40, mix=TINY_MIX, seed=9, bands=TINY_BANDS)
+    assert [(s.band, s.arrival, len(s.trace)) for s in a] == [
+        (s.band, s.arrival, len(s.trace)) for s in b
+    ]
+    assert {s.band for s in a} == set(TINY_MIX)
+    with pytest.raises(ValueError):
+        build_fleet(4, mix={"synth-small": -1.0}, bands=TINY_BANDS)
+
+
+def test_serve_bench_smoke(tmp_path):
+    """The bench family end to end at toy scale: artifact written with
+    throughput + latency quantiles, in-run verification green."""
+    import json
+
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=16, batch=16,
+        classes=(128, 512), slots=(8, 4), seed=2, arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    assert r.bench_id == "serve/custom/16"
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    assert d["group"] == "serve" and d["elements"] > 0
+    lat = d["extra"]["batch_latency"]
+    assert set(lat) == {"p50", "p95", "p99"}
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
+    assert d["extra"]["verify_ok"] is True
+    assert d["elements_per_sec"] > 0
+    # the sample spans every class that hosted docs
+    hosted = set(d["extra"]["docs_per_class"])
+    assert len(d["extra"]["verified_docs"]) >= min(
+        4, sum(d["extra"]["docs_per_class"].values())
+    )
+    assert hosted  # at least one class in use
+
+
+@pytest.mark.slow
+def test_fleet_moderate_scale(tmp_path):
+    """Full-gate scale: 256 docs over three classes with real-trace
+    windows in the mix; a 24-doc sample (every class) oracle-verified."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix={
+            "synth-small": 0.36, "synth-medium": 0.12, "synth-large": 0.06,
+            "trace-small": 0.21, "trace-medium": 0.15, "trace-large": 0.10,
+        },
+        n_docs=256, batch=32,
+        classes=(256, 1024, 4096), slots=(64, 24, 12), seed=1,
+        arrival_span=4, verify_sample=24,
+        bands={
+            "synth-small": ("synth", (24, 160)),
+            "synth-medium": ("synth", (320, 900)),
+            "synth-large": ("synth", (1400, 3400)),
+            "trace-small": ("trace", (240, None)),
+            "trace-medium": ("trace", (1000, None)),
+            "trace-large": ("trace", (3900, None)),
+        },
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    assert len(r.extra["docs_per_class"]) == 3

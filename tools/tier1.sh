@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# The blessed tier-1 gate — byte-for-byte the ROADMAP.md "Tier-1 verify"
+# command, so builders and CI invoke ONE script instead of re-typing it.
+# Runs the quick tier (every non-slow test) in a single process on CPU,
+# with a hard timeout, and echoes DOTS_PASSED=<count> for the driver.
+#
+# Usage: tools/tier1.sh        (from the repo root)
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
