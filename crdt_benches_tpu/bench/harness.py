@@ -128,6 +128,25 @@ def quantiles(samples, ps=(0.5, 0.95, 0.99)) -> dict[str, float]:
     return {f"p{100 * p:g}": _quantile(s, p) for p in ps}
 
 
+def steady_quantiles(
+    samples, skip_flags, ps=(0.5, 0.95, 0.99)
+) -> tuple[dict[str, float], float, int]:
+    """Quantiles over the samples NOT flagged in ``skip_flags`` — the
+    serve family's steady-state latency report, where flagged rounds are
+    the ones that triggered an XLA compile (cold-start skew, not serving
+    jitter; a p95 of 3.2s against a p50 of 0.7s in the round-loop
+    engine's artifact was pure compile noise).  Falls back to the full
+    list when every sample is flagged (tiny drains).  Returns
+    (quantile table, flagged_time, flagged_count)."""
+    if len(samples) != len(skip_flags):
+        raise ValueError(
+            f"{len(samples)} samples vs {len(skip_flags)} skip flags"
+        )
+    kept = [s for s, skip in zip(samples, skip_flags) if not skip]
+    skipped = [s for s, skip in zip(samples, skip_flags) if skip]
+    return quantiles(kept or list(samples), ps), sum(skipped), len(skipped)
+
+
 def classify_outliers(samples: list[float]) -> dict:
     """Tukey-fence outlier classification (criterion's analysis: mild
     outside Q1/Q3 +- 1.5*IQR, severe outside +- 3*IQR — the capability the

@@ -16,9 +16,13 @@ Families:
   classic (default) — the per-trace replay matrix above
   serve             — the multi-tenant document-fleet engine (serve/):
       python -m crdt_benches_tpu.bench.runner --family serve \
-          --serve-docs 4096 --serve-mix mixed --serve-mesh 8
-      Bench ids are serve/<mix>/<fleet-size>; the run reports fleet
-      patches/sec + p50/p95/p99 per-batch latency, byte-verifies a
+          --serve-docs 4096 --serve-mix mixed --serve-mesh 8 \
+          --serve-macro 8
+      Bench ids are serve/<mix>/<fleet-size>; the run drains the fleet
+      through K-deep macro-round dispatches (--serve-macro) of RLE-
+      coalesced range ops, reports fleet patches/sec + steady-state
+      p50/p95/p99 batch latency (compile rounds excluded, compile_time
+      separate) + pad_fraction/coalesce_ratio, byte-verifies a
       per-capacity-class doc sample against the oracle, and writes
       bench_results/serve_<mix>_<docs>.json.
 """
@@ -639,12 +643,19 @@ def run_serve(args) -> int:
         arrival_span=args.serve_arrival_span,
         mesh_devices=mesh_devices,
         verify_sample=args.serve_verify_sample,
+        macro_k=args.serve_macro,
+        batch_chars=args.serve_batch_chars,
+        save_name=args.serve_save_name,
         log=lambda m: print(m, file=sys.stderr),
     )
     print(
         f"{r.bench_id}: {r.elements_per_sec:,.0f} patches/s "
-        f"(batch latency p50 {r.extra['batch_latency']['p50'] * 1e3:.1f}ms "
-        f"/ p99 {r.extra['batch_latency']['p99'] * 1e3:.1f}ms)"
+        f"(K={r.extra['macro_k']}, steady batch latency "
+        f"p50 {r.extra['batch_latency']['p50'] * 1e3:.1f}ms "
+        f"/ p99 {r.extra['batch_latency']['p99'] * 1e3:.1f}ms, "
+        f"compile {r.extra['compile_time']:.2f}s, "
+        f"coalesce x{r.extra['coalesce_ratio']:.2f}, "
+        f"pad {r.extra['pad_fraction']:.3f})"
     )
     return 0 if info["verify_ok"] else 1
 
@@ -660,7 +671,17 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-mix", default="mixed",
                     help="workload mix name (serve/workload.py MIXES)")
     ap.add_argument("--serve-batch", type=int, default=64,
-                    help="unit ops per doc per scheduling round")
+                    help="coalesced range ops per doc per device round")
+    ap.add_argument("--serve-macro", type=int, default=8, metavar="K",
+                    help="macro-round depth: K staged rounds per device "
+                         "dispatch (lax.scan; 1 = legacy per-round "
+                         "dispatch through the same machinery)")
+    ap.add_argument("--serve-batch-chars", type=int, default=256,
+                    help="inserted chars per doc per device round (bounds "
+                         "the expansion nbits; insert runs are pre-split "
+                         "to fit)")
+    ap.add_argument("--serve-save-name", default=None,
+                    help="artifact basename (default serve_<mix>_<docs>)")
     ap.add_argument("--serve-classes", default="256,1024,4096,8192,49152",
                     help="capacity classes (slots per doc, ascending; the "
                          "largest must hold the biggest workload doc — "

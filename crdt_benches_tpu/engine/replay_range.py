@@ -51,15 +51,34 @@ def replay_ranges(
     apply: 'v3' = per-pass XLA apply on PackedState
     (ops/apply_range.py), 'v4' = fused-kernel apply on the maintained-cv
     PackedState4 (ops/apply_range_fused.py) — the state pytree must
-    match."""
-    from ..ops.resolve_range_pallas import resolve_range_pallas
+    match.
 
+    Resolver selection rides the ``interpret`` flag: on TPU (interpret
+    False) the fused Pallas kernel; off-TPU the native-XLA scan resolver
+    (ops/resolve_range_scan.py) — differentially tested equal — instead
+    of interpret-mode emulation of the kernel, which pays ref-tracking
+    overhead for no hardware reason.  The scan resolver's token list is
+    always the full 2B+2, so ``token_cap`` (a VMEM sizing lever) only
+    shapes the Pallas path."""
     if engine == "v4":
         from ..ops.apply_range_fused import apply_range_batch4
 
         apply_fn = partial(apply_range_batch4, interpret=interpret)
     else:
         apply_fn = apply_range_batch
+
+    if interpret:
+        from ..ops.resolve_range_scan import resolve_ranges_shared
+
+        def resolve(k, p, ln, s0, nvis):
+            return resolve_ranges_shared(k, p, ln, s0, nvis)
+    else:
+        from ..ops.resolve_range_pallas import resolve_range_pallas
+
+        def resolve(k, p, ln, s0, nvis):
+            return resolve_range_pallas(
+                k, p, ln, s0, nvis, interpret=False, token_cap=token_cap
+            )
 
     NB, B = kind_b.shape
     K = min(pack, NB)
@@ -71,9 +90,8 @@ def replay_ranges(
         st, mx = carry
         k, p, ln, s0 = batch
         for i in range(K):
-            tokens, dints, nused = resolve_range_pallas(
-                k[i], p[i], ln[i], s0[i], st.nvis, interpret=interpret,
-                token_cap=token_cap,
+            tokens, dints, nused = resolve(
+                k[i], p[i], ln[i], s0[i], st.nvis
             )
             mx = jnp.maximum(mx, jnp.max(nused))
             st = apply_fn(st, tokens, dints, nbits=nbits)
@@ -236,9 +254,15 @@ class RangeReplayEngine:
                 nbits=self.nbits, pack=self.pack, interpret=self.interpret,
                 token_cap=tcap, engine=self.engine,
             )
-            demands.append(
-                (effective_token_list_size(kind.shape[1], tcap), mx)
+            # Off-TPU the scan resolver always carries the exact 2B+2
+            # worst-case list — token_cap (a Pallas VMEM lever) must not
+            # shrink the bound the demand is checked against.
+            B = kind.shape[1]
+            t_eff = (
+                2 * B + 2 if self.interpret
+                else effective_token_list_size(B, tcap)
             )
+            demands.append((t_eff, mx))
         for i, (t_eff, mx) in enumerate(demands):
             got = int(mx)
             if got > t_eff:  # not assert: must survive python -O

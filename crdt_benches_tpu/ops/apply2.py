@@ -230,6 +230,41 @@ def _scatter_rows(arr, idx, val, C, add: bool = False):
     )
 
 
+def spread_add_rows(idx, val, C: int):
+    """Backend-dispatched exact dense spread: int32[R, C] with
+    ``val[r, b]`` added at ``idx[r, b]`` (out-of-range indices dropped;
+    indices distinct per row).
+
+    On TPU this is the 7-bit-chunk one-hot MXU matmul (_mxu_spread —
+    capacity-sized scatters serialize on the TPU runtime).  Off-TPU the
+    MXU trick is backwards: the one-hot einsum burns R*B*(C/128)*128
+    MACs on a vector unit while a native row scatter-add is O(R*B) — the
+    serve/ fleet's CPU-mesh hot path uses this entry point so each
+    backend gets the primitive it actually executes well.
+
+    TPU precondition: ``val`` in [0, 2^28) so four 7-bit chunks cover it
+    (callers with signed values split sign first, as apply_range.py's
+    ddelta spread does).  Off-TPU any int32 value is exact."""
+    if jax.default_backend() == "tpu":
+        chunks = [
+            jnp.bitwise_and(val, 127),
+            jnp.bitwise_and(jnp.right_shift(val, 7), 127),
+            jnp.bitwise_and(jnp.right_shift(val, 14), 127),
+            jnp.bitwise_and(jnp.right_shift(val, 21), 127),
+        ]
+        outs = _mxu_spread(idx, chunks, C)
+        return (
+            outs[0]
+            + jnp.left_shift(outs[1], 7)
+            + jnp.left_shift(outs[2], 14)
+            + jnp.left_shift(outs[3], 21)
+        )
+    R = idx.shape[0]
+    return _scatter_rows(
+        jnp.zeros((R, C), jnp.int32), idx, val, C, add=True
+    )
+
+
 class PackedState(NamedTuple):
     """Packed doc-order state: one int32 per position.
 

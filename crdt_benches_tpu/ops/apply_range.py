@@ -29,7 +29,9 @@ from .apply2 import (
     _excl_cumsum_small,
     _expand,
     _mxu_spread,
+    count_le_tiled,
     count_le_two_level,
+    spread_add_rows,
 )
 from .resolve import RUN, TINS
 
@@ -105,37 +107,48 @@ def apply_range_batch(
     B = dlo.shape[1]
     drop = jnp.int32(C + 7)
     col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    on_tpu = jax.default_backend() == "tpu"
 
     vis_bit = jnp.bitwise_and(state.doc, 1)
-    cvt, tile_base, tmax_abs = _two_level_vis(state.doc, state.length)
 
-    # ---- resolve ALL rank queries in one two-level pass: delete
-    # interval endpoints (B each) + insert-gap ranks (T) ----
+    # ---- resolve ALL rank queries in one pass: delete interval
+    # endpoints (B each) + insert-gap ranks (T) ----
     has_del = dlo >= 0
     live, gvis, cumlen = extract_range_tokens(
         ttype, ta, tch, tlen, v0=state.nvis
     )
-    allq = count_le_two_level(
-        cvt, tile_base, tmax_abs,
-        jnp.concatenate(
-            [
-                jnp.where(has_del, dlo, 0),
-                jnp.where(has_del, dhi, 0),
-                jnp.where(live, gvis, 0),
-            ],
-            axis=1,
-        ),
+    allq_in = jnp.concatenate(
+        [
+            jnp.where(has_del, dlo, 0),
+            jnp.where(has_del, dhi, 0),
+            jnp.where(live, gvis, 0),
+        ],
+        axis=1,
     )
+    if on_tpu:
+        # Two-level structure + factored one-hot row fetches: the
+        # take_along_axis gathers of count_le_tiled serialize per row on
+        # the TPU runtime.
+        cvt, tile_base, tmax_abs = _two_level_vis(state.doc, state.length)
+        allq = count_le_two_level(cvt, tile_base, tmax_abs, allq_in)
+    else:
+        # Off-TPU the gathers are cheap and the einsum row fetches are
+        # not: plain absolute cumvis + tiled searchsorted.
+        cumvis = jnp.cumsum(
+            vis_bit * (col < state.length[:, None]).astype(jnp.int32),
+            axis=1,
+        )
+        allq = count_le_tiled(cumvis, allq_in)
     lo_phys = allq[:, :B]
     hi_phys = allq[:, B : 2 * B]
     gq_phys = allq[:, 2 * B :]
 
     # ---- deletes: clear visible bits over physical rank intervals ----
-    starts, = _mxu_spread(
-        jnp.where(has_del, lo_phys, drop), [has_del.astype(jnp.int32)], C
+    starts = spread_add_rows(
+        jnp.where(has_del, lo_phys, drop), has_del.astype(jnp.int32), C
     )
-    stops, = _mxu_spread(
-        jnp.where(has_del, hi_phys + 1, drop), [has_del.astype(jnp.int32)], C
+    stops = spread_add_rows(
+        jnp.where(has_del, hi_phys + 1, drop), has_del.astype(jnp.int32), C
     )
     in_del = jnp.cumsum(starts - stops, axis=1) > 0
     doc = state.doc - (vis_bit & in_del.astype(jnp.int32))
@@ -146,8 +159,8 @@ def apply_range_batch(
     dest0 = jnp.where(live, g_phys + cumlen, drop)  # (R, T)
     dstop = jnp.where(live, dest0 + tlen, drop)
 
-    s1, = _mxu_spread(dest0, [live.astype(jnp.int32)], C)
-    s2, = _mxu_spread(dstop, [live.astype(jnp.int32)], C)
+    s1 = spread_add_rows(dest0, live.astype(jnp.int32), C)
+    s2 = spread_add_rows(dstop, live.astype(jnp.int32), C)
     ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
     cnt = jnp.cumsum(ind, axis=1)
 
@@ -161,24 +174,29 @@ def apply_range_batch(
     prev_live_delta = _prev_value(delta, live)
     ddelta = jnp.where(live, delta - prev_live_delta, 0)
     dpos_ = jnp.where(live, dest0, drop)
-    # |ddelta| <= 2C: derive the 7-bit chunk count from the static
-    # capacity (3 levels covered only C < 2^20 — round-5 widening; each
-    # level's values are bf16-exact shifted small ints and every cell
-    # receives at most one contribution, so exactness is per-level).
-    dlv = ddelta_levels(C)
-    dp = jnp.where(ddelta > 0, ddelta, 0)
-    dn = jnp.where(ddelta < 0, -ddelta, 0)
-    pos_chunks = [
-        jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
-        for v in (dp, dn)
-        for k in range(dlv)
-    ]
-    outs = _mxu_spread(dpos_, pos_chunks, C)
-    dd_dense = sum(
-        jnp.left_shift(outs[k], 7 * k) for k in range(dlv)
-    ) - sum(
-        jnp.left_shift(outs[dlv + k], 7 * k) for k in range(dlv)
-    )
+    if on_tpu:
+        # |ddelta| <= 2C: derive the 7-bit chunk count from the static
+        # capacity (3 levels covered only C < 2^20 — round-5 widening;
+        # each level's values are bf16-exact shifted small ints and every
+        # cell receives at most one contribution, so exactness is
+        # per-level).
+        dlv = ddelta_levels(C)
+        dp = jnp.where(ddelta > 0, ddelta, 0)
+        dn = jnp.where(ddelta < 0, -ddelta, 0)
+        pos_chunks = [
+            jnp.bitwise_and(jnp.right_shift(v, 7 * k), 127)
+            for v in (dp, dn)
+            for k in range(dlv)
+        ]
+        outs = _mxu_spread(dpos_, pos_chunks, C)
+        dd_dense = sum(
+            jnp.left_shift(outs[k], 7 * k) for k in range(dlv)
+        ) - sum(
+            jnp.left_shift(outs[dlv + k], 7 * k) for k in range(dlv)
+        )
+    else:
+        # Native scatter-add carries the full signed int32 in one pass.
+        dd_dense = spread_add_rows(dpos_, ddelta, C)
     delta_cum = jnp.cumsum(dd_dense, axis=1)
     fill_slot = col + delta_cum
     fill_dense = jnp.where(

@@ -1,0 +1,209 @@
+"""Pure-JAX range-op resolver: the vmappable twin of the Pallas kernel.
+
+``ops/resolve_range_pallas.py`` resolves one batch of RANGE ops for R
+replicas, but it has two constraints the serve/ document fleet cannot
+live with: the op batch is SHARED across replicas (every row replays the
+same stream), and off-TPU it only runs in Pallas interpret mode.  This
+module re-expresses the same cum-primary token-list algorithm as a
+``lax.scan`` over the ops of ONE document — plain jnp, jit/vmap
+compatible — so
+
+- ``jax.vmap(resolve_ranges_scan)`` over (kind[R, B], pos, rlen, slot0,
+  nvis[R]) resolves a *different* range batch per row (the fleet pool's
+  per-document lanes), and
+- off-TPU single-stream replay (engine/replay_range.py) gets a native
+  XLA resolver instead of interpret-mode emulation.
+
+Semantics are identical to the kernel (differentially tested in
+tests/test_resolve_range_scan.py): same token encoding — RUN ``ta`` is a
+pre-batch rank, TINS ``ta`` is the op's first SLOT id, ``tch`` the
+run-internal char offset — and the same per-delete rank intervals
+``(dlo, dhi, dcount)``.  The token list is the full 2B+2 worst case
+(token_cap staging is a VMEM concern; XLA just streams it), so overflow
+is impossible by construction and ``nused`` is returned for interface
+parity only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..traces.tensorize import DELETE, INSERT
+from .resolve import FREE, RUN, TINS
+
+_BIG = np.int32(1 << 30)
+
+
+def resolve_ranges_scan(kind, pos, rlen, slot0, v0):
+    """Resolve one batch of range ops against a document with ``v0``
+    visible chars.  ``kind``/``pos``/``rlen``/``slot0``: int32[B]; ``v0``
+    scalar.  Returns ``((ttype, ta, tch, tlen) int32[T], (dlo, dhi,
+    dcount) int32[B], nused)`` with T = 2B + 2 — the shapes
+    ``ops/apply_range.py apply_range_batch`` consumes (leading replica
+    axis supplied by vmap)."""
+    B = kind.shape[0]
+    T = 2 * B + 2
+    didx = jnp.arange(T, dtype=jnp.int32)
+    v0 = jnp.asarray(v0, jnp.int32)
+
+    # ttype (2 bits) and ta travel packed as tta = ta * 4 + ttype, the
+    # kernel's packing: one place() pass instead of two.
+    tta0 = jnp.where(didx == 0, RUN, FREE).astype(jnp.int32)
+    tch0 = jnp.zeros(T, jnp.int32)
+    cum0 = jnp.zeros(T, jnp.int32) + v0  # token 0 = RUN(0, v0); flat tail
+
+    def step(carry, op):
+        tta, tch, cum, total, nused = carry
+        k, p0, L0, s0 = op
+
+        is_ins = (k == INSERT) & (L0 > 0)
+        p = jnp.clip(p0, 0, total)
+        D = jnp.where(k == DELETE, jnp.clip(L0, 0, total - p), 0)
+        is_del = (k == DELETE) & (D > 0)
+        L = jnp.where(is_ins, L0, 0)
+
+        pre_all = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
+        ttok = jnp.bitwise_and(tta, 3)
+        is_run_tok = ttok == RUN
+
+        # ---- delete rank-interval outputs (pre-clamp coordinates) ----
+        pD = p + D
+        ov_lo = jnp.maximum(pre_all, p)
+        ov_hi = jnp.minimum(cum, pD)
+        has_ov = is_del & is_run_tok & (ov_hi > ov_lo)
+        ta_all = jnp.right_shift(tta, 2)
+        r_lo = ta_all + (ov_lo - pre_all)
+        r_hi = ta_all + (ov_hi - pre_all) - 1
+        dlo = jnp.min(jnp.where(has_ov, r_lo, _BIG))
+        dhi = jnp.max(jnp.where(has_ov, r_hi, -1))
+        dn = jnp.sum(jnp.where(has_ov, ov_hi - ov_lo, 0))
+        dlo = jnp.where(is_del & (dlo < _BIG), dlo, -1)
+        dhi = jnp.where(is_del, dhi, -1)
+        dn = jnp.where(is_del, dn, 0)
+
+        # ---- vector clamp: the delete's effect on every token ----
+        consumed = jnp.maximum(
+            0, jnp.minimum(cum, pD) - jnp.maximum(pre_all, p)
+        )
+        adv = jnp.where(is_del & (cum > pD), consumed, 0)
+        cum_c = jnp.where(
+            is_del, jnp.minimum(cum, p) + jnp.maximum(0, cum - pD), cum
+        )
+        tta_c = tta + jnp.where(is_run_tok, adv * 4, 0)
+        tch_c = tch + jnp.where(ttok == TINS, adv, 0)
+
+        # ---- locate the token containing p (pre-clamp coordinates) ----
+        t = jnp.sum((cum <= p).astype(jnp.int32))
+        t = jnp.minimum(t, nused)
+        c_t = cum[t]
+        pre = pre_all[t]
+        tta_t = tta[t]
+        ch = tch[t]
+        tt = jnp.bitwise_and(tta_t, 3)
+        off = p - pre
+        is_run_t = tt == RUN
+
+        split_ins = is_ins & (off > 0)
+        split_del = is_del & (off > 0) & (pD < c_t)
+        m = jnp.where(
+            is_ins,
+            jnp.where(split_ins, 3, 2),
+            jnp.where(split_del, 2, 1),
+        )
+
+        # Replacement pieces (same arithmetic as the kernel: m == 1
+        # writes the token's CLAMPED values back — identity for
+        # inserts/PAD, the boundary adjustment for spanning deletes).
+        c_t_clamped = jnp.where(
+            is_del, jnp.minimum(c_t, p) + jnp.maximum(0, c_t - pD), c_t
+        )
+        adv_t = jnp.where(
+            is_del & (c_t > pD),
+            jnp.maximum(0, jnp.minimum(c_t, pD) - jnp.maximum(pre, p)),
+            0,
+        )
+        tta_cl = tta_t + jnp.where(is_run_t, adv_t * 4, 0)
+        ch_cl = ch + jnp.where(tt == TINS, adv_t, 0)
+        tta_right_del = tta_t + jnp.where(is_run_t, (pD - pre) * 4, 0)
+        ch_right_del = jnp.where(is_run_t, ch, ch + (pD - pre))
+        tta_right_ins = tta_t + jnp.where(is_run_t, off * 4, 0)
+        ch_right_ins = jnp.where(is_run_t, ch, ch + off)
+        jj_tins = s0 * 4 + TINS  # TINS carries the op's first slot id
+
+        n0ta = jnp.where(
+            is_ins & ~split_ins, jj_tins,
+            jnp.where(split_del, tta_t, tta_cl),
+        )
+        n0c_ = jnp.where(
+            is_ins & ~split_ins, 0, jnp.where(split_del, ch, ch_cl)
+        )
+        n0cum = jnp.where(
+            is_ins,
+            jnp.where(split_ins, p, pre + L),
+            jnp.where(split_del, p, c_t_clamped),
+        )
+        n1ta = jnp.where(
+            is_ins, jnp.where(split_ins, jj_tins, tta_t), tta_right_del
+        )
+        n1c_ = jnp.where(
+            is_ins, jnp.where(split_ins, 0, ch), ch_right_del
+        )
+        n1cum = jnp.where(
+            is_ins, jnp.where(split_ins, p + L, c_t + L), c_t - D
+        )
+        n2ta, n2c_, n2cum = tta_right_ins, ch_right_ins, c_t + L
+
+        src = jnp.clip(didx - (m - 1), 0, T - 1)
+
+        def place(x, x0, x1, x2, dlt):
+            out = jnp.where(didx < t, x, x[src] + dlt)
+            out = jnp.where(didx == t, x0, out)
+            out = jnp.where((m >= 2) & (didx == t + 1), x1, out)
+            out = jnp.where((m == 3) & (didx == t + 2), x2, out)
+            return out
+
+        tta_n = place(tta_c, n0ta, n1ta, n2ta, 0)
+        tch_n = place(tch_c, n0c_, n1c_, n2c_, 0)
+        # tail cum shifts by L past the placed pieces (deletes: 0 — their
+        # tail effect is already in the vector clamp)
+        cum_n = place(cum_c, n0cum, n1cum, n2cum, L)
+
+        return (
+            (tta_n, tch_n, cum_n, total + L - D, nused + (m - 1)),
+            (dlo, dhi, dn),
+        )
+
+    ops = (
+        jnp.asarray(kind, jnp.int32),
+        jnp.asarray(pos, jnp.int32),
+        jnp.asarray(rlen, jnp.int32),
+        jnp.asarray(slot0, jnp.int32),
+    )
+    init = (tta0, tch0, cum0, v0, jnp.int32(1))
+    (tta, tch, cum, _total, nused), (dlo, dhi, dn) = jax.lax.scan(
+        step, init, ops
+    )
+    pre_all = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
+    ttype = jnp.bitwise_and(tta, 3)
+    ta = jnp.right_shift(tta, 2)
+    tlen = cum - pre_all
+    return (ttype, ta, tch, tlen), (dlo, dhi, dn), nused
+
+
+def resolve_ranges_rows(kind, pos, rlen, slot0, v0):
+    """Per-row fleet form: kind/pos/rlen/slot0 int32[R, B] (a different
+    op batch per document lane), v0 int32[R].  Returns token arrays
+    [R, T] and delete intervals [R, B] — exactly what
+    ``apply_range_batch`` consumes."""
+    return jax.vmap(resolve_ranges_scan)(kind, pos, rlen, slot0, v0)
+
+
+def resolve_ranges_shared(kind, pos, rlen, slot0, v0):
+    """Shared-stream form (the Pallas kernel's interface): one op batch
+    int32[B] replayed by every row, per-row v0 int32[R].  The off-TPU
+    resolver for engine/replay_range.py."""
+    return jax.vmap(
+        resolve_ranges_scan, in_axes=(None, None, None, None, 0)
+    )(kind, pos, rlen, slot0, v0)

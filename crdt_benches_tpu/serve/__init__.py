@@ -11,11 +11,14 @@ arxiv 2310.18220):
 - :mod:`.pool`       — ``DocPool``: documents bucketed by capacity class,
   one ``PackedState`` stack per class (rows = docs, not replicas), with
   admit/evict that round-trips cold docs through ``utils/checkpoint.py``
-  and a vmapped per-row resolve+apply step;
-- :mod:`.scheduler`  — ``FleetScheduler``: admission + batching; drains
-  per-doc op queues into fixed-shape device batches (idle lanes padded
-  with no-ops), promotes docs between buckets as they outgrow capacity,
-  reports queue depth / occupancy;
+  and a device-resident MACRO step: K staged rounds of per-row range ops
+  consumed by one jitted ``lax.scan`` over a compacted row tier;
+- :mod:`.scheduler`  — ``FleetScheduler``: macro-round admission +
+  batching; drains per-doc RLE-coalesced range-op queues into
+  ``(K, Rt, B)`` staged tensors (idle lanes padded with no-ops, staging
+  overlapped with device execution), promotes docs between buckets as
+  they outgrow capacity, reports queue depth / occupancy /
+  pad_fraction / coalesce_ratio;
 - :mod:`.workload`   — multi-tenant generator interleaving the four real
   traces (as prefixes) plus ``traces/synth.py`` streams across N
   simulated sessions with a configurable arrival mix;

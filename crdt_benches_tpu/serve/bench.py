@@ -6,10 +6,16 @@ of ``bench/harness.py BenchResult``).  Reported numbers:
 - **fleet throughput**: trace patches applied across the whole fleet per
   second of drain wall time (the ``Throughput::Elements`` analog, with
   element = one patch, summed over every tenant document);
-- **per-batch latency**: p50/p95/p99 over per-round wall times (one
-  round = one fixed-shape device batch per active capacity class,
-  including scheduling, admissions/evictions, H2D and the blocking
-  fence — honest serving latency, not just kernel time).
+- **per-macro-round latency**: p50/p95/p99 over per-macro-round wall
+  times (one macro-round = planning + staging + boundary row moves + one
+  async K-slice dispatch per active capacity class; the final fence is
+  folded into the last round).  Rounds that triggered an XLA compile
+  (first use of a (class, K, Rt, B) shape) are EXCLUDED from the
+  quantiles and reported separately as ``compile_time`` — compile skew
+  is a cold-start cost, not serving jitter;
+- **occupancy waste**: ``pad_fraction`` (PAD share of staged op slots
+  after row-tier compaction) and ``coalesce_ratio`` (unit ops carried
+  per staged RLE range op) are tracked per run.
 
 Correctness gate (in-run, not optional): a sample of docs spanning every
 capacity class that hosted documents is decoded and byte-compared
@@ -24,9 +30,8 @@ import sys
 
 import numpy as np
 
-from ..bench.harness import BenchResult, quantiles, save_results
+from ..bench.harness import BenchResult, save_results, steady_quantiles
 from ..oracle.text_oracle import replay_trace
-from ..traces.tensorize import PAD
 from .pool import DocPool
 from .scheduler import FleetScheduler, prepare_streams
 from .workload import build_fleet
@@ -87,6 +92,8 @@ def run_serve_bench(
     mesh_devices: int = 0,
     verify_sample: int = 8,
     bands: dict | None = None,
+    macro_k: int = 8,
+    batch_chars: int = 256,
     spool_dir: str | None = None,
     results_dir: str | None = None,
     save_name: str | None = None,
@@ -94,7 +101,11 @@ def run_serve_bench(
 ) -> tuple[BenchResult, dict]:
     """Build the fleet, drain it once, verify a per-class doc sample
     against the oracle, and persist the artifact.  Returns
-    (BenchResult, info) with ``info["verify_ok"]``."""
+    (BenchResult, info) with ``info["verify_ok"]``.
+
+    ``macro_k`` staged rounds ride each device dispatch (1 = the legacy
+    round loop through the same machinery); ``batch`` range ops and
+    ``batch_chars`` inserted chars bound one doc's slice."""
     classes = _parse_int_tuple(classes)
     slots = _parse_int_tuple(slots)
     mix_name = mix if isinstance(mix, str) else "custom"
@@ -111,35 +122,39 @@ def run_serve_bench(
     )
     pool = DocPool(classes=classes, slots=slots, mesh=mesh,
                    spool_dir=spool_dir)
-    streams = prepare_streams(sessions, pool, batch=batch)
+    streams = prepare_streams(
+        sessions, pool, batch=batch, batch_chars=batch_chars
+    )
     total_ops = sum(s.remaining for s in streams.values())
+    total_units = sum(
+        int(s.unit_cum[-1]) for s in streams.values() if len(s.kind)
+    )
     log(
-        f"serve: {len(sessions)} docs, {total_ops} unit ops, "
-        f"classes={classes} slots={slots} batch={batch} "
+        f"serve: {len(sessions)} docs, {total_ops} range ops "
+        f"({total_units} unit ops), classes={classes} slots={slots} "
+        f"batch={batch} chars={batch_chars} K={macro_k} "
         f"mesh={mesh_devices if mesh else 'off'}"
     )
 
-    # Warm every bucket's compiled step with an all-PAD batch so the
-    # first serving round doesn't absorb XLA compile time (criterion's
-    # warmup; latency quantiles then reflect steady-state serving).
-    for cls in classes:
-        b = pool.buckets[cls]
-        pool.step(cls, np.full((b.R, batch), PAD, np.int32),
-                  np.zeros((b.R, batch), np.int32),
-                  np.full((b.R, batch), -1, np.int32))
-        b.steps = 0
-    pool.block()
-
-    sched = FleetScheduler(pool, streams, batch=batch)
+    sched = FleetScheduler(
+        pool, streams, batch=batch, macro_k=macro_k,
+        batch_chars=batch_chars,
+    )
     stats = sched.run()
     assert sched.done, "scheduler stopped with pending work"
-    lat = quantiles(stats.round_latencies)
+    lat, compile_time, compile_rounds = steady_quantiles(
+        stats.round_latencies, stats.compile_flags
+    )
     throughput = stats.patches / stats.wall_time
     log(
         f"serve: drained in {stats.wall_time:.2f}s over {stats.rounds} "
-        f"rounds -> {throughput:,.0f} patches/s; batch latency "
+        f"macro-rounds ({stats.slices} device rounds) -> "
+        f"{throughput:,.0f} patches/s; steady batch latency "
         f"p50 {lat['p50'] * 1e3:.1f}ms p95 {lat['p95'] * 1e3:.1f}ms "
-        f"p99 {lat['p99'] * 1e3:.1f}ms; evictions {stats.evictions} "
+        f"p99 {lat['p99'] * 1e3:.1f}ms; compile {compile_time:.2f}s "
+        f"over {compile_rounds} rounds; "
+        f"coalesce x{stats.coalesce_ratio:.2f} "
+        f"pad {stats.pad_fraction:.3f}; evictions {stats.evictions} "
         f"restores {stats.restores} promotions {stats.promotions}"
     )
 
@@ -184,13 +199,21 @@ def run_serve_bench(
             "family": "serve",
             "fleet_docs": n_docs,
             "batch": batch,
+            "batch_chars": batch_chars,
+            "macro_k": macro_k,
             "classes": list(classes),
             "slots": list(slots),
             "mesh_devices": mesh_devices if mesh else 0,
             "rounds": stats.rounds,
-            "unit_ops": stats.ops,
+            "device_rounds": stats.slices,
+            "range_ops": stats.ops,
+            "unit_ops": stats.unit_ops,
+            "coalesce_ratio": stats.coalesce_ratio,
+            "pad_fraction": stats.pad_fraction,
             "patches_per_sec": throughput,
             "batch_latency": lat,
+            "compile_time": compile_time,
+            "compile_rounds": compile_rounds,
             "occupancy_mean": occ,
             "queue_depth_mean": float(np.mean(qd)),
             "queue_depth_max": int(np.max(qd)),

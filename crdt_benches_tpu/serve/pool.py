@@ -5,13 +5,11 @@ of the same document consuming the same op stream.  The pool re-purposes
 that axis as a **document** axis: each row of a ``PackedState`` stack is a
 different document with its own ``length``/``nvis`` lane, its own slot-id
 space, and its own op stream.  Per-row independence is exactly what the
-unit-op machinery already provides:
+range-op machinery already provides:
 
-- ``ops/resolve.py resolve_batch`` is written per-document and jit/vmap
-  compatible, so ``vmap(resolve_batch)`` over (kind[R, B], pos[R, B],
-  nvis[R]) resolves a *different* op batch per row;
-- ``ops/apply2.py apply_batch3`` (the packed v3 apply) already consumes
-  per-row resolved batches — it only needed per-row ``slots`` support.
+- ``ops/resolve_range_scan.py`` resolves a *different* range batch per
+  row under vmap (the Pallas kernel shares one stream across rows);
+- ``ops/apply_range.py apply_range_batch`` is row-local throughout.
 
 Documents are bucketed by **capacity class** (e.g. 256 / 1024 / 4096
 slots): a small doc must not pay a 4096-wide apply pass, so each class is
@@ -22,17 +20,29 @@ count, so promotion never requires a device sync), and **evicted** to a
 checkpoint spool (``utils/checkpoint.py`` .npz round-trip) when their
 bucket is full — cold docs rehydrate into *any* free row later.
 
+The serving hot path is the **macro step**: K rounds of per-class
+``(R, B)`` range-op tensors staged into one device buffer and consumed by
+a single jitted ``lax.scan`` — the device, not the Python round loop,
+owns the steady state (one dispatch instead of K, donated state keeps the
+scan allocation-free).  Because mean lane occupancy in a serving fleet is
+low, the step can run on a **row-tier slice** of the stack: the scheduler
+compacts the macro-round's active documents into the first ``Rt`` rows
+(per shard, under a mesh) and the jitted step slices/writes back inside
+the same dispatch, so idle rows cost nothing.
+
 The optional ``mesh`` shards every bucket's row (document) axis over the
 ``parallel/mesh.py`` replica mesh axis — the docs-over-mesh layout.  All
 per-row work in resolve/apply is row-local, so the step partitions with
-zero collectives.
+zero collectives; row allocation is shard-aware so tier slices stay
+balanced across devices.
 """
 
 from __future__ import annotations
 
+import heapq
 import os
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -40,14 +50,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.apply2 import LANE, PackedState, apply_batch3
+from ..ops.apply_range import apply_range_batch
 from ..ops.resolve import resolve_batch
-from ..traces.tensorize import PAD
+from ..ops.resolve_range_scan import resolve_ranges_rows
 from ..utils.checkpoint import load_state, save_state
 
 
 @partial(jax.jit, donate_argnums=(0,))
 def fleet_step(state: PackedState, kind, pos, slot) -> PackedState:
-    """One op batch per resident doc: per-row resolve, one batched apply.
+    """One UNIT-op batch per resident doc (the pre-macro hot path, kept
+    for API compatibility and as the minimal single-round reference).
 
     ``kind``/``pos``/``slot``: int32[R, B], row r = the next B ops of the
     doc in row r (``kind == PAD`` everywhere for idle rows — a no-op end
@@ -99,7 +111,7 @@ class DocRecord:
 
     doc_id: int
     n_init: int
-    capacity_need: int  # n_init + total inserts of the full stream
+    capacity_need: int  # n_init + total inserted chars of the full stream
     chars: np.ndarray  # int32[capacity_need] slot -> codepoint
     length: int = 0  # host mirror of device length (slots used)
     cls: int | None = None  # resident capacity class (None = cold)
@@ -109,11 +121,21 @@ class DocRecord:
 
 
 class Bucket:
-    """One capacity class: a PackedState stack whose rows are docs."""
+    """One capacity class: a PackedState stack whose rows are docs.
 
-    def __init__(self, C: int, R: int, sharding=None):
+    Row allocation is **shard-aware**: row ``r`` lives on mesh shard
+    ``r // (R / n_sh)``.  Free rows are per-shard min-heaps (with a lazy
+    invalidation set so the scheduler can claim *specific* rows for
+    compaction), and fresh allocations balance shards while preferring
+    the lowest local index — keeping the occupied set packed toward the
+    front of every shard, which is what makes tier slicing effective.
+    """
+
+    def __init__(self, C: int, R: int, n_sh: int = 1, sharding=None):
         self.C = C
         self.R = R
+        self.n_sh = n_sh
+        self.Rg = R // n_sh  # rows per shard
         state = PackedState(
             doc=jnp.full((R, C), 2, jnp.int32),
             length=jnp.zeros(R, jnp.int32),
@@ -123,12 +145,60 @@ class Bucket:
             state = jax.tree.map(lambda x: jax.device_put(x, sharding), state)
         self.state = state
         self.rows: list[int | None] = [None] * R  # row -> doc_id
-        self.free: list[int] = list(range(R - 1, -1, -1))
+        self._heaps: list[list[int]] = [
+            list(range(self.Rg)) for _ in range(n_sh)
+        ]
+        self._free: list[set[int]] = [
+            set(range(self.Rg)) for _ in range(n_sh)
+        ]
         self.steps = 0
+
+    # ---- row allocation ----
+
+    @property
+    def free(self) -> list[int]:
+        """Free GLOBAL row ids (read-only view; kept for compatibility
+        with callers that test emptiness / count)."""
+        return [
+            s * self.Rg + l for s in range(self.n_sh) for l in self._free[s]
+        ]
+
+    @property
+    def n_free(self) -> int:
+        return sum(len(f) for f in self._free)
+
+    def free_locals(self, shard: int) -> set[int]:
+        return self._free[shard]
+
+    def alloc_row(self) -> int:
+        """Lowest local index on the emptiest shard (ties -> lowest
+        shard) — balances the mesh while packing rows toward the front."""
+        s = max(range(self.n_sh), key=lambda i: (len(self._free[i]), -i))
+        if not self._free[s]:
+            raise RuntimeError(f"bucket c{self.C}: no free row")
+        h = self._heaps[s]
+        while h:
+            l = heapq.heappop(h)
+            if l in self._free[s]:
+                self._free[s].discard(l)
+                return s * self.Rg + l
+        raise RuntimeError(f"bucket c{self.C}: free-heap drift")
+
+    def take_row(self, row: int) -> None:
+        """Claim a SPECIFIC free row (compaction relocations)."""
+        s, l = divmod(row, self.Rg)
+        if l not in self._free[s]:
+            raise RuntimeError(f"bucket c{self.C}: row {row} not free")
+        self._free[s].discard(l)  # heap entry invalidated lazily
+
+    def release_row(self, row: int) -> None:
+        s, l = divmod(row, self.Rg)
+        self._free[s].add(l)
+        heapq.heappush(self._heaps[s], l)
 
 
 class DocPool:
-    """The document fleet: buckets + admit/evict/promote + vmapped step.
+    """The document fleet: buckets + admit/evict/promote + macro step.
 
     ``classes``: ascending capacity classes, each a multiple of 128 (the
     packed kernels tile by LANE).  ``slots``: resident rows per class.
@@ -152,8 +222,12 @@ class DocPool:
             if c % LANE:
                 raise ValueError(f"capacity class {c} not a multiple of {LANE}")
         self._sharding = None
+        self._op_sharding = None
+        self.n_sh = 1
         if mesh is not None:
-            from ..parallel.mesh import fleet_sharding
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import AXIS, fleet_sharding
 
             n_dev = mesh.devices.size
             for r in slots:
@@ -162,14 +236,19 @@ class DocPool:
                         f"bucket slots {r} not divisible by mesh size {n_dev}"
                     )
             self._sharding = fleet_sharding(mesh)
+            # staged macro tensors (K, R, B): shard the row axis
+            self._op_sharding = NamedSharding(mesh, P(None, AXIS, None))
+            self.n_sh = n_dev
         self.classes = tuple(classes)
         self.buckets = {
-            c: Bucket(c, r, self._sharding) for c, r in zip(classes, slots)
+            c: Bucket(c, r, self.n_sh, self._sharding)
+            for c, r in zip(classes, slots)
         }
         self.docs: dict[int, DocRecord] = {}
         self._owns_spool = spool_dir is None
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="crdt_serve_")
         os.makedirs(self.spool_dir, exist_ok=True)
+        self._macro_fns: dict[tuple, object] = {}
         # counters (reported by the scheduler / bench)
         self.evictions = 0
         self.restores = 0
@@ -203,7 +282,21 @@ class DocPool:
         b = self.buckets[cls]
         return [(d, r) for r, d in enumerate(b.rows) if d is not None]
 
-    # ---- row movement (all host round-trips: off the vmapped hot path) ----
+    def tiers(self, cls: int) -> list[int]:
+        """Row-count tiers the macro step compiles for, ascending.
+        Factor-4 steps bound the compile count while capping tier waste
+        at 4x; the smallest tier keeps >= 4 local rows per shard (when
+        the bucket has that many) so tiny slices don't starve the mesh."""
+        b = self.buckets[cls]
+        out, rt = [], b.Rg
+        while True:
+            out.append(rt * b.n_sh)
+            if rt <= 4:
+                break
+            rt = max(rt // 4, 4)
+        return sorted(out)
+
+    # ---- row movement (host round-trips: off the macro hot path) ----
 
     def _pull_row(self, rec: DocRecord) -> PackedState:
         b = self.buckets[rec.cls]
@@ -217,18 +310,16 @@ class DocPool:
     def _free_row(self, rec: DocRecord) -> None:
         b = self.buckets[rec.cls]
         b.rows[rec.row] = None
-        b.free.append(rec.row)
+        b.release_row(rec.row)
         rec.cls = rec.row = None
 
     def _install(self, rec: DocRecord, cls: int, doc_row: np.ndarray,
-                 length: int, nvis: int) -> tuple[int, int]:
+                 length: int, nvis: int, row: int | None = None
+                 ) -> tuple[int, int]:
         b = self.buckets[cls]
-        if not b.free:
-            raise RuntimeError(
-                f"bucket c{cls} full — scheduler must evict before admit"
-            )
-        row = b.free.pop()
-        if len(doc_row) < b.C:  # promotion / spooled-at-smaller-class pad
+        if row is None:
+            row = b.alloc_row()
+        if len(doc_row) < b.C:  # promotion / trimmed-spool pad
             doc_row = np.concatenate(
                 [doc_row, np.full(b.C - len(doc_row), 2, np.int32)]
             )
@@ -240,6 +331,28 @@ class DocPool:
         rec.cls, rec.row = cls, row
         return cls, row
 
+    def _spool_path(self, doc_id: int) -> str:
+        return os.path.join(self.spool_dir, f"doc{doc_id}.npz")
+
+    def spool_save(self, doc_id: int, doc_row: np.ndarray, length: int,
+                   nvis: int) -> str:
+        """Write one doc's checkpoint to the spool.  Only the used
+        ``length`` prefix is stored (the tail is the constant
+        beyond-length coding ``2`` that ``_install`` re-pads), and the
+        .npz is uncompressed — zlib on the eviction path was the single
+        largest host cost of the round-loop engine."""
+        path = self._spool_path(doc_id)
+        save_state(
+            path,
+            PackedState(
+                doc=np.ascontiguousarray(doc_row[None, :length]),
+                length=np.asarray([length], np.int32),
+                nvis=np.asarray([nvis], np.int32),
+            ),
+            compress=False,
+        )
+        return path
+
     def evict(self, doc_id: int) -> str:
         """Round-trip a resident doc out to the checkpoint spool
         (``utils/checkpoint.py`` .npz) and free its row."""
@@ -247,12 +360,13 @@ class DocPool:
         if rec.cls is None:
             raise ValueError(f"doc {doc_id} is not resident")
         st = self._pull_row(rec)
-        path = os.path.join(self.spool_dir, f"doc{doc_id}.npz")
-        save_state(path, st)
-        rec.spool = path
+        rec.spool = self.spool_save(
+            doc_id, np.asarray(st.doc[0]), int(st.length[0]),
+            int(st.nvis[0]),
+        )
         self._free_row(rec)
         self.evictions += 1
-        return path
+        return rec.spool
 
     def admit(self, doc_id: int, need: int) -> tuple[int, int]:
         """Make ``doc_id`` resident in the class covering ``need`` slots
@@ -286,12 +400,40 @@ class DocPool:
             rec, cls, _fresh_row_np(cls, rec.n_init), rec.n_init, rec.n_init
         )
 
-    # ---- the hot path ----
+    # ---- boundary bulk movement (one sync, one upload per class) ----
+
+    def pull_bucket(self, cls: int):
+        """Host snapshot of a whole bucket (doc, length, nvis as numpy).
+        SYNCS with any in-flight macro step — this is the forced
+        boundary the scheduler pays only when rows actually move."""
+        b = self.buckets[cls]
+        return (
+            np.asarray(b.state.doc),
+            np.asarray(b.state.length),
+            np.asarray(b.state.nvis),
+        )
+
+    def upload_bucket(self, cls: int, doc: np.ndarray, length: np.ndarray,
+                      nvis: np.ndarray) -> None:
+        """Replace a bucket's device state from host arrays (the write
+        half of a boundary compose; re-applies the mesh sharding)."""
+        b = self.buckets[cls]
+        state = PackedState(
+            doc=jnp.asarray(doc), length=jnp.asarray(length),
+            nvis=jnp.asarray(nvis),
+        )
+        if self._sharding is not None:
+            state = jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding), state
+            )
+        b.state = state
+
+    # ---- the hot paths ----
 
     def step(self, cls: int, kind: np.ndarray, pos: np.ndarray,
              slot: np.ndarray) -> None:
-        """Apply one (R, B) op batch to class ``cls`` (row r = ops for the
-        doc resident in row r; PAD rows are no-ops)."""
+        """Apply one (R, B) UNIT-op batch to class ``cls`` (row r = ops
+        for the doc resident in row r; PAD rows are no-ops)."""
         b = self.buckets[cls]
         args = [jnp.asarray(a) for a in (kind, pos, slot)]
         if self._sharding is not None:
@@ -299,8 +441,87 @@ class DocPool:
         b.state = fleet_step(b.state, *args)
         b.steps += 1
 
+    def _build_macro_fn(self, cls: int, Rt: int, nbits: int):
+        b = self.buckets[cls]
+        R, n_sh = b.R, b.n_sh
+        shard = self._sharding
+        full = Rt == R
+
+        def body(st, sl):
+            k, p, ln, s0 = sl
+            tokens, dints, _ = resolve_ranges_rows(k, p, ln, s0, st.nvis)
+            return apply_range_batch(st, tokens, dints, nbits=nbits), None
+
+        def fn(state, kind, pos, rlen, slot0):
+            if full:
+                out, _ = jax.lax.scan(
+                    body, state, (kind, pos, rlen, slot0)
+                )
+                return out
+            Rg, rt = R // n_sh, Rt // n_sh
+
+            def take(x):
+                y = x.reshape((n_sh, Rg) + x.shape[1:])[:, :rt]
+                return y.reshape((Rt,) + x.shape[1:])
+
+            sub = PackedState(
+                doc=take(state.doc), length=take(state.length),
+                nvis=take(state.nvis),
+            )
+            if shard is not None:
+                sub = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, shard),
+                    sub,
+                )
+            sub, _ = jax.lax.scan(body, sub, (kind, pos, rlen, slot0))
+
+            def put(x, s):
+                y = x.reshape((n_sh, Rg) + x.shape[1:])
+                z = y.at[:, :rt].set(
+                    s.reshape((n_sh, rt) + s.shape[1:])
+                )
+                return z.reshape(x.shape)
+
+            out = PackedState(
+                doc=put(state.doc, sub.doc),
+                length=put(state.length, sub.length),
+                nvis=put(state.nvis, sub.nvis),
+            )
+            if shard is not None:
+                out = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, shard),
+                    out,
+                )
+            return out
+
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def macro_step(self, cls: int, kind: np.ndarray, pos: np.ndarray,
+                   rlen: np.ndarray, slot0: np.ndarray, nbits: int) -> bool:
+        """ONE async dispatch applying K staged rounds to class ``cls``:
+        op tensors int32[K, Rt, B] (Rt a row tier from :meth:`tiers`,
+        row r covering local rows ``0..Rt/n_sh`` of every shard), scanned
+        on device with donated state.  No host sync — callers fence via
+        :meth:`block` or a boundary pull.  Returns True when this
+        (shape, nbits) compiled for the first time (the scheduler tags
+        the round as compile-skewed)."""
+        b = self.buckets[cls]
+        K, Rt, B = kind.shape
+        if Rt % b.n_sh or not b.n_sh <= Rt <= b.R:
+            raise ValueError(f"tier {Rt} incompatible with bucket {b.R}")
+        key = (cls, K, Rt, B, nbits)
+        fresh = key not in self._macro_fns
+        if fresh:
+            self._macro_fns[key] = self._build_macro_fn(cls, Rt, nbits)
+        args = [jnp.asarray(a) for a in (kind, pos, rlen, slot0)]
+        if self._op_sharding is not None:
+            args = [jax.device_put(a, self._op_sharding) for a in args]
+        b.state = self._macro_fns[key](b.state, *args)
+        b.steps += K
+        return fresh
+
     def block(self) -> None:
-        """Fence all outstanding bucket steps (honest per-round timing)."""
+        """Fence all outstanding bucket steps (honest drain timing)."""
         for b in self.buckets.values():
             b.state.doc.block_until_ready()
 
@@ -322,7 +543,7 @@ class DocPool:
 
     def occupancy(self) -> dict[int, float]:
         return {
-            c: 1.0 - len(b.free) / b.R for c, b in self.buckets.items()
+            c: 1.0 - b.n_free / b.R for c, b in self.buckets.items()
         }
 
     def close(self) -> None:

@@ -1,21 +1,40 @@
-"""Admission + batching scheduler for the document fleet.
+"""Admission + batching scheduler for the document fleet: macro-rounds.
 
-Drains per-doc op queues into fixed-shape device batches: every round,
-each capacity class gets one (R, B) unit-op batch — row r carries the
-next ≤B ops of the doc resident in row r, idle rows are padded with
-``kind == PAD`` no-ops — and the pool applies it in one vmapped step.
+Drains per-doc op queues into fixed-shape device batches.  Every
+**macro-round**, each active capacity class gets one ``(K_eff, Rt, B)``
+RANGE-op tensor — K_eff staged rounds of B ops for the doc in each of the
+first Rt rows, idle lanes padded with ``kind == PAD`` no-ops — and the
+pool applies it in ONE jitted ``lax.scan`` dispatch
+(``pool.macro_step``).  Three coordinated mechanisms:
+
+- **macro-rounds**: residency decisions are made once per K rounds, so a
+  doc admitted for a macro-round receives up to ``K * B`` ops before the
+  next placement decision — cutting the eviction/restore churn of the
+  round-loop engine by ~K and replacing K dispatch+fence round-trips
+  with one async dispatch;
+- **async staged dispatch**: while macro-round ``m`` executes on device,
+  the host plans and tensorizes macro-round ``m+1`` (selection,
+  placement, and capacity arithmetic are host-only).  The only device
+  syncs are the boundary **bucket pulls** when rows actually move
+  (evict / promote / relocate) and the final drain fence;
+- **RLE op coalescing + row compaction**: streams are run-length-coded
+  range ops (``tensorize_ranges(coalesce=True)``) so one op slot carries
+  a whole typing run or delete range (the semidirect-product composition
+  of adjacent ops, PAPERS.md arXiv 2004.04303), and each macro-round the
+  scheduled docs are compacted into the lowest row tier ``Rt`` (per mesh
+  shard) so the device scan never streams idle rows.
 
 Policy (deterministic, host-only — no device syncs on the decision path):
 
 - **round-robin fairness**: active docs are served in FIFO order and
   rotate to the back after being scheduled, so a huge doc cannot starve
   the fleet;
-- **class selection per chunk**: a doc's capacity need after its next
-  chunk is host-known (n_init + cumulative inserts), so promotion to a
-  larger class happens *before* the chunk that would overflow — the
-  device never sees an over-capacity insert;
+- **class selection per macro-round**: a doc's capacity need after its
+  next K slices is host-known (n_init + cumulative inserted chars), so
+  promotion to a larger class happens *before* the macro-round that
+  would overflow — the device never sees an over-capacity insert;
 - **eviction**: when a selected doc's target bucket has no free row, the
-  scheduler evicts a resident that is not scheduled this round —
+  scheduler evicts a resident that is not scheduled this macro-round —
   finished docs first, then least-recently-scheduled — through the
   pool's checkpoint spool.  A selected set never exceeds the bucket's
   row count, so a victim always exists.
@@ -26,25 +45,40 @@ Policy (deterministic, host-only — no device syncs on the decision path):
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..traces.tensorize import INSERT, PAD, tensorize
-from .pool import DocPool
+from ..traces.tensorize import (
+    INSERT,
+    PAD,
+    split_insert_runs,
+    tensorize_ranges,
+)
+from .pool import DocPool, _fresh_row_np
+from ..utils.checkpoint import load_state
 
 
 @dataclass
 class DocStream:
-    """One doc's pending op queue (host-side, read-only arrays + cursor)."""
+    """One doc's pending op queue (host-side, read-only arrays + cursor).
+
+    Ops are COALESCED RANGE ops: consecutive-position insert runs and
+    same/backspace delete runs merged at stream build
+    (``coalesce_patches``), then insert runs re-split to at most
+    ``batch_chars`` chars (``split_insert_runs``) so any single op fits a
+    slice's insert budget."""
 
     doc_id: int
-    kind: np.ndarray  # int32[N] unit ops (unpadded)
+    kind: np.ndarray  # int32[N] range ops (unpadded)
     pos: np.ndarray
-    slot: np.ndarray
-    ins_cum: np.ndarray  # int32[N] inclusive cumulative INSERT count
+    rlen: np.ndarray
+    slot0: np.ndarray
+    ins_cum: np.ndarray  # int32[N] inclusive cumulative INSERT chars
+    unit_cum: np.ndarray  # int32[N] inclusive cumulative unit-op count
     n_patches: int
     arrival: int = 0
     cursor: int = 0
@@ -53,40 +87,47 @@ class DocStream:
     def remaining(self) -> int:
         return len(self.kind) - self.cursor
 
-    def need_after(self, n_init: int, take: int) -> int:
-        """Slot capacity needed once the next ``take`` ops are applied."""
-        end = self.cursor + take
-        return n_init + (int(self.ins_cum[end - 1]) if end else 0)
+    def ins_before(self, i: int) -> int:
+        """Inserted chars in ops [0, i)."""
+        return int(self.ins_cum[i - 1]) if i > 0 else 0
+
+    def units_before(self, i: int) -> int:
+        return int(self.unit_cum[i - 1]) if i > 0 else 0
 
 
-def prepare_streams(sessions, pool: DocPool, batch: int = 64
-                    ) -> dict[int, DocStream]:
-    """Tensorize every session's trace, register the docs with the pool,
-    and return the per-doc op queues.  Sessions sharing an identical
-    trace object (the workload caches trace prefixes) share the
-    tensorized arrays — the queues only differ in cursor state."""
+def prepare_streams(sessions, pool: DocPool, batch: int = 64,
+                    batch_chars: int = 256) -> dict[int, DocStream]:
+    """Tensorize every session's trace as coalesced range ops, register
+    the docs with the pool, and return the per-doc op queues.  Sessions
+    sharing an identical trace object (the workload caches trace
+    prefixes) share the tensorized arrays — the queues only differ in
+    cursor state."""
     streams: dict[int, DocStream] = {}
-    cache: dict[int, tuple] = {}  # id(trace) -> (tt, chars)
+    cache: dict[int, tuple] = {}  # id(trace) -> (arrays, rt)
     for s in sessions:
         hit = cache.get(id(s.trace))
         if hit is None:
-            tt = tensorize(s.trace, batch=1)
-            chars = np.zeros(tt.capacity, np.int32)
-            chars[: len(tt.init_chars)] = tt.init_chars
-            ins = tt.kind == INSERT
-            chars[tt.slot[ins]] = tt.ch[ins]
-            hit = cache[id(s.trace)] = (tt, chars)
-        tt, chars = hit
-        n = tt.n_ops
+            rt = tensorize_ranges(s.trace, batch=1, coalesce=True)
+            n = rt.n_ops
+            arrays = split_insert_runs(
+                rt.kind[:n], rt.pos[:n], rt.rlen[:n], rt.slot0[:n],
+                batch_chars,
+            )
+            ins_cum = np.cumsum(
+                np.where(arrays[0] == INSERT, arrays[2], 0)
+            ).astype(np.int32)
+            unit_cum = np.cumsum(arrays[2]).astype(np.int32)
+            hit = cache[id(s.trace)] = (arrays, ins_cum, unit_cum, rt)
+        (kind, pos, rlen, slot0), ins_cum, unit_cum, rt = hit
         pool.register(
-            s.doc_id, n_init=len(tt.init_chars),
-            capacity_need=tt.capacity, chars=chars,
+            s.doc_id, n_init=len(rt.init_chars),
+            capacity_need=rt.capacity, chars=rt.chars,
         )
         streams[s.doc_id] = DocStream(
             doc_id=s.doc_id,
-            kind=tt.kind[:n], pos=tt.pos[:n], slot=tt.slot[:n],
-            ins_cum=np.cumsum(tt.kind[:n] == INSERT).astype(np.int32),
-            n_patches=tt.n_patches,
+            kind=kind, pos=pos, rlen=rlen, slot0=slot0,
+            ins_cum=ins_cum, unit_cum=unit_cum,
+            n_patches=rt.n_patches,
             arrival=getattr(s, "arrival", 0),
         )
     return streams
@@ -97,10 +138,14 @@ class ServeStats:
     """One drain's telemetry (the serve family's report surface)."""
 
     round_latencies: list[float] = field(default_factory=list)
+    compile_flags: list[bool] = field(default_factory=list)  # per round
     occupancy: list[float] = field(default_factory=list)  # per round
     queue_depth: list[int] = field(default_factory=list)  # per round
-    rounds: int = 0
-    ops: int = 0
+    rounds: int = 0  # macro-rounds dispatched
+    slices: int = 0  # inner device rounds (sum of K_eff per class)
+    ops: int = 0  # coalesced range ops applied
+    unit_ops: int = 0  # unit-op equivalent (sum of run lengths)
+    staged_cells: int = 0  # op slots staged across all macro tensors
     patches: int = 0
     evictions: int = 0
     restores: int = 0
@@ -108,13 +153,62 @@ class ServeStats:
     admissions: int = 0
     wall_time: float = 0.0
 
+    @property
+    def coalesce_ratio(self) -> float:
+        """Unit ops represented per staged range op (>= 1; the RLE win)."""
+        return self.unit_ops / self.ops if self.ops else 1.0
+
+    @property
+    def pad_fraction(self) -> float:
+        """Fraction of staged op slots that were PAD — occupancy waste
+        after row compaction (1 - real ops / staged cells)."""
+        if not self.staged_cells:
+            return 0.0
+        return 1.0 - self.ops / self.staged_cells
+
+    # NOTE: compile-time / steady-latency derivation lives in ONE place,
+    # bench/harness.py steady_quantiles (compile_flags feed it).
+
+
+@dataclass
+class _Lane:
+    stream: DocStream
+    takes: list[int]  # range ops consumed per slice (len <= K)
+    end: int  # cursor after the macro-round
+    row: int = -1
+
+
+@dataclass
+class _Plan:
+    base_round: int
+    lanes: dict[int, list[_Lane]] = field(default_factory=dict)
+    k_eff: dict[int, int] = field(default_factory=dict)
+    rt: dict[int, int] = field(default_factory=dict)
+    # data movement (executed at the sync boundary, planned host-side):
+    pull_classes: set[int] = field(default_factory=set)
+    evictions: list[tuple[int, int, int]] = field(default_factory=list)
+    # target class -> [(doc_id, row, source)]; source is ('fresh',),
+    # ('spool', path), or ('pull', src_cls, src_row)
+    installs: dict[int, list[tuple[int, int, tuple]]] = field(
+        default_factory=dict
+    )
+    waiting: int = 0
+
+
+def _pow2ceil(x: int) -> int:
+    return 1 << max(0, int(x - 1).bit_length())
+
 
 class FleetScheduler:
     def __init__(self, pool: DocPool, streams: dict[int, DocStream],
-                 batch: int = 64):
+                 batch: int = 64, macro_k: int = 1,
+                 batch_chars: int = 256):
         self.pool = pool
         self.streams = streams
         self.batch = batch
+        self.macro_k = max(1, macro_k)
+        self.batch_chars = batch_chars
+        self.nbits = max(1, int(batch_chars).bit_length())
         self.round = 0
         # FIFO of doc ids not yet arrived or with pending ops, in
         # arrival order (stable for determinism).
@@ -125,14 +219,32 @@ class FleetScheduler:
             patches=sum(s.n_patches for s in streams.values())
         )
 
-    # ---- one round ----
+    # ---- planning (host-only; no device syncs) ----
 
-    def _select(self) -> tuple[dict[int, list], int]:
-        """Pick this round's lanes: {class: [(stream, take)]}, bounded by
-        each bucket's row count, in round-robin order.  Returns the plan
-        and the number of active docs left waiting (queue depth)."""
-        plan: dict[int, list] = {c: [] for c in self.pool.classes}
-        waiting = 0
+    def _sim_takes(self, st: DocStream) -> tuple[list[int], int]:
+        """Per-slice op counts for one doc's next macro-round: each slice
+        takes up to ``batch`` range ops bounded by ``batch_chars``
+        inserted chars (ops are pre-split, so at least one op always
+        fits).  Returns (takes, end_cursor)."""
+        takes: list[int] = []
+        c = st.cursor
+        N = len(st.kind)
+        for _ in range(self.macro_k):
+            if c >= N:
+                break
+            hi = min(c + self.batch, N)
+            cap = st.ins_before(c) + self.batch_chars
+            e = c + int(
+                np.searchsorted(st.ins_cum[c:hi], cap, side="right")
+            )
+            e = max(e, c + 1)
+            takes.append(e - c)
+            c = e
+        return takes, c
+
+    def _select(self, plan: _Plan) -> None:
+        """Pick this macro-round's lanes: {class: [_Lane]}, bounded by
+        each bucket's row count, in round-robin order."""
         scheduled: list[int] = []
         deferred: list[int] = []
         while self._rr:
@@ -143,37 +255,20 @@ class FleetScheduler:
             if st.arrival > self.round:
                 deferred.append(doc_id)
                 continue
-            take = min(self.batch, st.remaining)
+            takes, end = self._sim_takes(st)
             rec = self.pool.docs[doc_id]
-            cls = self.pool.class_for(
-                max(st.need_after(rec.n_init, take), rec.length, 1)
-            )
-            b = self.pool.buckets[cls]
-            if len(plan[cls]) >= b.R:
-                waiting += 1
+            need = rec.n_init + st.ins_before(end)
+            cls = self.pool.class_for(max(need, rec.length, 1))
+            lanes = plan.lanes.setdefault(cls, [])
+            if len(lanes) >= self.pool.buckets[cls].R:
+                plan.waiting += 1
                 deferred.append(doc_id)
                 continue
-            plan[cls].append((st, take))
+            lanes.append(_Lane(stream=st, takes=takes, end=end))
             scheduled.append(doc_id)
         # rotation: scheduled docs go to the back; deferred keep order.
         self._rr.extend(deferred)
         self._rr.extend(scheduled)
-        return plan, waiting
-
-    def _place(self, cls: int, lanes: list, selected_all: set[int]) -> None:
-        """Make every selected doc resident in ``cls``, evicting
-        not-selected residents when the bucket is full."""
-        selected = {st.doc_id for st, _ in lanes}
-        b = self.pool.buckets[cls]
-        for st, take in lanes:
-            rec = self.pool.docs[st.doc_id]
-            if rec.cls == cls:
-                continue
-            if not b.free:
-                victim = self._pick_victim(cls, selected, selected_all)
-                self.pool.evict(victim)
-            self.pool.admit(st.doc_id, st.need_after(rec.n_init, take))
-            self.stats.admissions += 1
 
     def _pick_victim(self, cls: int, selected: set[int],
                      selected_all: set[int]) -> int:
@@ -202,61 +297,292 @@ class FleetScheduler:
             ),
         )
 
-    def run_round(self) -> bool:
-        """One scheduling round.  Returns False when no work remains."""
-        plan, waiting = self._select()
-        lanes_used = sum(len(v) for v in plan.values())
-        if lanes_used == 0:
-            if any(
-                s.remaining and s.arrival > self.round
-                for s in self.streams.values()
-            ):
-                self.round += 1  # idle tick: waiting on arrivals
-                return True
-            return False
+    def _place(self, plan: _Plan) -> None:
+        """Residency bookkeeping for every selected lane (evictions,
+        promotions, spool restores, fresh admits) and per-class row
+        compaction.  Pure host state — the data moves happen later, at
+        the boundary (:meth:`_execute_moves`)."""
+        pool = self.pool
         selected_all = {
-            st.doc_id for lanes in plan.values() for st, _ in lanes
+            l.stream.doc_id for lanes in plan.lanes.values() for l in lanes
         }
-        t0 = time.perf_counter()
-        for cls, lanes in plan.items():
+        for cls in pool.classes:
+            lanes = plan.lanes.get(cls)
             if not lanes:
                 continue
-            self._place(cls, lanes, selected_all)
+            b = pool.buckets[cls]
+            selected = {l.stream.doc_id for l in lanes}
+            pending: list[tuple[int, tuple]] = []  # (lane idx, source)
+            for i, lane in enumerate(lanes):
+                rec = pool.docs[lane.stream.doc_id]
+                if rec.cls == cls:
+                    lane.row = rec.row
+                    continue
+                if rec.cls is not None:  # promotion out of a smaller class
+                    pending.append((i, ("pull", rec.cls, rec.row)))
+                    plan.pull_classes.add(rec.cls)
+                    b_old = pool.buckets[rec.cls]
+                    b_old.rows[rec.row] = None
+                    b_old.release_row(rec.row)
+                    rec.cls = rec.row = None
+                    pool.promotions += 1
+                elif rec.spool is not None:
+                    pending.append((i, ("spool", rec.spool)))
+                    rec.spool = None
+                    pool.restores += 1
+                else:
+                    pending.append((i, ("fresh",)))
+                    pool.fresh_admits += 1
+                self.stats.admissions += 1
+            # make room: one victim per missing free row
+            while b.n_free < len(pending):
+                victim = self._pick_victim(cls, selected, selected_all)
+                vrec = pool.docs[victim]
+                plan.evictions.append((victim, cls, vrec.row))
+                plan.pull_classes.add(cls)
+                vrec.spool = pool._spool_path(victim)
+                b.rows[vrec.row] = None
+                b.release_row(vrec.row)
+                vrec.cls = vrec.row = None
+                pool.evictions += 1
+            # ---- occupancy-aware compaction: choose the row tier ----
+            # pow2 K depths bound the compile-shape count; the macro_k
+            # clamp keeps a non-pow2 --serve-macro from dispatching
+            # guaranteed-all-PAD tail slices.
+            k_eff = min(
+                _pow2ceil(max(len(l.takes) for l in lanes)), self.macro_k
+            )
+            resident_locals = [
+                (lane, divmod(lane.row, b.Rg)) for lane in lanes
+                if lane.row >= 0
+            ]
+            n_installs = len(pending)
+            chosen_rt = b.R
+            relocs: list[tuple[_Lane, int]] = []
+            install_rows: list[int] = []
+            for rt_total in pool.tiers(cls):
+                rt = rt_total // b.n_sh
+                fb = [
+                    sorted(l for l in b.free_locals(s) if l < rt)
+                    for s in range(b.n_sh)
+                ]
+                high = [[] for _ in range(b.n_sh)]
+                for lane, (s, l) in resident_locals:
+                    if l >= rt:
+                        high[s].append(lane)
+                if any(len(high[s]) > len(fb[s]) for s in range(b.n_sh)):
+                    continue
+                spare = sum(len(fb[s]) - len(high[s]) for s in range(b.n_sh))
+                if spare < n_installs:
+                    continue
+                chosen_rt = rt_total
+                # relocations: high scheduled rows -> lowest free locals
+                # on the same shard; installs fill remaining low rows,
+                # balanced across shards.
+                remaining: list[list[int]] = []
+                for s in range(b.n_sh):
+                    take = fb[s][: len(high[s])]
+                    for lane, dst_l in zip(high[s], take):
+                        relocs.append((lane, s * b.Rg + dst_l))
+                    remaining.append(fb[s][len(high[s]):])
+                for _ in range(n_installs):
+                    s = max(
+                        range(b.n_sh),
+                        key=lambda i: (len(remaining[i]), -i),
+                    )
+                    install_rows.append(s * b.Rg + remaining[s].pop(0))
+                break
+            plan.k_eff[cls] = k_eff
+            plan.rt[cls] = chosen_rt
+            if chosen_rt == b.R:
+                install_rows = []  # no tier: plain lowest-row allocation
+            inst = plan.installs.setdefault(cls, [])
+            for j, (i, source) in enumerate(pending):
+                lane = lanes[i]
+                rec = pool.docs[lane.stream.doc_id]
+                if install_rows:
+                    row = install_rows[j]
+                    b.take_row(row)
+                else:
+                    row = b.alloc_row()
+                b.rows[row] = rec.doc_id
+                rec.cls, rec.row = cls, row
+                lane.row = row
+                inst.append((rec.doc_id, row, source))
+            for lane, dst in relocs:
+                rec = pool.docs[lane.stream.doc_id]
+                src = rec.row
+                plan.pull_classes.add(cls)
+                inst.append((rec.doc_id, dst, ("pull", cls, src)))
+                b.take_row(dst)
+                b.rows[dst] = rec.doc_id
+                b.rows[src] = None
+                b.release_row(src)
+                rec.row = dst
+                lane.row = dst
+
+    def _plan(self) -> _Plan | None:
+        """One macro-round's full host plan, or None when drained.
+        Advances the round clock over arrival-wait gaps."""
+        while True:
+            plan = _Plan(base_round=self.round)
+            self._select(plan)
+            if plan.lanes:
+                self._place(plan)
+                return plan
+            pending = [
+                s.arrival for s in self.streams.values()
+                if s.remaining and s.arrival > self.round
+            ]
+            if not pending:
+                return None
+            self.round = min(pending)  # idle: jump to the next arrival
+
+    # ---- staging (host tensorize; overlaps device execution) ----
+
+    def _stage(self, plan: _Plan) -> dict[int, tuple]:
+        tensors: dict[int, tuple] = {}
+        B = self.batch
+        for cls, lanes in plan.lanes.items():
+            K, Rt = plan.k_eff[cls], plan.rt[cls]
             b = self.pool.buckets[cls]
-            B = self.batch
-            kind = np.full((b.R, B), PAD, np.int32)
-            pos = np.zeros((b.R, B), np.int32)
-            slot = np.full((b.R, B), -1, np.int32)
-            for st, take in lanes:
+            rt = Rt // b.n_sh
+            kind = np.full((K, Rt, B), PAD, np.int32)
+            pos = np.zeros((K, Rt, B), np.int32)
+            rlen = np.zeros((K, Rt, B), np.int32)
+            slot0 = np.full((K, Rt, B), -1, np.int32)
+            for lane in lanes:
+                st = lane.stream
+                s, l = divmod(lane.row, b.Rg)
+                r = s * rt + l  # sliced row index
+                c = st.cursor
+                for k, take in enumerate(lane.takes):
+                    kind[k, r, :take] = st.kind[c:c + take]
+                    pos[k, r, :take] = st.pos[c:c + take]
+                    rlen[k, r, :take] = st.rlen[c:c + take]
+                    slot0[k, r, :take] = st.slot0[c:c + take]
+                    c += take
+            tensors[cls] = (kind, pos, rlen, slot0)
+        return tensors
+
+    # ---- boundary execution (the only device syncs) ----
+
+    def _execute_moves(self, plan: _Plan) -> None:
+        """Apply the plan's row movement: pull affected buckets once
+        (syncing with any in-flight macro step), write eviction spools,
+        compose installs on host, upload each touched bucket once."""
+        pool = self.pool
+        snaps = {
+            cls: pool.pull_bucket(cls) for cls in sorted(plan.pull_classes)
+        }
+        for doc_id, cls, row in plan.evictions:
+            doc, length, nvis = snaps[cls]
+            pool.spool_save(
+                doc_id, doc[row], int(length[row]), int(nvis[row])
+            )
+        for cls, items in plan.installs.items():
+            if not items:
+                continue
+            if cls in snaps:
+                doc_s, len_s, nvis_s = snaps[cls]
+            else:
+                doc_s, len_s, nvis_s = pool.pull_bucket(cls)
+            # writable copies: sources always read the pre-compose
+            # snapshot, so a row can be both vacated and refilled in one
+            # boundary without ordering hazards.
+            doc_w = np.array(doc_s)
+            len_w = np.array(len_s)
+            nvis_w = np.array(nvis_s)
+            C = self.pool.buckets[cls].C
+            for doc_id, row, source in items:
+                rec = pool.docs[doc_id]
+                if source[0] == "fresh":
+                    doc_w[row] = _fresh_row_np(C, rec.n_init)
+                    len_w[row] = nvis_w[row] = rec.n_init
+                elif source[0] == "spool":
+                    st = load_state(source[1])
+                    os.unlink(source[1])  # rehydrated: bound the spool
+                    L = int(st.length[0])
+                    doc_w[row, :L] = st.doc[0, :L]
+                    doc_w[row, L:] = 2
+                    len_w[row] = L
+                    nvis_w[row] = int(st.nvis[0])
+                else:  # ("pull", src_cls, src_row)
+                    _, src_cls, src_row = source
+                    sdoc, slen, snvis = snaps[src_cls]
+                    L = int(slen[src_row])
+                    doc_w[row, :L] = sdoc[src_row, :L]
+                    doc_w[row, L:] = 2
+                    len_w[row] = L
+                    nvis_w[row] = int(snvis[src_row])
+            pool.upload_bucket(cls, doc_w, len_w, nvis_w)
+
+    # ---- dispatch + mirrors ----
+
+    def _dispatch(self, plan: _Plan, tensors: dict[int, tuple]) -> bool:
+        compiled = False
+        for cls, (kind, pos, rlen, slot0) in tensors.items():
+            compiled |= self.pool.macro_step(
+                cls, kind, pos, rlen, slot0, nbits=self.nbits
+            )
+            self.stats.slices += plan.k_eff[cls]
+            self.stats.staged_cells += kind.size
+        return compiled
+
+    def _advance(self, plan: _Plan) -> None:
+        """Host mirrors after dispatch: the staged ops WILL be applied,
+        and length/cursor evolve deterministically, so no sync is needed
+        to keep scheduling exact."""
+        lanes_used = 0
+        for cls, lanes in plan.lanes.items():
+            for lane in lanes:
+                st = lane.stream
                 rec = self.pool.docs[st.doc_id]
-                r, c0 = rec.row, st.cursor
-                kind[r, :take] = st.kind[c0:c0 + take]
-                pos[r, :take] = st.pos[c0:c0 + take]
-                slot[r, :take] = st.slot[c0:c0 + take]
-            self.pool.step(cls, kind, pos, slot)
-            for st, take in lanes:
-                rec = self.pool.docs[st.doc_id]
-                st.cursor += take
-                rec.length = rec.n_init + int(st.ins_cum[st.cursor - 1])
-                rec.last_sched = self.round
-                self.stats.ops += take
-        self.pool.block()
-        dt = time.perf_counter() - t0
-        self.stats.round_latencies.append(dt)
+                self.stats.ops += lane.end - st.cursor
+                self.stats.unit_ops += (
+                    st.units_before(lane.end) - st.units_before(st.cursor)
+                )
+                st.cursor = lane.end
+                rec.length = rec.n_init + st.ins_before(lane.end)
+                rec.last_sched = plan.base_round
+                lanes_used += 1
         total_lanes = sum(b.R for b in self.pool.buckets.values())
         self.stats.occupancy.append(lanes_used / total_lanes)
-        self.stats.queue_depth.append(waiting)
-        self.round += 1
+        self.stats.queue_depth.append(plan.waiting)
+        self.round = plan.base_round + max(plan.k_eff.values())
+
+    # ---- driver ----
+
+    def run_round(self) -> bool:
+        """One macro-round (plan -> stage -> boundary moves -> one async
+        dispatch per class).  Returns False when no work remains."""
+        t0 = time.perf_counter()
+        plan = self._plan()
+        if plan is None:
+            return False
+        tensors = self._stage(plan)
+        self._execute_moves(plan)
+        compiled = self._dispatch(plan, tensors)
+        self._advance(plan)
+        self.stats.round_latencies.append(time.perf_counter() - t0)
+        self.stats.compile_flags.append(compiled)
         return True
 
     def run(self, max_rounds: int | None = None) -> ServeStats:
-        """Drain every queue (or stop after ``max_rounds``)."""
+        """Drain every queue (or stop after ``max_rounds`` macro-rounds).
+        Synchronization discipline: each run_round syncs only at its
+        boundary moves; the device drains behind the host planner and is
+        fenced once here at the end."""
         t0 = time.perf_counter()
         n = 0
         while self.run_round():
             n += 1
             if max_rounds is not None and n >= max_rounds:
                 break
+        tail0 = time.perf_counter()
+        self.pool.block()  # final fence: the last macro-round's drain
+        if self.stats.round_latencies:
+            self.stats.round_latencies[-1] += time.perf_counter() - tail0
         self.stats.wall_time += time.perf_counter() - t0
         self.stats.rounds = len(self.stats.round_latencies)
         self.stats.evictions = self.pool.evictions

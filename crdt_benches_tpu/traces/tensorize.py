@@ -172,6 +172,39 @@ def coalesce_patches(trace: TestData):
         yield tuple(pend)
 
 
+def split_insert_runs(
+    kind: np.ndarray, pos: np.ndarray, rlen: np.ndarray, slot0: np.ndarray,
+    max_ins: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Split INSERT runs longer than ``max_ins`` chars into consecutive
+    pieces: inserting ``L`` chars at ``p`` equals inserting the first
+    ``max_ins`` at ``p``, the next at ``p + max_ins``, ... with slot ids
+    advancing in step.  Deletes pass through whole (a delete range of any
+    length is one interval clear in the apply — only inserted chars gate
+    the expansion's nbits budget).  Lets a scheduler cap per-batch insert
+    volume without per-op cursor state (serve/scheduler.py)."""
+    if max_ins < 1:
+        raise ValueError(f"max_ins must be >= 1, got {max_ins}")
+    splits = (kind == INSERT) & (rlen > max_ins)
+    if not splits.any():
+        return kind, pos, rlen, slot0
+    reps = np.where(splits, -(-rlen // max_ins), 1).astype(np.int64)
+    idx = np.repeat(np.arange(len(kind)), reps)
+    first = np.concatenate([[0], np.cumsum(reps)[:-1]])
+    off = (np.arange(len(idx)) - np.repeat(first, reps)).astype(np.int64)
+    chars_before = (off * max_ins).astype(np.int32)
+    k2 = kind[idx]
+    is_ins = k2 == INSERT
+    p2 = np.where(is_ins, pos[idx] + chars_before, pos[idx]).astype(np.int32)
+    r2 = np.where(
+        is_ins, np.minimum(max_ins, rlen[idx] - chars_before), rlen[idx]
+    ).astype(np.int32)
+    s2 = np.where(is_ins, slot0[idx] + chars_before, slot0[idx]).astype(
+        np.int32
+    )
+    return k2, p2, r2, s2
+
+
 def tensorize_ranges(
     trace: TestData, batch: int = 512, coalesce: bool = False,
     patches=None,

@@ -32,14 +32,19 @@ _CLASSES = {
 }
 
 
-def save_state(path: str, state) -> None:
+def save_state(path: str, state, compress: bool = True) -> None:
     """Persist a DocState/DownState pytree (device arrays are fetched).
 
     Non-NumPy-native dtypes need explicit handling: ``np.savez`` writes a
     bfloat16 array (PackedState4.cv_intile) but ``np.load`` reads it back
     as an opaque void dtype (``|V2``), silently breaking v4-state resume.
     Such fields are stored as a uint16 bit-view plus a dtype manifest and
-    re-viewed on load."""
+    re-viewed on load.
+
+    ``compress=False`` skips zlib (``np.savez``): the serve/ eviction
+    spool writes thousands of small checkpoints per drain and the
+    deflate pass dominated its host cost; ``load_state`` reads both
+    forms transparently."""
     cls = type(state).__name__
     if cls not in _CLASSES:
         raise TypeError(f"unsupported state type {cls}")
@@ -51,7 +56,8 @@ def save_state(path: str, state) -> None:
         if a.dtype == _BF16:
             a = a.view(np.uint16)
         arrays[f] = a
-    np.savez_compressed(
+    saver = np.savez_compressed if compress else np.savez
+    saver(
         path, __class__=np.asarray(cls), __fields__=np.asarray(state._fields),
         __dtypes__=np.asarray(dtypes), **arrays,
     )

@@ -1,0 +1,136 @@
+"""The pure-JAX range resolver vs the Pallas kernel and the oracle.
+
+``ops/resolve_range_scan.py`` must be bit-identical to
+``ops/resolve_range_pallas.py`` (interpret mode on CPU) on every output —
+token arrays, per-delete rank intervals, nused — because the serve fleet
+and the off-TPU replay engine trust it as a drop-in; and per-ROW batches
+(the fleet's whole reason for its existence) must replay documents
+byte-exactly through ``apply_range_batch``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.ops.resolve_range_pallas import (
+    resolve_range_pallas,
+)
+from crdt_benches_tpu.ops.resolve_range_scan import (
+    resolve_ranges_rows,
+    resolve_ranges_shared,
+)
+from crdt_benches_tpu.oracle import OracleDocument
+from crdt_benches_tpu.traces.synth import synth_trace
+from crdt_benches_tpu.traces.tensorize import (
+    INSERT,
+    split_insert_runs,
+    tensorize_ranges,
+)
+
+
+def _oracle(trace):
+    doc = OracleDocument.from_str(trace.start_content)
+    for p, d, ins in trace.iter_patches():
+        doc.replace(p, p + d, ins)
+    return doc.content()
+
+
+@pytest.mark.parametrize("seed,coalesce", [(0, False), (1, True), (3, True)])
+def test_scan_resolver_matches_pallas_kernel(seed, coalesce):
+    """Every output bit-identical to the kernel across a full replay's
+    batches (interpret mode = the kernel's own CPU reference)."""
+    tr = synth_trace(seed=seed, n_ops=260, base="scan-vs-pallas base ")
+    rt = tensorize_ranges(tr, batch=32, coalesce=coalesce)
+    kb, pb, lb, sb = rt.batched()
+    nvis = len(rt.init_chars)
+    for i in range(rt.n_batches):
+        k, p, l, s = (jnp.asarray(x[i]) for x in (kb, pb, lb, sb))
+        v = jnp.asarray([nvis], jnp.int32)
+        tok_p, di_p, nu_p = resolve_range_pallas(
+            k, p, l, s, v, interpret=True
+        )
+        tok_s, di_s, nu_s = resolve_ranges_shared(k, p, l, s, v)
+        T = np.asarray(tok_s[0]).shape[1]  # kernel pads T up to 128
+        for a, b, name in zip(tok_p, tok_s, ("ttype", "ta", "tch", "tlen")):
+            np.testing.assert_array_equal(
+                np.asarray(a)[:, :T], np.asarray(b), err_msg=f"{i}/{name}"
+            )
+        for a, b, name in zip(di_p, di_s, ("dlo", "dhi", "dcount")):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"{i}/{name}"
+            )
+        assert int(np.asarray(nu_p)[0, 0]) == int(np.asarray(nu_s)[0])
+        ins = int(np.where(kb[i] == INSERT, lb[i], 0).sum())
+        nvis += ins - int(np.asarray(di_s[2]).sum())
+
+
+def test_per_row_batches_replay_byte_exact():
+    """The fleet contract: R lanes, each a DIFFERENT document with its
+    own coalesced range stream, replayed via vmapped scan-resolve +
+    apply_range_batch — every lane byte-identical to its oracle."""
+    from crdt_benches_tpu.ops.apply2 import PackedState, decode_state3
+    from crdt_benches_tpu.ops.apply_range import apply_range_batch
+    from crdt_benches_tpu.serve.pool import _fresh_row_np
+
+    R, B, C, CAP = 4, 8, 256, 32
+    traces = [
+        synth_trace(seed=40 + r, n_ops=90, base="doc base " * (r + 1))
+        for r in range(R)
+    ]
+    rts = [tensorize_ranges(t, batch=1, coalesce=True) for t in traces]
+    streams = [
+        split_insert_runs(
+            rt.kind[: rt.n_ops], rt.pos[: rt.n_ops],
+            rt.rlen[: rt.n_ops], rt.slot0[: rt.n_ops], CAP,
+        )
+        for rt in rts
+    ]
+    n_batches = max(-(-len(s[0]) // B) for s in streams)
+    state = PackedState(
+        doc=jnp.asarray(np.stack([
+            _fresh_row_np(C, len(rt.init_chars)) for rt in rts
+        ])),
+        length=jnp.asarray([len(rt.init_chars) for rt in rts], jnp.int32),
+        nvis=jnp.asarray([len(rt.init_chars) for rt in rts], jnp.int32),
+    )
+    for i in range(n_batches):
+        kind = np.zeros((R, B), np.int32)  # PAD
+        pos = np.zeros((R, B), np.int32)
+        rlen = np.zeros((R, B), np.int32)
+        slot0 = np.full((R, B), -1, np.int32)
+        for r, (k, p, l, s) in enumerate(streams):
+            lo, hi = i * B, min((i + 1) * B, len(k))
+            if lo < hi:
+                kind[r, : hi - lo] = k[lo:hi]
+                pos[r, : hi - lo] = p[lo:hi]
+                rlen[r, : hi - lo] = l[lo:hi]
+                slot0[r, : hi - lo] = s[lo:hi]
+        tokens, dints, _ = resolve_ranges_rows(
+            *(jnp.asarray(a) for a in (kind, pos, rlen, slot0)),
+            state.nvis,
+        )
+        state = apply_range_batch(state, tokens, dints, nbits=6)
+    for r, (t, rt) in enumerate(zip(traces, rts)):
+        codes, nvis = decode_state3(state, jnp.asarray(rt.chars), replica=r)
+        got = "".join(map(chr, np.asarray(codes)[: int(nvis)].tolist()))
+        assert got == _oracle(t), f"lane {r} diverged"
+
+
+def test_split_insert_runs_invariants():
+    kind = np.asarray([1, 2, 1, 1], np.int32)  # INSERT, DELETE, INSERT x2
+    pos = np.asarray([0, 5, 10, 3], np.int32)
+    rlen = np.asarray([70, 99, 32, 5], np.int32)
+    slot0 = np.asarray([100, -1, 200, 300], np.int32)
+    k2, p2, r2, s2 = split_insert_runs(kind, pos, rlen, slot0, 32)
+    # 70 -> 32+32+6; delete untouched; 32 and 5 untouched
+    assert list(r2) == [32, 32, 6, 99, 32, 5]
+    assert list(p2) == [0, 32, 64, 5, 10, 3]
+    assert list(s2) == [100, 132, 164, -1, 200, 300]
+    assert (r2[k2 == 1] <= 32).all()
+    # char totals preserved
+    assert r2[k2 == 1].sum() == rlen[kind == 1].sum()
+    with pytest.raises(ValueError):
+        split_insert_runs(kind, pos, rlen, slot0, 0)
+    # no-op when nothing exceeds the cap: same arrays pass through
+    k3, p3, r3, s3 = split_insert_runs(kind, pos, rlen, slot0, 128)
+    assert r3 is rlen and s3 is slot0

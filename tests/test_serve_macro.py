@@ -1,0 +1,242 @@
+"""Macro-round serving: parity, mid-macro churn, and telemetry.
+
+The macro engine changes WHEN everything happens (K rounds per dispatch,
+boundary-batched row movement, row-tier compaction, RLE op coalescing)
+but must never change WHAT each document becomes — every test's ground
+truth is the oracle or the K=1 drain of the identical fleet.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.bench.harness import steady_quantiles
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, prepare_streams
+from crdt_benches_tpu.serve.workload import Session, build_fleet, trace_prefix
+
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+def _drain(sessions, pool, batch=16, macro_k=1, batch_chars=64):
+    streams = prepare_streams(
+        sessions, pool, batch=batch, batch_chars=batch_chars
+    )
+    sched = FleetScheduler(
+        pool, streams, batch=batch, macro_k=macro_k,
+        batch_chars=batch_chars,
+    )
+    stats = sched.run()
+    assert sched.done
+    return stats
+
+
+def _mixed_sessions(tmp_path):
+    """A small fleet spanning synth AND real-trace classes (both test
+    pool classes host docs), with arrivals staggered."""
+    sessions = build_fleet(
+        10, mix=TINY_MIX, seed=7, arrival_span=3, bands=TINY_BANDS
+    )
+    nxt = len(sessions)
+    sessions += [
+        Session(doc_id=nxt, band="trace-small", source="automerge-paper",
+                trace=trace_prefix("automerge-paper", 240), arrival=1),
+        Session(doc_id=nxt + 1, band="trace-medium",
+                source="sveltecomponent",
+                trace=trace_prefix("sveltecomponent", 500)),
+    ]
+    return sessions
+
+
+def test_macro_k8_byte_identical_to_k1(tmp_path):
+    """THE parity gate: the same fleet drained with macro-rounds (K=8)
+    and with single rounds (K=1) is byte-identical for every doc — a
+    sample spanning every hosted class — and both match the oracle."""
+    sessions = _mixed_sessions(tmp_path)
+
+    def run(k, sub):
+        pool = DocPool(classes=(256, 1024), slots=(6, 3),
+                       spool_dir=str(tmp_path / sub))
+        stats = _drain(sessions, pool, macro_k=k)
+        out = {s.doc_id: pool.decode(s.doc_id) for s in sessions}
+        hosted = {pool.docs[s.doc_id].cls for s in sessions}
+        return out, stats, hosted
+
+    k1, stats1, _ = run(1, "k1")
+    k8, stats8, hosted = run(8, "k8")
+    assert k1 == k8
+    for s in sessions:
+        assert k8[s.doc_id] == replay_trace(s.trace), (
+            f"doc {s.doc_id} ({s.band}) diverged from oracle"
+        )
+    # the sample really spans hosted classes, and the macro engine
+    # actually batched: fewer macro-rounds than K=1 rounds
+    assert len([c for c in hosted if c]) >= 1
+    assert stats8.rounds < stats1.rounds
+    # identical op streams -> identical coalescing accounting
+    assert stats8.unit_ops == stats1.unit_ops
+    assert stats8.ops == stats1.ops
+
+
+def test_evict_restore_mid_macro_round_roundtrip(tmp_path):
+    """Eviction mid-macro-round is a FORCED SYNC boundary: dispatch a
+    macro-round, then — with the device potentially still draining —
+    evict a scheduled doc through the checkpoint spool, reload it into a
+    different row, and finish.  Byte-identical to an uninterrupted
+    replay."""
+    from crdt_benches_tpu.traces.synth import synth_trace
+
+    traces = [synth_trace(seed=200 + i, n_ops=120) for i in range(3)]
+    sessions = [
+        Session(doc_id=i, band="synth-small", source="synth", trace=t)
+        for i, t in enumerate(traces)
+    ]
+    pool = DocPool(classes=(128,), slots=(2,), spool_dir=str(tmp_path))
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32)
+
+    # one macro-round dispatched; its device work may still be in
+    # flight — pool.evict's row pull must fence it (the boundary sync)
+    sched.run(max_rounds=1)
+    rec0 = pool.docs[0]
+    assert streams[0].cursor > 0 and streams[0].remaining > 0
+    if rec0.cls is None:
+        if not pool.buckets[128].free:
+            pool.evict(pool.residents(128)[0][0])
+        pool.admit(0, need=rec0.length)
+    row_before = rec0.row
+    spool = pool.evict(0)
+    assert os.path.exists(spool) and rec0.spool == spool
+    assert rec0.cls is None
+
+    # occupy the freed row, then free the OTHER row, so doc 0 must
+    # rehydrate into a different slot
+    other = next(d for d in (1, 2) if pool.docs[d].cls is None)
+    assert pool.admit(other, need=pool.docs[other].length)[1] == row_before
+    for d, _row in pool.residents(128):
+        if pool.docs[d].row != row_before:
+            pool.evict(d)
+    cls, row_after = pool.admit(0, need=rec0.length)
+    assert (cls, row_after) != (128, row_before), (
+        "test setup: doc 0 restored into its old slot; churn not exercised"
+    )
+
+    sched.run()  # drain the rest through macro-rounds
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+    assert pool.restores >= 1
+
+
+def test_spool_checkpoint_trimmed_roundtrip(tmp_path):
+    """The macro engine's spool writes are length-trimmed and
+    uncompressed — they must still round-trip bit-exactly through
+    utils/checkpoint for ANY resident doc state."""
+    from crdt_benches_tpu.utils.checkpoint import load_state
+
+    sessions = _mixed_sessions(tmp_path)
+    pool = DocPool(classes=(256, 1024), slots=(6, 3),
+                   spool_dir=str(tmp_path / "sp"))
+    streams = prepare_streams(sessions, pool, batch=16, batch_chars=64)
+    sched = FleetScheduler(pool, streams, batch=16, macro_k=4,
+                           batch_chars=64)
+    sched.run(max_rounds=2)
+    doc_id, _row = pool.residents(256)[0]
+    before = pool.decode(doc_id)
+    path = pool.evict(doc_id)
+    st = load_state(path)
+    rec = pool.docs[doc_id]
+    assert st.doc.shape[1] == int(st.length[0])  # trimmed to used prefix
+    assert pool.decode(doc_id) == before  # spooled decode == resident
+    pool.admit(doc_id, need=rec.length)
+    assert pool.decode(doc_id) == before
+    sched.run()
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+
+
+def test_mesh_macro_fleet_matches_unsharded(tmp_path):
+    """Docs-over-mesh with ROW-TIER SLICING: bucket rows big enough that
+    compaction picks a sub-tier (Rt < R) on the 8-device virtual mesh —
+    sharded slice/writeback must decode identically to the single-device
+    drain, and both match the oracle."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    from crdt_benches_tpu.parallel.mesh import replica_mesh
+
+    sessions = build_fleet(
+        12, mix={"synth-small": 1.0}, seed=5, arrival_span=2,
+        bands=TINY_BANDS,
+    )
+
+    def run(mesh, sub):
+        # 128 rows over 8 shards = 16 local rows; 12 docs compact into
+        # the Rt=32 tier (4 locals/shard), exercising the sliced path
+        pool = DocPool(classes=(128,), slots=(128,), mesh=mesh,
+                       spool_dir=str(tmp_path / sub))
+        stats = _drain(sessions, pool, macro_k=4)
+        assert stats.pad_fraction < 1.0
+        return {s.doc_id: pool.decode(s.doc_id) for s in sessions}
+
+    plain = run(None, "plain")
+    sharded = run(replica_mesh(8), "mesh")
+    assert plain == sharded
+    for s in sessions:
+        assert plain[s.doc_id] == replay_trace(s.trace)
+
+
+def test_stats_pad_fraction_and_coalesce_ratio(tmp_path):
+    """The occupancy-waste telemetry satellite: both metrics live in
+    ServeStats and land in the serve_*.json artifact."""
+    import json
+
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=12, batch=16,
+        classes=(128, 512), slots=(8, 4), seed=3, arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS, macro_k=4, batch_chars=64,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    stats = info["stats"]
+    assert 0.0 <= stats.pad_fraction < 1.0
+    assert stats.coalesce_ratio >= 1.0
+    assert stats.unit_ops >= stats.ops
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    ex = d["extra"]
+    assert 0.0 <= ex["pad_fraction"] < 1.0
+    assert ex["coalesce_ratio"] >= 1.0
+    assert ex["macro_k"] == 4
+    assert ex["unit_ops"] >= ex["range_ops"] > 0
+    # compile rounds are excluded from the latency quantiles and
+    # reported separately
+    assert ex["compile_rounds"] >= 1
+    assert ex["compile_time"] > 0
+    lat = ex["batch_latency"]
+    assert set(lat) == {"p50", "p95", "p99"}
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
+
+
+def test_steady_quantiles_excludes_flagged():
+    lats = [5.0, 0.1, 0.2, 0.3, 9.0]
+    flags = [True, False, False, False, True]
+    q, skipped_time, n = steady_quantiles(lats, flags)
+    assert n == 2 and skipped_time == 14.0
+    assert q["p50"] == 0.2 and q["p99"] <= 0.3
+    # all-flagged falls back to the full list instead of raising
+    q2, t2, n2 = steady_quantiles([1.0, 2.0], [True, True], ps=(0.5,))
+    assert q2["p50"] == 1.5 and n2 == 2 and t2 == 3.0
+    with pytest.raises(ValueError):
+        steady_quantiles([1.0], [True, False])

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
+#
+# Usage: tools/bench_smoke.sh [--family serve]     (from the repo root)
+#
+# The serve family (the default and currently only family) drains a tiny
+# document fleet through the macro-round engine (K=4) on host CPU and
+# exits NONZERO when the in-run oracle byte-verification fails
+# (`verify_ok: false`) — the runner's exit code carries the gate, so a
+# correctness regression in the serving hot path fails CI even when every
+# unit test was green.  The artifact lands in bench_results/ under a
+# smoke-specific name so it never clobbers committed headline numbers.
+set -euo pipefail
+
+family="serve"
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --family) family="$2"; shift 2 ;;
+    *) echo "unknown argument: $1" >&2; exit 2 ;;
+  esac
+done
+
+case "$family" in
+  serve)
+    exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-save-name serve_smoke
+    ;;
+  *)
+    echo "unknown family: $family (expected: serve)" >&2
+    exit 2
+    ;;
+esac
