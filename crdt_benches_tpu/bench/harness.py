@@ -147,6 +147,21 @@ def steady_quantiles(
     return quantiles(kept or list(samples), ps), sum(skipped), len(skipped)
 
 
+def summarize(values) -> dict:
+    """Compact count/mean/max summary of a metric list — the artifact
+    form of per-event series (the serve family's MTTR-in-rounds and
+    replay-size reports).  Zeros when the list is empty, so a clean run
+    and a chaos run share one schema."""
+    vs = list(values)
+    if not vs:
+        return {"n": 0, "mean": 0.0, "max": 0}
+    return {
+        "n": len(vs),
+        "mean": float(sum(vs)) / len(vs),
+        "max": max(vs),
+    }
+
+
 def classify_outliers(samples: list[float]) -> dict:
     """Tukey-fence outlier classification (criterion's analysis: mild
     outside Q1/Q3 +- 1.5*IQR, severe outside +- 3*IQR — the capability the

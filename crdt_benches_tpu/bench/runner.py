@@ -25,6 +25,16 @@ Families:
       separate) + pad_fraction/coalesce_ratio, byte-verifies a
       per-capacity-class doc sample against the oracle, and writes
       bench_results/serve_<mix>_<docs>.json.
+
+      Fault tolerance: --serve-journal DIR|auto enables the write-ahead
+      op journal + snapshot barriers (--serve-snapshot-every);
+      --serve-faults SPEC runs a seeded chaos drain (serve/faults.py
+      grammar, e.g. "seed=7,spool_corrupt=1,device_loss=1,
+      queue_overflow=1") with recovery metrics (MTTR in rounds, ops
+      replayed/shed, quarantines) in the artifact; --serve-queue-cap
+      bounds per-doc pending ops with --serve-overflow-policy deciding
+      defer-vs-shed at the cap.  Chaos exit code is nonzero when the
+      verify fails OR any injected fault goes unfired/unrecovered.
 """
 
 from __future__ import annotations
@@ -629,7 +639,9 @@ def verify_merge(config: str, merge_ops: int, batch: int,
 def run_serve(args) -> int:
     """The serve family: build/drain a document fleet (serve/bench.py),
     verify a per-class sample against the oracle, persist the artifact.
-    Exits nonzero on a verification mismatch."""
+    Exits nonzero on a verification mismatch — and, in chaos mode
+    (--serve-faults), when any injected fault goes unfired or
+    unrecovered."""
     from ..serve.bench import ensure_virtual_devices, run_serve_bench
 
     mesh_devices = ensure_virtual_devices(args.serve_mesh)
@@ -645,6 +657,11 @@ def run_serve(args) -> int:
         verify_sample=args.serve_verify_sample,
         macro_k=args.serve_macro,
         batch_chars=args.serve_batch_chars,
+        journal_dir=args.serve_journal,
+        snapshot_every=args.serve_snapshot_every,
+        faults=args.serve_faults,
+        queue_cap=args.serve_queue_cap,
+        overflow_policy=args.serve_overflow_policy,
         save_name=args.serve_save_name,
         log=lambda m: print(m, file=sys.stderr),
     )
@@ -657,7 +674,19 @@ def run_serve(args) -> int:
         f"coalesce x{r.extra['coalesce_ratio']:.2f}, "
         f"pad {r.extra['pad_fraction']:.3f})"
     )
-    return 0 if info["verify_ok"] else 1
+    if r.extra["faults"] is not None:
+        f = r.extra["faults"]
+        mttr = r.extra["mttr_rounds"]
+        print(
+            f"  chaos: {f['injected']} injected / {f['recovered']} "
+            f"recovered ({f['not_fired']} not fired), "
+            f"MTTR {mttr['mean']:.1f} rounds (max {mttr['max']}), "
+            f"replayed {r.extra['ops_replayed']} ops, "
+            f"shed {r.extra['shed_ops']}, "
+            f"quarantines {len(r.extra['quarantines'])}, "
+            f"degraded rounds {r.extra['degraded_rounds']}"
+        )
+    return 0 if info["verify_ok"] and info["faults_ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -682,6 +711,28 @@ def main(argv=None) -> int:
                          "to fit)")
     ap.add_argument("--serve-save-name", default=None,
                     help="artifact basename (default serve_<mix>_<docs>)")
+    ap.add_argument("--serve-journal", default=None, metavar="DIR",
+                    help="enable the write-ahead op journal + snapshot "
+                         "barriers in DIR ('auto' = an owned temp dir, "
+                         "removed after the run)")
+    ap.add_argument("--serve-snapshot-every", type=int, default=32,
+                    metavar="N",
+                    help="fleet snapshot barrier period in macro-rounds "
+                         "(journal mode only)")
+    ap.add_argument("--serve-faults", default=None, metavar="SPEC",
+                    help="seeded chaos drain: serve/faults.py spec, e.g. "
+                         "'seed=7,span=8,spool_corrupt=1,device_loss=1,"
+                         "queue_overflow=1,dup_batch=1,stall=1'")
+    ap.add_argument("--serve-queue-cap", type=int, default=0,
+                    help="bound each doc's pending op queue (0 = "
+                         "unbounded legacy behavior; overflow past the "
+                         "cap is an explicit defer/shed decision)")
+    ap.add_argument("--serve-overflow-policy", default="defer",
+                    choices=("defer", "shed"),
+                    help="decision at a queue-cap overflow: backpressure "
+                         "the producer (defer) or tail-drop the "
+                         "session's remaining ops (shed; surfaced as "
+                         "shed_ops + lossy_docs)")
     ap.add_argument("--serve-classes", default="256,1024,4096,8192,49152",
                     help="capacity classes (slots per doc, ascending; the "
                          "largest must hold the biggest workload doc — "

@@ -22,24 +22,42 @@ arxiv 2310.18220):
 - :mod:`.workload`   — multi-tenant generator interleaving the four real
   traces (as prefixes) plus ``traces/synth.py`` streams across N
   simulated sessions with a configurable arrival mix;
+- :mod:`.journal`    — fault tolerance: per-round write-ahead op journal
+  (CRC-framed, torn-tail safe), periodic fleet snapshot barriers
+  (atomic directory commit), crash recovery (``recover_fleet``) and the
+  targeted rebuild primitive (``rebuild_doc``) used by in-run repair;
+- :mod:`.faults`     — deterministic chaos: a seeded ``FaultPlan``
+  (spool corruption/truncation, mid-macro device-state loss, duplicated
+  op batches, host stalls, queue-overflow bursts) injected through
+  scheduler hooks, every event tracked fired/recovered;
 - :mod:`.bench`      — the ``serve`` bench family (fleet patches/sec +
-  p50/p95/p99 per-batch latency), wired into ``bench/runner.py`` under
-  ``--family serve`` with bench ids ``serve/<mix>/<fleet-size>``.
+  p50/p95/p99 per-batch latency, recovery metrics in chaos mode), wired
+  into ``bench/runner.py`` under ``--family serve`` with bench ids
+  ``serve/<mix>/<fleet-size>``.
 
 Correctness gate: sampled docs from every capacity bucket finish
 byte-identical to ``oracle/text_oracle.py`` replaying the same per-doc
-stream (tests/test_serve.py, and the in-run verify of the bench family).
+stream (tests/test_serve.py, and the in-run verify of the bench family)
+— including after recovery from injected faults (tests/test_journal.py,
+tests/test_serve_faults.py).
 """
 
+from .faults import FaultInjector, FaultPlan
+from .journal import OpJournal, RecoveryReport, recover_fleet
 from .pool import DocPool
 from .scheduler import FleetScheduler, ServeStats, prepare_streams
 from .workload import BANDS, MIXES, build_fleet
 
 __all__ = [
     "DocPool",
+    "FaultInjector",
+    "FaultPlan",
     "FleetScheduler",
+    "OpJournal",
+    "RecoveryReport",
     "ServeStats",
     "prepare_streams",
+    "recover_fleet",
     "BANDS",
     "MIXES",
     "build_fleet",

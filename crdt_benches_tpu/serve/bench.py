@@ -20,18 +20,37 @@ of ``bench/harness.py BenchResult``).  Reported numbers:
 Correctness gate (in-run, not optional): a sample of docs spanning every
 capacity class that hosted documents is decoded and byte-compared
 against ``oracle/text_oracle.py`` replaying the same per-doc stream; a
-mismatch fails the run.
+mismatch fails the run.  Docs that lost ops to an EXPLICIT load-shed or
+quarantine decision are excluded from the sample (their loss is the
+decision, surfaced in the artifact) — everything else must match.
+
+Chaos mode (``faults=<spec>``): a seeded ``serve/faults.py`` FaultPlan
+is wired into the drain (journal + snapshot barriers recommended via
+``journal_dir``), and the artifact grows a ``faults`` block — the event
+list with fired/recovered flags, MTTR in macro-rounds, ops replayed /
+shed / deferred, quarantines, degraded rounds.  ``info["faults_ok"]``
+is False when any event failed to fire or went unrecovered — the chaos
+smoke's exit gate, alongside ``verify_ok``.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import sys
+import tempfile
 
 import numpy as np
 
-from ..bench.harness import BenchResult, save_results, steady_quantiles
+from ..bench.harness import (
+    BenchResult,
+    save_results,
+    steady_quantiles,
+    summarize,
+)
 from ..oracle.text_oracle import replay_trace
+from .faults import FaultInjector, FaultPlan
+from .journal import OpJournal
 from .pool import DocPool
 from .scheduler import FleetScheduler, prepare_streams
 from .workload import build_fleet
@@ -95,20 +114,53 @@ def run_serve_bench(
     macro_k: int = 8,
     batch_chars: int = 256,
     spool_dir: str | None = None,
+    journal_dir: str | None = None,
+    snapshot_every: int = 32,
+    journal_fsync: bool = False,
+    faults=None,
+    queue_cap: int = 0,
+    overflow_policy: str = "defer",
+    delivery: str | None = None,
     results_dir: str | None = None,
     save_name: str | None = None,
     log=print,
 ) -> tuple[BenchResult, dict]:
     """Build the fleet, drain it once, verify a per-class doc sample
     against the oracle, and persist the artifact.  Returns
-    (BenchResult, info) with ``info["verify_ok"]``.
+    (BenchResult, info) with ``info["verify_ok"]`` (and, in chaos mode,
+    ``info["faults_ok"]``).
 
     ``macro_k`` staged rounds ride each device dispatch (1 = the legacy
     round loop through the same machinery); ``batch`` range ops and
-    ``batch_chars`` inserted chars bound one doc's slice."""
+    ``batch_chars`` inserted chars bound one doc's slice.
+
+    Fault-tolerance knobs: ``journal_dir`` enables the write-ahead op
+    journal + snapshot barriers every ``snapshot_every`` macro-rounds
+    ("auto" = an owned temp dir, removed after the run); ``faults`` is a
+    ``serve/faults.py`` spec string or FaultPlan; ``queue_cap`` bounds
+    each doc's pending ops with ``overflow_policy`` deciding
+    defer-vs-shed at the cap (chaos with ``queue_overflow`` events
+    auto-defaults the cap to ``8 * batch`` when unset)."""
     classes = _parse_int_tuple(classes)
     slots = _parse_int_tuple(slots)
     mix_name = mix if isinstance(mix, str) else "custom"
+
+    plan = None
+    if faults is not None:
+        plan = faults if isinstance(faults, FaultPlan) else (
+            FaultPlan.from_spec(faults)
+        )
+        if queue_cap <= 0 and any(
+            e.kind == "queue_overflow" for e in plan.events
+        ):
+            queue_cap = 8 * batch
+            log(f"serve: queue_overflow faults need a bounded queue; "
+                f"defaulting queue_cap={queue_cap}")
+    owns_journal = journal_dir == "auto"
+    if owns_journal:
+        journal_dir = tempfile.mkdtemp(prefix="crdt_journal_")
+    journal = OpJournal(journal_dir, fsync=journal_fsync) \
+        if journal_dir else None
 
     mesh = None
     if mesh_devices > 1:
@@ -116,120 +168,210 @@ def run_serve_bench(
 
         mesh = replica_mesh(mesh_devices)
 
-    log(f"serve: building fleet n_docs={n_docs} mix={mix_name} seed={seed}")
-    sessions = build_fleet(
-        n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands
-    )
-    pool = DocPool(classes=classes, slots=slots, mesh=mesh,
-                   spool_dir=spool_dir)
-    streams = prepare_streams(
-        sessions, pool, batch=batch, batch_chars=batch_chars
-    )
-    total_ops = sum(s.remaining for s in streams.values())
-    total_units = sum(
-        int(s.unit_cum[-1]) for s in streams.values() if len(s.kind)
-    )
-    log(
-        f"serve: {len(sessions)} docs, {total_ops} range ops "
-        f"({total_units} unit ops), classes={classes} slots={slots} "
-        f"batch={batch} chars={batch_chars} K={macro_k} "
-        f"mesh={mesh_devices if mesh else 'off'}"
-    )
+    pool = None
+    # every exit path — including a failed drain or verify — must
+    # close the journal, drop an owned journal dir, and release the
+    # pool's spool directory (CI chaos runs must not leak temp dirs)
+    try:
+        log(f"serve: building fleet n_docs={n_docs} mix={mix_name} seed={seed}")
+        sessions = build_fleet(
+            n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands,
+            delivery=delivery,
+        )
+        pool = DocPool(classes=classes, slots=slots, mesh=mesh,
+                       spool_dir=spool_dir)
+        streams = prepare_streams(
+            sessions, pool, batch=batch, batch_chars=batch_chars
+        )
+        total_ops = sum(s.remaining for s in streams.values())
+        total_units = sum(
+            int(s.unit_cum[-1]) for s in streams.values() if len(s.kind)
+        )
+        log(
+            f"serve: {len(sessions)} docs, {total_ops} range ops "
+            f"({total_units} unit ops), classes={classes} slots={slots} "
+            f"batch={batch} chars={batch_chars} K={macro_k} "
+            f"mesh={mesh_devices if mesh else 'off'}"
+        )
 
-    sched = FleetScheduler(
-        pool, streams, batch=batch, macro_k=macro_k,
-        batch_chars=batch_chars,
-    )
-    stats = sched.run()
-    assert sched.done, "scheduler stopped with pending work"
-    lat, compile_time, compile_rounds = steady_quantiles(
-        stats.round_latencies, stats.compile_flags
-    )
-    throughput = stats.patches / stats.wall_time
-    log(
-        f"serve: drained in {stats.wall_time:.2f}s over {stats.rounds} "
-        f"macro-rounds ({stats.slices} device rounds) -> "
-        f"{throughput:,.0f} patches/s; steady batch latency "
-        f"p50 {lat['p50'] * 1e3:.1f}ms p95 {lat['p95'] * 1e3:.1f}ms "
-        f"p99 {lat['p99'] * 1e3:.1f}ms; compile {compile_time:.2f}s "
-        f"over {compile_rounds} rounds; "
-        f"coalesce x{stats.coalesce_ratio:.2f} "
-        f"pad {stats.pad_fraction:.3f}; evictions {stats.evictions} "
-        f"restores {stats.restores} promotions {stats.promotions}"
-    )
+        sched = FleetScheduler(
+            pool, streams, batch=batch, macro_k=macro_k,
+            batch_chars=batch_chars,
+            queue_cap=queue_cap, overflow_policy=overflow_policy,
+            faults=FaultInjector(plan) if plan else None,
+            journal=journal, snapshot_every=snapshot_every,
+        )
+        stats = sched.run()
+        assert sched.done, "scheduler stopped with pending work"
+        # steady-state latency excludes BOTH compile rounds and snapshot
+        # barrier rounds (forced syncs, reported separately)
+        skip = [c or b for c, b in zip(stats.compile_flags,
+                                       stats.barrier_flags)]
+        lat, _, _ = steady_quantiles(stats.round_latencies, skip)
+        _, compile_time, compile_rounds = steady_quantiles(
+            stats.round_latencies, stats.compile_flags
+        )
+        throughput = stats.patches / stats.wall_time
+        log(
+            f"serve: drained in {stats.wall_time:.2f}s over {stats.rounds} "
+            f"macro-rounds ({stats.slices} device rounds) -> "
+            f"{throughput:,.0f} patches/s; steady batch latency "
+            f"p50 {lat['p50'] * 1e3:.1f}ms p95 {lat['p95'] * 1e3:.1f}ms "
+            f"p99 {lat['p99'] * 1e3:.1f}ms; compile {compile_time:.2f}s "
+            f"over {compile_rounds} rounds; "
+            f"coalesce x{stats.coalesce_ratio:.2f} "
+            f"pad {stats.pad_fraction:.3f}; evictions {stats.evictions} "
+            f"restores {stats.restores} promotions {stats.promotions}"
+        )
+        if plan is not None or stats.recoveries or stats.shed_ops:
+            log(
+                f"serve: faults — injected {stats.faults_injected}, "
+                f"recoveries {stats.recoveries} "
+                f"(replayed {stats.ops_replayed} ops over "
+                f"{stats.replay_dispatches} dispatches), "
+                f"shed {stats.shed_ops} deferred {stats.deferred_ops} "
+                f"dup-dropped {stats.dup_ops_dropped}, "
+                f"quarantines {len(stats.quarantines)}, "
+                f"degraded rounds {stats.degraded_rounds}, "
+                f"snapshots {stats.snapshots}"
+            )
 
-    # ---- per-class byte verification against the oracle ----
-    by_class: dict[int, list[int]] = {}
-    for s in sessions:
-        rec = pool.docs[s.doc_id]
-        final_cls = rec.cls or pool.class_for(max(rec.length, 1))
-        by_class.setdefault(final_cls, []).append(s.doc_id)
-    used_classes = sorted(by_class)
-    per_class = max(1, -(-verify_sample // len(used_classes)))
-    rng = np.random.default_rng(seed + 1)
-    sample: list[int] = []
-    for cls in used_classes:
-        ids = by_class[cls]
-        pick = rng.choice(ids, size=min(per_class, len(ids)), replace=False)
-        sample.extend(int(x) for x in pick)
-    failures = []
-    session_of = {s.doc_id: s for s in sessions}
-    for doc_id in sample:
-        want = replay_trace(session_of[doc_id].trace)
-        got = pool.decode(doc_id)
-        if got != want:
-            failures.append(doc_id)
-    verify_ok = not failures
-    log(
-        f"serve: verified {len(sample)} docs across classes "
-        f"{used_classes}: " + ("all byte-identical to oracle" if verify_ok
-                               else f"MISMATCH on docs {failures}")
-    )
+        # ---- per-class byte verification against the oracle ----
+        # docs whose ops were shed by an EXPLICIT decision (overflow shed /
+        # quarantine) cannot match a full oracle replay; they are excluded
+        # from the sample and surfaced in the artifact instead.
+        lossy = sorted(d for d, st in streams.items() if st.lossy)
+        by_class: dict[int, list[int]] = {}
+        for s in sessions:
+            if streams[s.doc_id].lossy:
+                continue
+            rec = pool.docs[s.doc_id]
+            final_cls = rec.cls or pool.class_for(max(rec.length, 1))
+            by_class.setdefault(final_cls, []).append(s.doc_id)
+        used_classes = sorted(by_class)
+        per_class = max(1, -(-verify_sample // max(1, len(used_classes))))
+        rng = np.random.default_rng(seed + 1)
+        sample: list[int] = []
+        for cls in used_classes:
+            ids = by_class[cls]
+            pick = rng.choice(ids, size=min(per_class, len(ids)), replace=False)
+            sample.extend(int(x) for x in pick)
+        failures = []
+        session_of = {s.doc_id: s for s in sessions}
+        for doc_id in sample:
+            want = replay_trace(session_of[doc_id].trace)
+            got = pool.decode(doc_id)
+            if got != want:
+                failures.append(doc_id)
+        # an EMPTY sample must not pass the gate: with every doc lossy
+        # (mass shed/quarantine) there is nothing left to verify, and a
+        # vacuous green would let the chaos smoke pass while checking
+        # nothing
+        verify_ok = not failures and bool(sample)
+        log(
+            f"serve: verified {len(sample)} docs across classes "
+            f"{used_classes}: "
+            + ("all byte-identical to oracle" if verify_ok
+               else "EMPTY SAMPLE (all docs lossy?)" if not sample
+               else f"MISMATCH on docs {failures}")
+            + (f" ({len(lossy)} lossy docs excluded: {lossy[:16]})"
+               if lossy else "")
+        )
 
-    occ = float(np.mean(stats.occupancy)) if stats.occupancy else 0.0
-    qd = stats.queue_depth or [0]
-    r = BenchResult(
-        group="serve",
-        trace=mix_name,
-        backend=str(n_docs),
-        elements=stats.patches,
-        samples=[stats.wall_time],
-        replicas=1,
-        extra={
-            "family": "serve",
-            "fleet_docs": n_docs,
-            "batch": batch,
-            "batch_chars": batch_chars,
-            "macro_k": macro_k,
-            "classes": list(classes),
-            "slots": list(slots),
-            "mesh_devices": mesh_devices if mesh else 0,
-            "rounds": stats.rounds,
-            "device_rounds": stats.slices,
-            "range_ops": stats.ops,
-            "unit_ops": stats.unit_ops,
-            "coalesce_ratio": stats.coalesce_ratio,
-            "pad_fraction": stats.pad_fraction,
-            "patches_per_sec": throughput,
-            "batch_latency": lat,
-            "compile_time": compile_time,
-            "compile_rounds": compile_rounds,
-            "occupancy_mean": occ,
-            "queue_depth_mean": float(np.mean(qd)),
-            "queue_depth_max": int(np.max(qd)),
-            "evictions": stats.evictions,
-            "restores": stats.restores,
-            "promotions": stats.promotions,
-            "admissions": stats.admissions,
-            "docs_per_class": {
-                str(c): len(v) for c, v in sorted(by_class.items())
+        fault_summary = plan.summary() if plan is not None else None
+        faults_ok = fault_summary is None or (
+            fault_summary["unrecovered"] == 0
+            and fault_summary["not_fired"] == 0
+        )
+        if fault_summary is not None and not faults_ok:
+            log(
+                f"serve: FAULTS NOT CLEARED — "
+                f"{fault_summary['unrecovered']} unrecovered, "
+                f"{fault_summary['not_fired']} never fired"
+            )
+
+        occ = float(np.mean(stats.occupancy)) if stats.occupancy else 0.0
+        qd = stats.queue_depth or [0]
+        r = BenchResult(
+            group="serve",
+            trace=mix_name,
+            backend=str(n_docs),
+            elements=stats.patches,
+            samples=[stats.wall_time],
+            replicas=1,
+            extra={
+                "family": "serve",
+                "fleet_docs": n_docs,
+                "batch": batch,
+                "batch_chars": batch_chars,
+                "macro_k": macro_k,
+                "classes": list(classes),
+                "slots": list(slots),
+                "mesh_devices": mesh_devices if mesh else 0,
+                "rounds": stats.rounds,
+                "device_rounds": stats.slices,
+                "range_ops": stats.ops,
+                "unit_ops": stats.unit_ops,
+                "coalesce_ratio": stats.coalesce_ratio,
+                "pad_fraction": stats.pad_fraction,
+                "patches_per_sec": throughput,
+                "batch_latency": lat,
+                "compile_time": compile_time,
+                "compile_rounds": compile_rounds,
+                "occupancy_mean": occ,
+                "queue_depth_mean": float(np.mean(qd)),
+                "queue_depth_max": int(np.max(qd)),
+                "evictions": stats.evictions,
+                "restores": stats.restores,
+                "promotions": stats.promotions,
+                "admissions": stats.admissions,
+                # ---- fault tolerance / robustness surface ----
+                "queue_cap": queue_cap,
+                "overflow_policy": overflow_policy,
+                "shed_ops": stats.shed_ops,
+                "deferred_ops": stats.deferred_ops,
+                "overflow_events": stats.overflow_events,
+                "backpressure_rounds": stats.backpressure_rounds,
+                "dup_ops_dropped": stats.dup_ops_dropped,
+                "stall_rounds": stats.stall_rounds,
+                "quarantines": stats.quarantines,
+                "recoveries": stats.recoveries,
+                "ops_replayed": stats.ops_replayed,
+                "replay_dispatches": stats.replay_dispatches,
+                "mttr_rounds": summarize(stats.mttr_rounds),
+                "degraded_rounds": stats.degraded_rounds,
+                "lossy_docs": lossy,
+                "journal": None if journal is None else {
+                    "dir": None if owns_journal else journal_dir,
+                    "records": journal.records,
+                    "bytes": journal.bytes_written,
+                    "fsync": journal_fsync,
+                    "snapshots": stats.snapshots,
+                    "snapshot_every": snapshot_every,
+                    "snapshot_time": stats.snapshot_time,
+                },
+                "faults": fault_summary,
+                "docs_per_class": {
+                    str(c): len(v) for c, v in sorted(by_class.items())
+                },
+                "verified_docs": sorted(sample),
+                "verify_ok": verify_ok,
             },
-            "verified_docs": sorted(sample),
+        )
+        kw = {"results_dir": results_dir} if results_dir else {}
+        path = save_results([r], save_name or f"serve_{mix_name}_{n_docs}", **kw)
+        log(f"serve: wrote {path}")
+        return r, {
             "verify_ok": verify_ok,
-        },
-    )
-    kw = {"results_dir": results_dir} if results_dir else {}
-    path = save_results([r], save_name or f"serve_{mix_name}_{n_docs}", **kw)
-    log(f"serve: wrote {path}")
-    pool.close()  # verification done: drop an owned spool directory
-    return r, {"verify_ok": verify_ok, "path": path, "stats": stats}
+            "faults_ok": faults_ok,
+            "path": path,
+            "stats": stats,
+        }
+    finally:
+        if journal is not None:
+            journal.close()
+        if owns_journal:
+            shutil.rmtree(journal_dir, ignore_errors=True)
+        if pool is not None:
+            pool.close()  # drop an owned spool directory
+

@@ -1,0 +1,243 @@
+"""Deterministic fault injection for the serving engine.
+
+A :class:`FaultPlan` is a seeded schedule of fault events parsed from a
+compact spec string (the ``--serve-faults`` grammar); a
+:class:`FaultInjector` is its runtime half — the scheduler polls hooks
+at fixed points of every macro-round and the injector fires each event
+exactly once, deterministically.  Everything is seeded: the same spec +
+workload seed reproduces the same faults at the same rounds against the
+same targets, so a chaos run is as replayable as a clean one.
+
+Spec grammar (comma-separated ``key=value`` tokens)::
+
+    seed=7,span=8,spool_corrupt=1,device_loss=1,queue_overflow=1
+
+- ``seed``  — RNG seed for fire rounds / target picks (default 0)
+- ``span``  — random fire rounds are drawn from ``[2, span]`` macro-
+  rounds (default 8; events whose round never arrives before the drain
+  ends are reported as not fired)
+- ``stall_ms`` — host stall duration (default 40)
+- ``burst``    — queue-overflow burst size in ops (default 4x the cap)
+- fault kinds, each with an event count (``kind=N``) or an explicit
+  fire round (``kind@round=N``):
+
+  =================  ======================================================
+  ``spool_corrupt``  flip bytes inside an existing eviction spool .npz
+  ``spool_truncate`` truncate an existing spool to ~60% of its bytes
+  ``device_loss``    clobber one capacity class's device state right
+                     after a macro dispatch (mid-macro-round loss)
+  ``dup_batch``      redeliver an op batch the doc already applied
+                     (duplicated/reordered delivery; the cursor
+                     high-water mark must drop it)
+  ``stall``          sleep the host staging path for ``stall_ms``
+  ``queue_overflow`` burst-deliver past a doc's bounded queue cap,
+                     forcing an explicit shed/defer decision
+  ``poison_rebuild`` make the targeted doc's rebuild fail (tests the
+                     quarantine path; normally test-constructed)
+  =================  ======================================================
+
+Every event records whether it fired and whether the engine recovered
+from it; the bench artifact carries the full event list, and the chaos
+smoke exits nonzero when any event goes unfired or unrecovered.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+KINDS = (
+    "spool_corrupt",
+    "spool_truncate",
+    "device_loss",
+    "dup_batch",
+    "stall",
+    "queue_overflow",
+    "poison_rebuild",
+)
+
+
+@dataclass
+class FaultEvent:
+    kind: str
+    round: int  # earliest macro-round the event may fire
+    target: int | None = None  # doc id (or class) pin; None = pick live
+    param: int = 0  # stall ms / burst ops / dup depth (0 = default)
+    fired: bool = False
+    fired_round: int = -1
+    recovered: bool = False
+    detail: dict = field(default_factory=dict)
+
+    def fire(self, rnd: int, **detail) -> None:
+        self.fired = True
+        self.fired_round = rnd
+        self.detail.update(detail)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "round": self.round,
+            "fired": self.fired,
+            "fired_round": self.fired_round,
+            "recovered": self.recovered,
+            "target": self.target,
+            "detail": self.detail,
+        }
+
+
+class FaultPlan:
+    """A seeded, ordered fault schedule."""
+
+    def __init__(self, events: list[FaultEvent], seed: int = 0,
+                 stall_ms: int = 40, burst: int = 0, spec: str = ""):
+        self.events = sorted(events, key=lambda e: (e.round, e.kind))
+        self.seed = seed
+        self.stall_ms = stall_ms
+        self.burst = burst
+        self.spec = spec
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        seed, span, stall_ms, burst = 0, 8, 40, 0
+        counts: list[tuple[str, int | None, int]] = []  # (kind, round, n)
+        for tok in str(spec).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if "=" not in tok:
+                raise ValueError(f"fault spec token {tok!r}: expected k=v")
+            key, val = tok.split("=", 1)
+            key, val = key.strip(), int(val)
+            if key == "seed":
+                seed = val
+            elif key == "span":
+                span = max(2, val)
+            elif key == "stall_ms":
+                stall_ms = val
+            elif key == "burst":
+                burst = val
+            else:
+                rnd = None
+                if "@" in key:
+                    key, at = key.split("@", 1)
+                    rnd = int(at)
+                if key not in KINDS:
+                    raise ValueError(
+                        f"fault spec: unknown kind {key!r} "
+                        f"(expected one of {KINDS})"
+                    )
+                counts.append((key, rnd, val))
+        rng = np.random.default_rng(seed)
+        events = []
+        for kind, rnd, n in counts:
+            for _ in range(max(0, n)):
+                r = rnd if rnd is not None else int(rng.integers(2, span + 1))
+                events.append(FaultEvent(kind=kind, round=r))
+        return cls(events, seed=seed, stall_ms=stall_ms, burst=burst,
+                   spec=spec)
+
+    def summary(self) -> dict:
+        fired = [e for e in self.events if e.fired]
+        return {
+            "spec": self.spec,
+            "seed": self.seed,
+            "events": [e.to_dict() for e in self.events],
+            "injected": len(fired),
+            "recovered": sum(e.recovered for e in fired),
+            "unrecovered": sum(not e.recovered for e in fired),
+            "not_fired": sum(not e.fired for e in self.events),
+        }
+
+
+class FaultInjector:
+    """The runtime half: the scheduler polls these hooks at fixed points
+    of each macro-round; every pending event fires at the first poll at
+    or after its scheduled round where a valid target exists."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.rng = np.random.default_rng(plan.seed ^ 0x9E3779B9)
+
+    def _pending(self, rnd: int, *kinds: str) -> FaultEvent | None:
+        for e in self.plan.events:
+            if e.kind in kinds and not e.fired and rnd >= e.round:
+                return e
+        return None
+
+    # ---- hooks (each returns the event to fire, or None) ----
+
+    def stall_event(self, rnd: int) -> tuple[FaultEvent, float] | None:
+        e = self._pending(rnd, "stall")
+        if e is None:
+            return None
+        return e, (e.param or self.plan.stall_ms) / 1e3
+
+    def overflow_event(self, rnd: int) -> FaultEvent | None:
+        return self._pending(rnd, "queue_overflow")
+
+    def dup_event(self, rnd: int, doc_id: int,
+                  cursor: int) -> FaultEvent | None:
+        """A redelivered batch for ``doc_id``: only docs that already
+        applied ops are meaningful dup targets."""
+        if cursor <= 0:
+            return None
+        e = self._pending(rnd, "dup_batch")
+        if e is None or (e.target is not None and e.target != doc_id):
+            return None
+        return e
+
+    def device_loss_event(self, rnd: int, cls: int) -> FaultEvent | None:
+        e = self._pending(rnd, "device_loss")
+        if e is None or (e.target is not None and e.target != cls):
+            return None
+        return e
+
+    def spool_event(self, rnd: int) -> FaultEvent | None:
+        return self._pending(rnd, "spool_corrupt", "spool_truncate")
+
+    def poisoned(self, doc_id: int) -> bool:
+        """Fire-once: is this doc's REBUILD poisoned?  (Exercises the
+        quarantine path — recovery itself failing.)"""
+        for e in self.plan.events:
+            if e.kind == "poison_rebuild" and not e.fired and (
+                e.target is None or e.target == doc_id
+            ):
+                e.fire(-1, doc=doc_id)
+                e.recovered = False  # a poisoned rebuild ends in quarantine
+                return True
+        return False
+
+    # ---- corruption primitives ----
+
+    def corrupt_file(self, path: str, kind: str) -> dict:
+        """Damage an on-disk checkpoint: truncate to ~60% or flip a run
+        of bytes in the middle.  The damaged bytes land in a NEW file
+        swapped over ``path`` (never an in-place mutation): snapshot
+        barriers hard-link live spools on the immutability guarantee
+        that every spool write goes through ``os.replace``, and fault
+        injection must honor the same contract — the fault hits THIS
+        file, not a committed snapshot member sharing its inode.
+        Returns detail for the event record."""
+        data = bytearray(open(path, "rb").read())
+        size = len(data)
+        if kind == "spool_truncate" or size < 64:
+            keep = max(1, int(size * 0.6))
+            data = data[:keep]
+            detail = {"mode": "truncate", "bytes": size, "kept": keep}
+        else:
+            off = int(self.rng.integers(size // 4, max(size // 4 + 1,
+                                                       size - 16)))
+            for i in range(off, min(off + 8, size)):
+                data[i] ^= 0xFF
+            detail = {"mode": "bitflip", "bytes": size, "offset": off}
+        tmp = path + ".fault"
+        with open(tmp, "wb") as f:
+            f.write(bytes(data))
+        os.replace(tmp, path)
+        return detail
+
+    def pick(self, candidates: list[int]) -> int:
+        """Seeded target selection among live candidates."""
+        return int(candidates[int(self.rng.integers(len(candidates)))])

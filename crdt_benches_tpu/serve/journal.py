@@ -1,0 +1,600 @@
+"""Write-ahead op journal + fleet snapshot barriers + crash recovery.
+
+CRDTs exist to stay available under faults; this module makes the serve/
+fleet *provably* recoverable: after any crash, recovery restores the
+last consistent snapshot set and replays the journal tail through the
+existing macro-round path, and the oracle byte-verify confirms the
+result is exactly the converged state an uninterrupted run produces.
+
+Three persistent artifacts live under one journal directory:
+
+- **op journal** (``journal.log``): an append-only record stream.  Every
+  macro-round, the scheduler journals the per-class lane set — one
+  ``(doc, start_cursor, end_cursor)`` triple per scheduled document —
+  BEFORE dispatching the staged tensors (write-ahead).  Because every
+  doc's op stream is deterministic host data, a cursor interval IS the
+  op batch: replaying ``[start, end)`` of the stream reproduces the
+  exact device work.  Records are one line each, ``<crc32hex> <json>``;
+  a torn tail (crash mid-write) fails CRC/JSON and is dropped at read
+  time, never propagated.  Quarantine / load-shed decisions are also
+  journaled — they change what the converged state *is*, so recovery
+  must re-apply them.
+- **snapshot barriers** (``snap_<round>/``): every ``snapshot_every``
+  macro-rounds the scheduler pulls each bucket once (a sync barrier —
+  the same boundary discipline as row moves), writes one CRC-verified
+  ``.npz`` per capacity class plus copies of every live eviction spool,
+  and commits the set atomically by renaming the staging directory.
+  A snapshot bounds the journal tail a recovery must replay.
+- **recovery** (:func:`recover_fleet`): pick the newest loadable
+  snapshot (older ones are fallbacks; cold start from round 0 is the
+  last resort — streams are deterministic, so a fleet is recoverable
+  from nothing), restore residency/cursors/spools into a fresh pool,
+  re-apply journaled quarantine/shed decisions from the tail, and
+  report the redo span (``ops_replayed``).  Resumed serving then drives
+  the tail through the normal macro-round path.
+
+:func:`rebuild_doc` is the in-run repair primitive shared by the
+scheduler's fault handling (corrupt spool, device-state loss): rebuild
+one document's row at cursor ``target`` from a base state at cursor
+``start`` by replaying the stream interval through the same
+scan-of-slices dispatch shape the macro engine uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..traces.tensorize import PAD
+from ..utils.checkpoint import (
+    CorruptCheckpointError,
+    load_state,
+    save_state,
+)
+
+SNAP_PREFIX = "snap_"
+
+
+# ---------------------------------------------------------------------------
+# the op journal (append-only, CRC-framed JSON lines)
+# ---------------------------------------------------------------------------
+
+
+class OpJournal:
+    """Append-only write-ahead journal.  One record per line:
+    ``<crc32 of payload, 8 hex chars> <compact json payload>``.
+
+    ``fsync=True`` makes every record durable before the append returns
+    (the strict WAL discipline); the default leaves flushing to the OS —
+    a lost *suffix* is exactly what recovery tolerates, torn or not.
+
+    Reopening an existing log first truncates any torn tail: appending
+    new records BEHIND a damaged line would hide them from the next
+    recovery (readers stop at the first bad line)."""
+
+    def __init__(self, journal_dir: str, fsync: bool = False):
+        os.makedirs(journal_dir, exist_ok=True)
+        self.dir = journal_dir
+        self.path = os.path.join(journal_dir, "journal.log")
+        self.fsync = fsync
+        if os.path.exists(self.path):
+            good = _valid_prefix_bytes(self.path)
+            if good < os.path.getsize(self.path):
+                with open(self.path, "r+b") as f:
+                    f.truncate(good)
+        self._f = open(self.path, "a", encoding="utf-8")
+        self.records = 0
+        self.bytes_written = 0
+
+    def append(self, obj: dict) -> None:
+        payload = json.dumps(obj, separators=(",", ":"))
+        line = f"{zlib.crc32(payload.encode()):08x} {payload}\n"
+        self._f.write(line)
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self.records += 1
+        self.bytes_written += len(line)
+
+    def round_record(
+        self, rnd: int, lanes: dict[int, list[tuple[int, int, int]]]
+    ) -> None:
+        """The write-ahead record for one macro-round: per class, the
+        ``[doc, start_cursor, end_cursor]`` of every scheduled lane.
+        MUST be appended before the round's dispatch."""
+        self.append({
+            "t": "round",
+            "r": rnd,
+            "lanes": {str(c): spans for c, spans in lanes.items()},
+        })
+
+    def event(self, kind: str, **fields) -> None:
+        self.append({"t": kind, **fields})
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+def _valid_prefix_bytes(path: str) -> int:
+    """Byte length of the longest CRC-valid record prefix of a journal
+    file (everything from the first damaged line on is a torn tail)."""
+    good = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            try:
+                line = raw.decode("utf-8")
+                crc_hex, payload = line.rstrip("\n").split(" ", 1)
+                if int(crc_hex, 16) != zlib.crc32(payload.encode()):
+                    break
+                json.loads(payload)
+            except (ValueError, UnicodeDecodeError, json.JSONDecodeError):
+                break
+            good += len(raw)
+    return good
+
+
+def read_journal(journal_dir: str) -> tuple[list[dict], int]:
+    """All CRC-valid records, in order.  Reading stops at the first
+    damaged line (a crash can only tear the TAIL of an append-only
+    file); returns ``(records, dropped_lines)``."""
+    path = os.path.join(journal_dir, "journal.log")
+    records: list[dict] = []
+    dropped = 0
+    if not os.path.exists(path):
+        return records, dropped
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        try:
+            crc_hex, payload = line.rstrip("\n").split(" ", 1)
+            if int(crc_hex, 16) != zlib.crc32(payload.encode()):
+                raise ValueError("crc mismatch")
+            records.append(json.loads(payload))
+        except (ValueError, json.JSONDecodeError):
+            dropped = len(lines) - i
+            break
+    return records, dropped
+
+
+# ---------------------------------------------------------------------------
+# snapshot barriers
+# ---------------------------------------------------------------------------
+
+
+def write_snapshot(journal_dir: str, pool, streams, rnd: int,
+                   keep: int = 2) -> str:
+    """One fleet snapshot: per-class bucket states (CRC'd .npz), copies
+    of all live eviction spools, and a manifest of cursors/residency.
+    The set is staged in ``<dir>.tmp`` with the manifest written LAST,
+    then committed by a single directory rename — a crash mid-snapshot
+    leaves only an ignorable ``.tmp`` directory, never a half snapshot
+    that recovery could mistake for consistent."""
+    from .pool import PackedState  # local: avoid import cycle at module load
+
+    final = os.path.join(journal_dir, f"{SNAP_PREFIX}{rnd:08d}")
+    tmp = final + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+
+    resident: dict[str, list[int]] = {}
+    spooled: dict[str, str] = {}
+    for doc_id, rec in pool.docs.items():
+        if rec.cls is not None:
+            resident[str(doc_id)] = [int(rec.cls), int(rec.row)]
+        elif rec.spool is not None and os.path.exists(rec.spool):
+            fname = f"doc{doc_id}.npz"
+            dst = os.path.join(tmp, fname)
+            # spools are immutable once written (save_state lands them
+            # via os.replace, so a re-eviction swaps in a NEW inode):
+            # hard-link the snapshot member instead of copying — a
+            # thousands-of-cold-docs fleet barrier stays cheap
+            try:
+                os.link(rec.spool, dst)
+            except OSError:  # cross-device / unsupported fs
+                shutil.copy2(rec.spool, dst)
+            spooled[str(doc_id)] = fname
+
+    used_classes = sorted({int(v[0]) for v in resident.values()})
+    for cls in used_classes:
+        doc, length, nvis = pool.pull_bucket(cls)  # the sync barrier
+        save_state(
+            os.path.join(tmp, f"class_{cls}.npz"),
+            PackedState(doc=doc, length=length, nvis=nvis),
+            compress=False,
+        )
+
+    docs = {}
+    for doc_id, st in streams.items():
+        docs[str(doc_id)] = {
+            "c": int(st.cursor),
+            "lim": None if st.limit is None else int(st.limit),
+            "lossy": bool(st.lossy),
+        }
+    manifest = {
+        "round": int(rnd),
+        "classes": used_classes,
+        "resident": resident,
+        "spooled": spooled,
+        "docs": docs,
+    }
+    mtmp = os.path.join(tmp, "MANIFEST.tmp")
+    with open(mtmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, separators=(",", ":"))
+    os.replace(mtmp, os.path.join(tmp, "MANIFEST.json"))
+    os.rename(tmp, final)  # the commit point
+
+    for old in list_snapshots(journal_dir)[:-keep]:
+        shutil.rmtree(os.path.join(journal_dir, old), ignore_errors=True)
+    return final
+
+
+def list_snapshots(journal_dir: str) -> list[str]:
+    """Committed snapshot directory names, oldest first."""
+    if not os.path.isdir(journal_dir):
+        return []
+    return sorted(
+        d for d in os.listdir(journal_dir)
+        if d.startswith(SNAP_PREFIX) and not d.endswith(".tmp")
+        and os.path.isdir(os.path.join(journal_dir, d))
+    )
+
+
+def _read_manifest(snap_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(snap_dir, "MANIFEST.json"),
+                  encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class SnapshotBases:
+    """Lazy, cached access to per-doc base states across the retained
+    snapshots — the rebuild path's source of truth.  ``base(doc_id)``
+    walks snapshots newest-first and returns the first intact base:
+    ``(doc_row, length, nvis, cursor)`` with the row trimmed/padded to
+    the caller's target capacity by :func:`rebuild_doc`.  Returns None
+    when no snapshot holds the doc (fresh rebuild from cursor 0).
+
+    Manifests are cached per snapshot (a class-loss recovery calls
+    ``base`` once per resident doc); the per-class state cache can hold
+    whole bucket arrays, so callers ``release()`` it once a recovery
+    pass is done instead of pinning tens of MB for the run."""
+
+    def __init__(self, journal_dir: str | None):
+        self.dir = journal_dir
+        self._class_cache: dict[str, object] = {}
+        self._manifests: dict[str, dict | None] = {}
+
+    def release(self) -> None:
+        """Drop cached bucket states (and manifests — a new snapshot
+        may have pruned old directories)."""
+        self._class_cache.clear()
+        self._manifests.clear()
+
+    def _manifest(self, snap: str, sd: str) -> dict | None:
+        if snap not in self._manifests:
+            self._manifests[snap] = _read_manifest(sd)
+        return self._manifests[snap]
+
+    def base(self, doc_id: int):
+        if self.dir is None:
+            return None
+        for snap in reversed(list_snapshots(self.dir)):
+            sd = os.path.join(self.dir, snap)
+            m = self._manifest(snap, sd)
+            if m is None:
+                continue
+            key = str(doc_id)
+            try:
+                if key in m.get("resident", {}):
+                    cls, row = m["resident"][key]
+                    ck = f"{snap}/class_{cls}"
+                    if ck not in self._class_cache:
+                        self._class_cache[ck] = load_state(
+                            os.path.join(sd, f"class_{cls}.npz")
+                        )
+                    st = self._class_cache[ck]
+                    return (
+                        np.array(st.doc[row]),
+                        int(st.length[row]),
+                        int(st.nvis[row]),
+                        int(m["docs"][key]["c"]),
+                    )
+                if key in m.get("spooled", {}):
+                    st = load_state(
+                        os.path.join(sd, m["spooled"][key])
+                    )
+                    return (
+                        np.array(st.doc[0]),
+                        int(st.length[0]),
+                        int(st.nvis[0]),
+                        int(m["docs"][key]["c"]),
+                    )
+            except CorruptCheckpointError:
+                continue  # damaged snapshot member: fall back to older
+        return None
+
+
+# ---------------------------------------------------------------------------
+# targeted rebuild: replay a stream interval through the macro scan path
+# ---------------------------------------------------------------------------
+
+_REPLAYERS: dict[tuple, object] = {}
+
+
+def _replayer(C: int, B: int, K: int, nbits: int):
+    """The jitted single-row macro replayer for one (capacity, batch,
+    depth, nbits) shape: a ``lax.scan`` over K slices of (1, B) range
+    ops — the same resolve/apply body as ``DocPool.macro_step``, on a
+    one-row stack.  Cached per shape (the recovery path's compile cost
+    is paid once)."""
+    key = (C, B, K, nbits)
+    if key not in _REPLAYERS:
+        import jax
+
+        from ..ops.apply_range import apply_range_batch
+        from ..ops.resolve_range_scan import resolve_ranges_rows
+
+        def body(st, sl):
+            k, p, ln, s0 = sl
+            tokens, dints, _ = resolve_ranges_rows(k, p, ln, s0, st.nvis)
+            return apply_range_batch(st, tokens, dints, nbits=nbits), None
+
+        def fn(state, kind, pos, rlen, slot0):
+            out, _ = jax.lax.scan(body, state, (kind, pos, rlen, slot0))
+            return out
+
+        _REPLAYERS[key] = jax.jit(fn, donate_argnums=(0,))
+    return _REPLAYERS[key]
+
+
+def _pad_row(row: np.ndarray, C: int) -> np.ndarray:
+    """Pad/keep a doc row to capacity ``C`` with the beyond-length
+    coding ``2`` (trimmed spools and smaller-class bases)."""
+    row = np.asarray(row, np.int32)
+    if len(row) >= C:
+        return row[:C]
+    return np.concatenate([row, np.full(C - len(row), 2, np.int32)])
+
+
+def rebuild_doc(
+    stream,
+    C: int,
+    base,  # (doc_row, length, nvis, base_cursor) or None for fresh
+    target: int,
+    *,
+    n_init: int,
+    batch: int,
+    batch_chars: int,
+    nbits: int,
+    macro_k: int = 1,
+) -> tuple[np.ndarray, int, int, int]:
+    """Rebuild one document's row state at cursor ``target`` by
+    replaying stream ops ``[base_cursor, target)`` over the base state,
+    through the macro scan dispatch shape.  Returns
+    ``(doc_row[C], length, nvis, dispatches)`` — ``dispatches`` is the
+    macro-round-equivalent count (the MTTR unit).
+
+    Ops at indices below the base cursor are never re-applied — the
+    cursor IS the idempotence high-water mark, the same dedup rule the
+    scheduler uses for redelivered batches."""
+    import jax.numpy as jnp
+
+    from .pool import PackedState, _fresh_row_np
+
+    if base is None:
+        doc_row, length, nvis, c = _fresh_row_np(C, n_init), n_init, n_init, 0
+    else:
+        doc_row, length, nvis, c = base
+        doc_row = _pad_row(doc_row, C)
+    c = max(0, min(int(c), target))
+    state = PackedState(
+        doc=jnp.asarray(doc_row[None]),
+        length=jnp.asarray([length], jnp.int32),
+        nvis=jnp.asarray([nvis], jnp.int32),
+    )
+    K = max(1, macro_k)
+    dispatches = 0
+    while c < target:
+        kind = np.full((K, 1, batch), PAD, np.int32)
+        pos = np.zeros((K, 1, batch), np.int32)
+        rlen = np.zeros((K, 1, batch), np.int32)
+        slot0 = np.full((K, 1, batch), -1, np.int32)
+        for k in range(K):
+            if c >= target:
+                break  # trailing slices stay PAD (no-ops)
+            # the scheduler's slice-budget rule, verbatim (DocStream)
+            e = stream.slice_end(c, batch, batch_chars, target)
+            take = e - c
+            kind[k, 0, :take] = stream.kind[c:e]
+            pos[k, 0, :take] = stream.pos[c:e]
+            rlen[k, 0, :take] = stream.rlen[c:e]
+            slot0[k, 0, :take] = stream.slot0[c:e]
+            c = e
+        state = _replayer(C, batch, K, nbits)(
+            state,
+            jnp.asarray(kind), jnp.asarray(pos),
+            jnp.asarray(rlen), jnp.asarray(slot0),
+        )
+        dispatches += 1
+    return (
+        np.asarray(state.doc[0]),
+        int(np.asarray(state.length[0])),
+        int(np.asarray(state.nvis[0])),
+        dispatches,
+    )
+
+
+# ---------------------------------------------------------------------------
+# crash recovery
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RecoveryReport:
+    """What a :func:`recover_fleet` run found and did."""
+
+    snapshot_round: int = -1  # -1 = cold start (no usable snapshot)
+    snapshot_dir: str | None = None
+    resume_round: int = 0
+    docs_restored: int = 0  # residency/cursor restored from the snapshot
+    spools_restored: int = 0
+    ops_replayed: int = 0  # journal-tail redo span (snap cursor -> WAL tip)
+    torn_records: int = 0  # damaged journal tail lines dropped
+    quarantined: list[int] = field(default_factory=list)
+    shed_ops: int = 0
+    records: int = 0
+
+
+def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:
+    """Restore a crashed fleet into a FRESH pool + stream set (built by
+    the same ``prepare_streams`` the original run used): load the newest
+    intact snapshot, re-apply journaled quarantine/shed decisions from
+    the tail, and leave cursors at the snapshot barrier so resumed
+    serving replays the journal tail through the normal macro-round
+    path.  Falls back to older snapshots on damage, and to a cold start
+    (round 0) when none is usable — per-doc streams are deterministic,
+    so the fleet is recoverable from nothing but the workload."""
+    report = RecoveryReport()
+    records, dropped = read_journal(journal_dir)
+    report.torn_records = dropped
+    report.records = len(records)
+
+    # ---- newest intact snapshot ----
+    manifest = None
+    for snap in reversed(list_snapshots(journal_dir)):
+        sd = os.path.join(journal_dir, snap)
+        m = _read_manifest(sd)
+        if m is None:
+            continue
+        try:
+            _restore_snapshot(pool, streams, sd, m)
+        except CorruptCheckpointError:
+            _reset_fleet(pool, streams)
+            continue
+        manifest = m
+        report.snapshot_dir = sd
+        report.snapshot_round = int(m["round"])
+        report.docs_restored = len(m["resident"])
+        report.spools_restored = len(m["spooled"])
+        break
+
+    # ---- journal tail: redo span + re-applied decisions ----
+    snap_round = report.snapshot_round
+    high: dict[int, int] = {}
+    max_r = snap_round
+    for rec in records:
+        r = int(rec.get("r", -1))
+        if rec["t"] == "round":
+            max_r = max(max_r, r)
+            # the barrier round value is the clock AFTER the last
+            # snapshotted round advanced, so a record with r == the
+            # snapshot round was journaled after the barrier: redo it
+            if r < snap_round:
+                continue  # already durable in the snapshot
+            for spans in rec["lanes"].values():
+                for doc, _start, end in spans:
+                    high[int(doc)] = max(high.get(int(doc), 0), int(end))
+        elif rec["t"] in ("quarantine", "shed") and r >= snap_round:
+            doc = int(rec["doc"])
+            st = streams.get(doc)
+            if st is None:
+                continue
+            lim = int(rec["at"])
+            st.limit = lim if st.limit is None else min(st.limit, lim)
+            st.lossy = True
+            report.shed_ops += int(rec.get("ops", 0))
+            if rec["t"] == "quarantine":
+                report.quarantined.append(doc)
+    for doc, hw in high.items():
+        st = streams.get(doc)
+        if st is None:
+            continue
+        report.ops_replayed += max(
+            0, min(hw, st.n_total) - st.cursor
+        )
+    report.resume_round = max(0, max_r + 1)
+    return report
+
+
+def _reset_fleet(pool, streams) -> None:
+    """Undo a partially applied snapshot restore (damage discovered
+    mid-restore): drop all residency/cursor state back to cold."""
+    for rec in pool.docs.values():
+        if rec.cls is not None:
+            b = pool.buckets[rec.cls]
+            b.rows[rec.row] = None
+            b.release_row(rec.row)
+        rec.cls = rec.row = None
+        rec.spool = None
+        rec.length = rec.n_init
+        rec.last_sched = -1
+    for st in streams.values():
+        st.cursor = 0
+        st.limit = None
+        st.lossy = False
+        if st.delivered is not None:
+            st.delivered = 0
+
+
+def _restore_snapshot(pool, streams, snap_dir: str, manifest: dict) -> None:
+    """Apply one snapshot to a fresh pool/streams.  Raises
+    CorruptCheckpointError on any damaged member (caller falls back)."""
+    # per-class bucket states first (so damage aborts before bookkeeping)
+    states = {
+        cls: load_state(os.path.join(snap_dir, f"class_{cls}.npz"))
+        for cls in manifest["classes"]
+    }
+    by_class: dict[int, list[tuple[int, int]]] = {}
+    for key, (cls, row) in manifest["resident"].items():
+        by_class.setdefault(int(cls), []).append((int(key), int(row)))
+    for cls, docs in by_class.items():
+        b = pool.buckets[cls]
+        st = states[cls]
+        doc_w = np.full((b.R, b.C), 2, np.int32)
+        len_w = np.zeros(b.R, np.int32)
+        nvis_w = np.zeros(b.R, np.int32)
+        for doc_id, row in docs:
+            doc_w[row] = np.asarray(st.doc[row], np.int32)
+            len_w[row] = int(st.length[row])
+            nvis_w[row] = int(st.nvis[row])
+            b.rows[row] = doc_id
+            b.take_row(row)
+            rec = pool.docs[doc_id]
+            rec.cls, rec.row = cls, row
+        pool.upload_bucket(cls, doc_w, len_w, nvis_w)
+    # spool members: damage here degrades ONE doc to a cold restart
+    # (deterministic streams make a from-scratch replay byte-exact), it
+    # does not void the rest of the snapshot
+    damaged: set[int] = set()
+    for key, fname in manifest["spooled"].items():
+        doc_id = int(key)
+        src = os.path.join(snap_dir, fname)
+        try:
+            load_state(src)  # verify BEFORE adopting
+        except CorruptCheckpointError:
+            damaged.add(doc_id)
+            continue
+        rec = pool.docs[doc_id]
+        rec.spool = pool._spool_path(doc_id)
+        shutil.copy2(src, rec.spool)
+    for key, d in manifest["docs"].items():
+        doc_id = int(key)
+        st = streams.get(doc_id)
+        if st is None:
+            continue
+        st.cursor = 0 if doc_id in damaged else int(d["c"])
+        st.limit = d["lim"]
+        st.lossy = bool(d["lossy"])
+        if st.delivered is not None:
+            st.delivered = st.cursor
+        rec = pool.docs[doc_id]
+        rec.length = rec.n_init + st.ins_before(st.cursor)
+        rec.last_sched = int(manifest["round"])

@@ -53,7 +53,11 @@ from ..ops.apply2 import LANE, PackedState, apply_batch3
 from ..ops.apply_range import apply_range_batch
 from ..ops.resolve import resolve_batch
 from ..ops.resolve_range_scan import resolve_ranges_rows
-from ..utils.checkpoint import load_state, save_state
+from ..utils.checkpoint import (
+    CorruptCheckpointError,
+    load_state,
+    save_state,
+)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -387,7 +391,14 @@ class DocPool:
                 int(st.length[0]), int(st.nvis[0]),
             )
         if rec.spool is not None:
-            st = load_state(rec.spool)
+            try:
+                st = load_state(rec.spool)
+            except CorruptCheckpointError as e:
+                # surface WHICH doc is stuck; the scheduler's heal path
+                # (serve/scheduler.py _heal_spool) repairs or quarantines
+                raise CorruptCheckpointError(
+                    f"doc {doc_id}: eviction spool damaged: {e}"
+                ) from e
             os.unlink(rec.spool)  # rehydrated: keep the spool bounded
             rec.spool = None
             self.restores += 1
@@ -528,7 +539,10 @@ class DocPool:
     # ---- decode / verify (off the hot path) ----
 
     def decode(self, doc_id: int) -> str:
-        """The doc's visible content, whether resident or spooled."""
+        """The doc's visible content, whether resident or spooled.
+        Raises ``CorruptCheckpointError`` when the doc is cold and its
+        spool is damaged (a chaos drain heals such spools before it
+        finishes — see scheduler ``finalize_faults``)."""
         rec = self.docs[doc_id]
         if rec.cls is not None:
             st = self._pull_row(rec)

@@ -41,6 +41,31 @@ Policy (deterministic, host-only — no device syncs on the decision path):
 - **arrival**: each doc becomes active at its session's arrival round
   (the workload's arrival staggering), modeling sessions joining a live
   server rather than a cold batch job.
+
+Fault tolerance (serve/journal.py + serve/faults.py wire in here):
+
+- **bounded queues + backpressure**: with ``queue_cap > 0`` a doc's
+  pending window is capped; delivery past the cap is an explicit
+  decision — **defer** (producer backpressure, nothing lost) or
+  **shed** (tail-drop the session's remaining ops; the doc is marked
+  lossy, excluded from byte-verify, and the loss is surfaced as
+  ``shed_ops``).  Silent overflow cannot happen;
+- **write-ahead journal**: each macro-round's lane set is journaled
+  BEFORE dispatch; snapshot barriers every ``snapshot_every`` rounds
+  bound the redo tail (crash recovery = ``journal.recover_fleet``);
+- **in-run repair**: a spool that fails its CRC on restore is rebuilt
+  from the last snapshot base + stream replay (``journal.rebuild_doc``)
+  through the same scan path; a class whose device state is lost
+  mid-macro-round is rebuilt the same way, one row per resident.  A doc
+  whose rebuild ALSO fails is **quarantined** — its remaining ops shed,
+  its row freed — and the fleet keeps serving;
+- **graceful degradation**: after ``degrade_after`` faults inside
+  ``degrade_window`` rounds, the scheduler falls back from macro-K to
+  K=1 synchronous rounds for ``degrade_rounds`` rounds (fence per
+  round), then restores K automatically;
+- **idempotent admission**: the per-doc cursor is the delivery
+  high-water mark — a duplicated or stale-reordered batch is clamped
+  against it and dropped (``dup_ops_dropped``), never re-applied.
 """
 
 from __future__ import annotations
@@ -59,7 +84,8 @@ from ..traces.tensorize import (
     tensorize_ranges,
 )
 from .pool import DocPool, _fresh_row_np
-from ..utils.checkpoint import load_state
+from ..utils.checkpoint import CorruptCheckpointError, load_state
+from .journal import SnapshotBases, rebuild_doc, write_snapshot
 
 
 @dataclass
@@ -70,7 +96,12 @@ class DocStream:
     same/backspace delete runs merged at stream build
     (``coalesce_patches``), then insert runs re-split to at most
     ``batch_chars`` chars (``split_insert_runs``) so any single op fits a
-    slice's insert budget."""
+    slice's insert budget.
+
+    Queue-bounding state: ``delivered`` (None = unbounded) is how far
+    the producer has pushed ops into the bounded pending window;
+    ``limit`` truncates the stream (quarantine / load-shed tail-drop)
+    and ``lossy`` marks docs excluded from byte-verification."""
 
     doc_id: int
     kind: np.ndarray  # int32[N] range ops (unpadded)
@@ -82,10 +113,29 @@ class DocStream:
     n_patches: int
     arrival: int = 0
     cursor: int = 0
+    delivered: int | None = None  # bounded-queue fill point (None = all)
+    limit: int | None = None  # stream truncation (shed / quarantine)
+    lossy: bool = False  # ops were shed: excluded from byte-verify
+    burst: int | None = None  # producer delivery rate (ops/round)
+    deferred_high: int = 0  # highest op index ever backpressured
+
+    @property
+    def n_total(self) -> int:
+        """Stream length after any shed truncation."""
+        n = len(self.kind)
+        return n if self.limit is None else min(self.limit, n)
 
     @property
     def remaining(self) -> int:
-        return len(self.kind) - self.cursor
+        return self.n_total - self.cursor
+
+    @property
+    def n_sched(self) -> int:
+        """Ops visible to the scheduler: everything up to the bounded
+        queue's fill point (the whole stream when unbounded)."""
+        if self.delivered is None:
+            return self.n_total
+        return min(self.n_total, self.delivered)
 
     def ins_before(self, i: int) -> int:
         """Inserted chars in ops [0, i)."""
@@ -93,6 +143,27 @@ class DocStream:
 
     def units_before(self, i: int) -> int:
         return int(self.unit_cum[i - 1]) if i > 0 else 0
+
+    def slice_end(self, c: int, batch: int, batch_chars: int,
+                  n: int) -> int:
+        """End cursor of ONE device slice starting at ``c`` (bounded by
+        ``n``): up to ``batch`` range ops and ``batch_chars`` inserted
+        chars.  Ops are pre-split, so at least one always fits.  THE
+        slice-budget rule — the scheduler's staging (``_sim_takes``) and
+        the recovery replayer (``journal.rebuild_doc``) must size slices
+        identically, so both call here."""
+        hi = min(c + batch, n)
+        cap = self.ins_before(c) + batch_chars
+        e = c + int(np.searchsorted(self.ins_cum[c:hi], cap, side="right"))
+        return max(e, c + 1)
+
+    def clamp_redelivery(self, start: int, end: int) -> int:
+        """Admit a (re)delivered batch ``[start, end)``: ops below the
+        applied cursor are duplicates (or stale reorders) and are
+        dropped — the cursor is the idempotence high-water mark.
+        Returns the dropped-op count; the live stream always continues
+        from ``cursor``, so nothing is ever applied twice."""
+        return max(0, min(end, self.cursor) - max(0, start))
 
 
 def prepare_streams(sessions, pool: DocPool, batch: int = 64,
@@ -129,6 +200,7 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
             ins_cum=ins_cum, unit_cum=unit_cum,
             n_patches=rt.n_patches,
             arrival=getattr(s, "arrival", 0),
+            burst=getattr(s, "burst", None),
         )
     return streams
 
@@ -139,6 +211,7 @@ class ServeStats:
 
     round_latencies: list[float] = field(default_factory=list)
     compile_flags: list[bool] = field(default_factory=list)  # per round
+    barrier_flags: list[bool] = field(default_factory=list)  # snapshot rounds
     occupancy: list[float] = field(default_factory=list)  # per round
     queue_depth: list[int] = field(default_factory=list)  # per round
     rounds: int = 0  # macro-rounds dispatched
@@ -152,6 +225,23 @@ class ServeStats:
     promotions: int = 0
     admissions: int = 0
     wall_time: float = 0.0
+    # ---- fault tolerance / graceful degradation ----
+    shed_ops: int = 0  # ops dropped by an explicit load-shed decision
+    deferred_ops: int = 0  # ops backpressured at the bounded queue cap
+    overflow_events: int = 0
+    backpressure_rounds: int = 0
+    dup_ops_dropped: int = 0  # duplicated/stale redeliveries clamped
+    stall_rounds: int = 0
+    quarantines: list[dict] = field(default_factory=list)
+    recoveries: int = 0  # successful in-run repairs (spool / device loss)
+    ops_replayed: int = 0  # redo span re-applied by repairs
+    replay_dispatches: int = 0
+    mttr_rounds: list[int] = field(default_factory=list)  # per recovery
+    degraded_rounds: int = 0  # macro-rounds served in the K=1 fallback
+    faults_seen: int = 0  # faults the engine observed (incl. organic)
+    faults_injected: int = 0  # events the injector fired
+    snapshots: int = 0
+    snapshot_time: float = 0.0
 
     @property
     def coalesce_ratio(self) -> float:
@@ -167,7 +257,8 @@ class ServeStats:
         return 1.0 - self.ops / self.staged_cells
 
     # NOTE: compile-time / steady-latency derivation lives in ONE place,
-    # bench/harness.py steady_quantiles (compile_flags feed it).
+    # bench/harness.py steady_quantiles (compile_flags feed it;
+    # barrier_flags mark snapshot rounds, excluded the same way).
 
 
 @dataclass
@@ -202,22 +293,111 @@ def _pow2ceil(x: int) -> int:
 class FleetScheduler:
     def __init__(self, pool: DocPool, streams: dict[int, DocStream],
                  batch: int = 64, macro_k: int = 1,
-                 batch_chars: int = 256):
+                 batch_chars: int = 256,
+                 queue_cap: int = 0, overflow_policy: str = "defer",
+                 faults=None, journal=None,
+                 snapshot_every: int = 0, snapshot_keep: int = 2,
+                 degrade_after: int = 3, degrade_window: int = 8,
+                 degrade_rounds: int = 4,
+                 start_round: int = 0):
+        if overflow_policy not in ("defer", "shed"):
+            raise ValueError(f"unknown overflow policy {overflow_policy!r}")
         self.pool = pool
         self.streams = streams
         self.batch = batch
         self.macro_k = max(1, macro_k)
         self.batch_chars = batch_chars
         self.nbits = max(1, int(batch_chars).bit_length())
-        self.round = 0
+        self.round = start_round
+        self.queue_cap = max(0, queue_cap)
+        self.overflow_policy = overflow_policy
+        self.faults = faults  # serve/faults.py FaultInjector (or None)
+        self.journal = journal  # serve/journal.py OpJournal (or None)
+        self.snapshot_every = snapshot_every
+        self.snapshot_keep = snapshot_keep
+        self.degrade_after = degrade_after
+        self.degrade_window = degrade_window
+        self.degrade_rounds = degrade_rounds
+        self._bases = SnapshotBases(journal.dir if journal else None)
+        self._fault_rounds: deque[int] = deque()
+        self._degrade_left = 0  # K=1 fallback rounds still to serve
+        self._planned_degraded = False  # THIS round planned under K=1
+        self._k_round = self.macro_k  # per-plan frozen macro depth
+        self._dead_lanes: set[int] = set()  # quarantined mid-round
+        self._bp_round = False
+        self._snapped = False
+        self._n_rounds = 0
         # FIFO of doc ids not yet arrived or with pending ops, in
         # arrival order (stable for determinism).
         self._rr = deque(sorted(
             streams, key=lambda d: (streams[d].arrival, d)
         ))
+        if self.queue_cap > 0:
+            for st in streams.values():
+                if st.delivered is None:
+                    st.delivered = st.cursor
         self.stats = ServeStats(
             patches=sum(s.n_patches for s in streams.values())
         )
+
+    # ---- degradation (automatic macro-K -> K=1 fallback) ----
+
+    @property
+    def effective_k(self) -> int:
+        """Macro depth for the NEXT planned round: 1 while degraded."""
+        return 1 if self._degrade_left > 0 else self.macro_k
+
+    def _note_fault(self) -> None:
+        """Track fault density; repeated faults inside the window trip
+        (or extend) the K=1 synchronous fallback for ``degrade_rounds``
+        dispatched rounds, starting with the next planned round."""
+        self.stats.faults_seen += 1
+        self._fault_rounds.append(self.round)
+        while (self._fault_rounds
+               and self._fault_rounds[0] < self.round - self.degrade_window):
+            self._fault_rounds.popleft()
+        if (self.macro_k > 1 and self.degrade_after > 0
+                and len(self._fault_rounds) >= self.degrade_after
+                and self._degrade_left < self.degrade_rounds):
+            self._degrade_left = self.degrade_rounds
+            if self.journal:
+                self.journal.event(
+                    "degrade", r=self.round, rounds=self.degrade_rounds
+                )
+
+    # ---- bounded-queue delivery (backpressure is explicit) ----
+
+    def _push_delivery(self, st: DocStream, want: int) -> int:
+        """THE bounded-queue admission rule: clamp a producer push at
+        ``queue_cap`` pending ops, counting each refused op ONCE (the
+        ``deferred_high`` high-water mark) as ``deferred_ops``.  Both
+        the per-round delivery and the overflow-burst fault go through
+        here — one copy of the invariant.  Returns the deferred
+        excess."""
+        lim = st.cursor + self.queue_cap
+        excess = max(0, want - lim)
+        if excess:
+            first_new = max(lim, st.deferred_high)
+            newly = max(0, want - first_new)
+            if newly:
+                self.stats.deferred_ops += newly
+                st.deferred_high = max(st.deferred_high, want)
+            self._bp_round = True
+        st.delivered = max(st.delivered, min(want, lim))
+        return excess
+
+    def _deliver(self, st: DocStream) -> None:
+        """Advance the producer's delivery point into the bounded
+        pending window.  Delivery past ``queue_cap`` pending ops is
+        refused — the producer holds the excess (counted as
+        ``deferred_ops`` the first time each op is pushed back)."""
+        if st.delivered is None:
+            return
+        n = st.n_total
+        want = n if st.burst is None else min(
+            n, max(st.delivered, st.cursor) + st.burst
+        )
+        self._push_delivery(st, want)
 
     # ---- planning (host-only; no device syncs) ----
 
@@ -228,16 +408,11 @@ class FleetScheduler:
         fits).  Returns (takes, end_cursor)."""
         takes: list[int] = []
         c = st.cursor
-        N = len(st.kind)
-        for _ in range(self.macro_k):
+        N = st.n_sched
+        for _ in range(self._k_round):
             if c >= N:
                 break
-            hi = min(c + self.batch, N)
-            cap = st.ins_before(c) + self.batch_chars
-            e = c + int(
-                np.searchsorted(st.ins_cum[c:hi], cap, side="right")
-            )
-            e = max(e, c + 1)
+            e = st.slice_end(c, self.batch, self.batch_chars, N)
             takes.append(e - c)
             c = e
         return takes, c
@@ -250,11 +425,30 @@ class FleetScheduler:
         while self._rr:
             doc_id = self._rr.popleft()
             st = self.streams[doc_id]
+            self._deliver(st)
             if st.remaining == 0:
-                continue  # drained: drop from the rotation for good
+                continue  # drained/shed: drop from the rotation for good
             if st.arrival > self.round:
                 deferred.append(doc_id)
                 continue
+            if st.n_sched <= st.cursor:
+                # bounded queue empty under backpressure: wait a round
+                plan.waiting += 1
+                deferred.append(doc_id)
+                continue
+            if self.faults is not None:
+                dup = self.faults.dup_event(self.round, doc_id, st.cursor)
+                if dup is not None:
+                    depth = dup.param or min(st.cursor, self.batch)
+                    dropped = st.clamp_redelivery(
+                        st.cursor - depth, st.cursor
+                    )
+                    self.stats.dup_ops_dropped += dropped
+                    self.stats.faults_injected += 1
+                    dup.fire(self.round, doc=doc_id, depth=depth,
+                             dropped=dropped)
+                    dup.recovered = True  # clamped, nothing re-applied
+                    self._note_fault()
             takes, end = self._sim_takes(st)
             rec = self.pool.docs[doc_id]
             need = rec.n_init + st.ins_before(end)
@@ -350,7 +544,8 @@ class FleetScheduler:
             # clamp keeps a non-pow2 --serve-macro from dispatching
             # guaranteed-all-PAD tail slices.
             k_eff = min(
-                _pow2ceil(max(len(l.takes) for l in lanes)), self.macro_k
+                _pow2ceil(max(len(l.takes) for l in lanes)),
+                self._k_round,
             )
             resident_locals = [
                 (lane, divmod(lane.row, b.Rg)) for lane in lanes
@@ -423,8 +618,14 @@ class FleetScheduler:
 
     def _plan(self) -> _Plan | None:
         """One macro-round's full host plan, or None when drained.
-        Advances the round clock over arrival-wait gaps."""
+        Advances the round clock over arrival-wait gaps.  The macro
+        depth is FROZEN per plan (``_k_round``): a fault that trips
+        degradation mid-selection (e.g. a dup event inside ``_select``)
+        must not shrink K under lanes already sized for the old depth —
+        the fallback takes effect from the next plan."""
         while True:
+            self._k_round = self.effective_k
+            self._planned_degraded = self._degrade_left > 0
             plan = _Plan(base_round=self.round)
             self._select(plan)
             if plan.lanes:
@@ -465,12 +666,281 @@ class FleetScheduler:
             tensors[cls] = (kind, pos, rlen, slot0)
         return tensors
 
+    # ---- fault firing + repair (serve/faults.py + serve/journal.py) ----
+
+    def _maybe_stall(self, rnd: int) -> None:
+        """Host staging stall fault: sleep the staging path."""
+        hit = self.faults.stall_event(rnd)
+        if hit is None:
+            return
+        ev, secs = hit
+        time.sleep(secs)
+        ev.fire(rnd, ms=secs * 1e3)
+        ev.recovered = True  # a stall is absorbed, not repaired
+        self.stats.stall_rounds += 1
+        self.stats.faults_injected += 1
+        self._note_fault()
+
+    def _fire_overflow(self) -> None:
+        """Queue-overflow fault: the producer bursts past the bounded
+        cap and the scheduler makes the explicit shed/defer decision."""
+        if self.queue_cap <= 0:
+            return
+        ev = self.faults.overflow_event(self.round)
+        if ev is None:
+            return
+        cands = sorted(
+            d for d, s in self.streams.items()
+            if s.remaining > 0 and s.delivered is not None
+        )
+        if not cands:
+            return  # stays pending; retried next round
+        deep = [d for d in cands
+                if self.streams[d].remaining > self.queue_cap]
+        doc = self.faults.pick(deep or cands)
+        st = self.streams[doc]
+        burst = ev.param or self.faults.plan.burst or 4 * self.queue_cap
+        lim = st.cursor + self.queue_cap
+        want = min(st.n_total, lim + burst)
+        self.stats.overflow_events += 1
+        self.stats.faults_injected += 1
+        self._note_fault()
+        shed = 0
+        if self.overflow_policy == "shed":
+            # load-shed: tail-drop the session's remaining ops past the
+            # cap — explicit, surfaced loss (the doc becomes lossy)
+            keep = min(st.n_total, lim)
+            shed = st.n_total - keep
+            if shed:
+                st.limit = keep
+                st.lossy = True
+                self.stats.shed_ops += shed
+                if self.journal:
+                    self.journal.event(
+                        "shed", r=self.round, doc=doc, at=keep, ops=shed
+                    )
+        else:
+            # defer: the bounded queue refuses the burst; the producer
+            # holds the excess and redelivers under backpressure
+            shed = 0
+            ev.detail["deferred"] = self._push_delivery(st, want)
+        ev.fire(self.round, doc=doc, burst=burst,
+                policy=self.overflow_policy, shed=shed)
+        ev.recovered = True  # the decision IS the recovery
+
+    def _all_residents(self) -> list[tuple[int, int]]:
+        return [
+            (d, row) for cls in self.pool.classes
+            for d, row in self.pool.residents(cls)
+        ]
+
+    def _fire_spool_fault(self, plan: _Plan) -> None:
+        """Corrupt/truncate an eviction spool on disk.  Prefers an
+        existing spool of a doc with pending ops (its restore — and so
+        the detection — is guaranteed); with none live, tears a spool as
+        it is written by evicting a non-scheduled pending resident."""
+        ev = self.faults.spool_event(self.round)
+        if ev is None:
+            return
+        pool = self.pool
+        cands = sorted(
+            d for d, rec in pool.docs.items()
+            if rec.spool is not None and os.path.exists(rec.spool)
+            and self.streams[d].remaining > 0
+        )
+        if not cands:
+            scheduled = {
+                l.stream.doc_id
+                for lanes in plan.lanes.values() for l in lanes
+            }
+            evictable = sorted(
+                d for d, _row in self._all_residents()
+                if d not in scheduled and self.streams[d].remaining > 0
+            )
+            if not evictable:
+                return  # stays pending; retried next round
+            victim = self.faults.pick(evictable)
+            pool.evict(victim)  # a boundary sync, like any eviction
+            cands = [victim]
+        doc = self.faults.pick(cands)
+        detail = self.faults.corrupt_file(pool.docs[doc].spool, ev.kind)
+        ev.fire(self.round, doc=doc, **detail)
+        self.stats.faults_injected += 1
+
+    def _quarantine(self, doc_id: int, reason: str) -> None:
+        """Isolate a document that cannot be repaired: shed its
+        remaining ops, free its row, and keep the fleet serving.  The
+        doc is marked lossy (excluded from byte-verification) and the
+        decision is journaled — recovery must re-apply it."""
+        st = self.streams[doc_id]
+        rec = self.pool.docs[doc_id]
+        shed = max(0, st.remaining)
+        st.limit = st.cursor
+        st.lossy = True
+        self.stats.shed_ops += shed
+        if rec.cls is not None:
+            b = self.pool.buckets[rec.cls]
+            b.rows[rec.row] = None
+            b.release_row(rec.row)
+            rec.cls = rec.row = None
+        rec.spool = None
+        self._dead_lanes.add(doc_id)
+        self.stats.quarantines.append({
+            "doc": doc_id, "round": self.round, "reason": reason,
+            "shed_ops": shed,
+        })
+        if self.journal:
+            self.journal.event(
+                "quarantine", r=self.round, doc=doc_id, at=st.cursor,
+                ops=shed, reason=reason[:120],
+            )
+
+    def _heal_spool(self, doc_id: int, cls: int, err: str):
+        """A spool failed its integrity check on restore: rebuild the
+        doc's row at its applied cursor from the last snapshot base (or
+        from scratch — streams are deterministic) through the macro
+        replay path.  Returns ``(doc_row, length, nvis)`` or None after
+        quarantining an unrepairable doc."""
+        st = self.streams[doc_id]
+        rec = self.pool.docs[doc_id]
+        self._note_fault()
+        ev = None
+        if self.faults is not None:
+            for e in self.faults.plan.events:
+                if (e.kind in ("spool_corrupt", "spool_truncate")
+                        and e.fired and not e.recovered
+                        and e.detail.get("doc") == doc_id):
+                    ev = e
+                    break
+        try:
+            if self.faults is not None and self.faults.poisoned(doc_id):
+                raise RuntimeError("rebuild poisoned by fault plan")
+            base = self._bases.base(doc_id)
+            row_v, L, nv, disp = rebuild_doc(
+                st, cls, base, st.cursor, n_init=rec.n_init,
+                batch=self.batch, batch_chars=self.batch_chars,
+                nbits=self.nbits, macro_k=self.effective_k,
+            )
+            start = min(base[3], st.cursor) if base is not None else 0
+            self.stats.recoveries += 1
+            self.stats.ops_replayed += st.cursor - start
+            self.stats.replay_dispatches += disp
+            self.stats.mttr_rounds.append(max(1, disp))
+            if ev is not None:
+                ev.recovered = True
+            if self.journal:
+                self.journal.event(
+                    "heal", r=self.round, doc=doc_id,
+                    ops=st.cursor - start, why="spool",
+                )
+            return row_v, L, nv
+        except Exception as e2:  # rebuild itself failed: isolate the doc
+            self._quarantine(
+                doc_id, f"spool unreadable ({err}); rebuild failed: {e2}"
+            )
+            if ev is not None:
+                ev.detail["quarantined"] = True
+            return None
+        finally:
+            self._bases.release()  # don't pin snapshot arrays post-heal
+
+    def _recover_class(self, cls: int, plan: _Plan, ev) -> None:
+        """Device-state loss mid-macro-round: the class's bucket is gone.
+        This round's staged ops for the class never became durable —
+        their lanes are dropped un-advanced (the WAL already recorded
+        them; the docs simply get rescheduled).  Every resident row is
+        rebuilt at its applied cursor from snapshot base + stream replay
+        and the bucket is re-uploaded in one compose."""
+        pool = self.pool
+        b = pool.buckets[cls]
+        plan.lanes.pop(cls, None)  # not applied: do not advance cursors
+        affected = pool.residents(cls)
+        doc_w = np.full((b.R, b.C), 2, np.int32)
+        len_w = np.zeros(b.R, np.int32)
+        nvis_w = np.zeros(b.R, np.int32)
+        replayed = 0
+        disp_total = 0
+        disp_max = 0
+        self._note_fault()
+        for doc_id, row in affected:
+            st = self.streams[doc_id]
+            rec = pool.docs[doc_id]
+            try:
+                if self.faults is not None and self.faults.poisoned(doc_id):
+                    raise RuntimeError("rebuild poisoned by fault plan")
+                base = self._bases.base(doc_id)
+                row_v, L, nv, disp = rebuild_doc(
+                    st, cls, base, st.cursor, n_init=rec.n_init,
+                    batch=self.batch, batch_chars=self.batch_chars,
+                    nbits=self.nbits, macro_k=self.effective_k,
+                )
+            except Exception as e:
+                self._quarantine(doc_id, f"device loss; rebuild failed: {e}")
+                continue
+            doc_w[row] = row_v
+            len_w[row] = L
+            nvis_w[row] = nv
+            start = min(base[3], st.cursor) if base is not None else 0
+            replayed += st.cursor - start
+            disp_total += disp
+            disp_max = max(disp_max, disp)
+        pool.upload_bucket(cls, doc_w, len_w, nvis_w)
+        self._bases.release()  # whole-class pass done: drop cached states
+        self.stats.recoveries += 1
+        self.stats.ops_replayed += replayed
+        self.stats.replay_dispatches += disp_total
+        self.stats.mttr_rounds.append(max(1, disp_max))
+        self.stats.faults_injected += 1
+        ev.fire(self.round, cls=cls, docs=len(affected),
+                replayed_ops=replayed)
+        ev.recovered = True
+        if self.journal:
+            self.journal.event(
+                "device_loss", r=self.round, cls=cls, docs=len(affected),
+                ops=replayed,
+            )
+
+    def finalize_faults(self) -> None:
+        """End-of-drain sweep: a corrupted spool whose doc was never
+        rehydrated again is healed NOW (rebuild + rewrite the spool), so
+        a chaos run never ends with an undecodable doc or a fired fault
+        left unrecovered."""
+        for e in self.faults.plan.events:
+            if e.kind not in ("spool_corrupt", "spool_truncate"):
+                continue
+            if not e.fired or e.recovered:
+                continue
+            doc_id = e.detail.get("doc")
+            rec = self.pool.docs.get(doc_id)
+            st = self.streams.get(doc_id)
+            if rec is None or st is None:
+                continue
+            if rec.spool is None or not os.path.exists(rec.spool):
+                e.recovered = True  # superseded: doc resident again
+                continue
+            try:
+                load_state(rec.spool)
+                e.recovered = True  # damage missed the live bytes
+                continue
+            except CorruptCheckpointError as err:
+                healed = self._heal_spool(
+                    doc_id, self.pool.class_for(max(rec.length, 1)),
+                    str(err),
+                )
+            if healed is None:
+                continue  # quarantined (reported separately)
+            row_v, L, nv = healed
+            rec.spool = self.pool.spool_save(doc_id, row_v, L, nv)
+            e.recovered = True
+
     # ---- boundary execution (the only device syncs) ----
 
     def _execute_moves(self, plan: _Plan) -> None:
         """Apply the plan's row movement: pull affected buckets once
         (syncing with any in-flight macro step), write eviction spools,
-        compose installs on host, upload each touched bucket once."""
+        compose installs on host, upload each touched bucket once.  A
+        spool that fails its CRC here is repaired in place
+        (:meth:`_heal_spool`) — or its doc quarantined."""
         pool = self.pool
         snaps = {
             cls: pool.pull_bucket(cls) for cls in sorted(plan.pull_classes)
@@ -500,7 +970,24 @@ class FleetScheduler:
                     doc_w[row] = _fresh_row_np(C, rec.n_init)
                     len_w[row] = nvis_w[row] = rec.n_init
                 elif source[0] == "spool":
-                    st = load_state(source[1])
+                    try:
+                        st = load_state(source[1])
+                    except CorruptCheckpointError as e:
+                        healed = self._heal_spool(doc_id, cls, str(e))
+                        try:
+                            os.unlink(source[1])
+                        except OSError:
+                            pass
+                        if healed is None:  # quarantined: scratch row
+                            doc_w[row] = _fresh_row_np(C, rec.n_init)
+                            len_w[row] = nvis_w[row] = rec.n_init
+                        else:
+                            row_v, L, nv = healed
+                            doc_w[row, :L] = row_v[:L]
+                            doc_w[row, L:] = 2
+                            len_w[row] = L
+                            nvis_w[row] = nv
+                        continue
                     os.unlink(source[1])  # rehydrated: bound the spool
                     L = int(st.length[0])
                     doc_w[row, :L] = st.doc[0, :L]
@@ -527,16 +1014,24 @@ class FleetScheduler:
             )
             self.stats.slices += plan.k_eff[cls]
             self.stats.staged_cells += kind.size
+            if self.faults is not None:
+                ev = self.faults.device_loss_event(self.round, cls)
+                if ev is not None:
+                    self._recover_class(cls, plan, ev)
         return compiled
 
     def _advance(self, plan: _Plan) -> None:
         """Host mirrors after dispatch: the staged ops WILL be applied,
         and length/cursor evolve deterministically, so no sync is needed
-        to keep scheduling exact."""
+        to keep scheduling exact.  Lanes of a class that lost its device
+        state (popped from the plan) and quarantined docs do NOT
+        advance — their ops are simply rescheduled or shed."""
         lanes_used = 0
         for cls, lanes in plan.lanes.items():
             for lane in lanes:
                 st = lane.stream
+                if st.doc_id in self._dead_lanes:
+                    continue
                 rec = self.pool.docs[st.doc_id]
                 self.stats.ops += lane.end - st.cursor
                 self.stats.unit_ops += (
@@ -546,26 +1041,73 @@ class FleetScheduler:
                 rec.length = rec.n_init + st.ins_before(lane.end)
                 rec.last_sched = plan.base_round
                 lanes_used += 1
+        self._dead_lanes.clear()
         total_lanes = sum(b.R for b in self.pool.buckets.values())
         self.stats.occupancy.append(lanes_used / total_lanes)
         self.stats.queue_depth.append(plan.waiting)
+        if self._planned_degraded:
+            self.stats.degraded_rounds += 1
+            self._degrade_left -= 1
+        if self._bp_round:
+            self.stats.backpressure_rounds += 1
+            self._bp_round = False
         self.round = plan.base_round + max(plan.k_eff.values())
+        self._n_rounds += 1
+
+    def _maybe_snapshot(self) -> None:
+        """Periodic fleet snapshot barrier (journal mode): pull every
+        bucket once and persist the consistent set.  The barrier is a
+        forced sync — its round is flagged so steady-state latency
+        quantiles exclude it, like compile rounds."""
+        self._snapped = False
+        if self.journal is None or self.snapshot_every <= 0:
+            return
+        if self._n_rounds % self.snapshot_every:
+            return
+        t0 = time.perf_counter()
+        d = write_snapshot(
+            self.journal.dir, self.pool, self.streams, self.round,
+            keep=self.snapshot_keep,
+        )
+        self.stats.snapshots += 1
+        self.stats.snapshot_time += time.perf_counter() - t0
+        self.journal.event("snap", r=self.round, dir=os.path.basename(d))
+        self._bases.release()  # the new barrier may have pruned old dirs
+        self._snapped = True
 
     # ---- driver ----
 
     def run_round(self) -> bool:
-        """One macro-round (plan -> stage -> boundary moves -> one async
-        dispatch per class).  Returns False when no work remains."""
+        """One macro-round (plan -> WAL record -> stage -> boundary
+        moves -> one async dispatch per class).  Returns False when no
+        work remains."""
         t0 = time.perf_counter()
+        if self.faults is not None:
+            self._fire_overflow()
         plan = self._plan()
         if plan is None:
             return False
+        if self.journal is not None:
+            # write-ahead: the lane set is durable BEFORE dispatch
+            self.journal.round_record(plan.base_round, {
+                cls: [[l.stream.doc_id, int(l.stream.cursor), int(l.end)]
+                      for l in lanes]
+                for cls, lanes in plan.lanes.items()
+            })
         tensors = self._stage(plan)
+        if self.faults is not None:
+            self._maybe_stall(plan.base_round)
         self._execute_moves(plan)
+        if self.faults is not None:
+            self._fire_spool_fault(plan)
         compiled = self._dispatch(plan, tensors)
         self._advance(plan)
+        if self._planned_degraded:
+            self.pool.block()  # degraded mode is SYNCHRONOUS K=1
+        self._maybe_snapshot()
         self.stats.round_latencies.append(time.perf_counter() - t0)
         self.stats.compile_flags.append(compiled)
+        self.stats.barrier_flags.append(self._snapped)
         return True
 
     def run(self, max_rounds: int | None = None) -> ServeStats:
@@ -583,6 +1125,8 @@ class FleetScheduler:
         self.pool.block()  # final fence: the last macro-round's drain
         if self.stats.round_latencies:
             self.stats.round_latencies[-1] += time.perf_counter() - tail0
+        if self.faults is not None and max_rounds is None:
+            self.finalize_faults()
         self.stats.wall_time += time.perf_counter() - t0
         self.stats.rounds = len(self.stats.round_latencies)
         self.stats.evictions = self.pool.evictions

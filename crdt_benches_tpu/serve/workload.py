@@ -21,6 +21,14 @@ source ("synth" with an op-count range, or a real-trace budget) so
 documents land across every pool capacity class.  Sessions get a
 staggered **arrival round**, modeling tenants joining a live server.
 
+Each band also carries a **delivery burst** — how many ops a session's
+producer pushes toward the fleet per scheduler round.  It only matters
+when the scheduler runs with a bounded per-doc queue (``queue_cap``):
+delivery past the cap is refused (backpressure) or shed, and the burst
+is what makes that pressure realistic instead of all-ops-at-once.
+``build_fleet(delivery="banded")`` turns it on; the default (None)
+keeps the legacy everything-pre-delivered stream.
+
 Real-trace windows are cached per (trace, band): all sessions of one
 band edit the same template document (many users editing from a shared
 starting point); synthetic sessions are all distinct (seeded per doc).
@@ -54,6 +62,16 @@ BANDS: dict[str, tuple[str, object]] = {
     "trace-huge": ("trace", (49000, 1200)),
 }
 
+#: band -> producer delivery burst (coalesced range ops pushed per
+#: scheduler round) under ``delivery="banded"``.  Small interactive docs
+#: trickle; big trace replays arrive in heavy bursts — the shape that
+#: stresses a bounded admission queue.
+DELIVERY_BURST: dict[str, int] = {
+    "synth-small": 64, "synth-medium": 96, "synth-large": 128,
+    "trace-small": 96, "trace-medium": 128, "trace-large": 192,
+    "trace-xl": 256, "trace-huge": 256,
+}
+
 #: mix name -> {band: weight}.  "mixed" is the headline multi-tenant
 #: blend; "synth"/"traces" isolate the two stream sources.
 MIXES: dict[str, dict[str, float]] = {
@@ -82,6 +100,7 @@ class Session:
     source: str  # "synth" or a real trace name
     trace: TestData
     arrival: int = 0
+    burst: int | None = None  # producer delivery rate (ops/round)
 
 
 @functools.lru_cache(maxsize=8)
@@ -153,11 +172,15 @@ def build_fleet(
     seed: int = 0,
     arrival_span: int = 8,
     bands: dict | None = None,
+    delivery: str | None = None,
 ) -> list[Session]:
     """N sessions drawn from the mix's band weights, with arrival rounds
     staggered uniformly over ``arrival_span`` rounds.  ``mix`` is a name
     from MIXES or an explicit {band: weight} table; ``bands`` overrides
-    the band sizing table (tests use tiny bands)."""
+    the band sizing table (tests use tiny bands).
+    ``delivery="banded"`` attaches each band's :data:`DELIVERY_BURST`
+    producer rate to its sessions (consumed by the scheduler's bounded
+    admission queue); the default delivers each stream whole."""
     weights = MIXES[mix] if isinstance(mix, str) else dict(mix)
     table = BANDS if bands is None else bands
     names = sorted(weights)
@@ -187,8 +210,9 @@ def build_fleet(
             src = fits[trace_rr % len(fits)]
             trace_rr += 1
             trace = trace_prefix(src, int(budget), cap)
+        burst = DELIVERY_BURST.get(band) if delivery == "banded" else None
         sessions.append(Session(
             doc_id=doc_id, band=band, source=src, trace=trace,
-            arrival=int(arrivals[doc_id]),
+            arrival=int(arrivals[doc_id]), burst=burst,
         ))
     return sessions

@@ -7,11 +7,29 @@ state pytree (DocState, DownState, vmapped replica stacks) round-trips
 through a single ``.npz`` file, so a long replay can stop after any op batch
 and resume bit-exactly — tested in tests/test_checkpoint.py.
 
-Format: one array per state field plus a field-order manifest and the state
-class name; plain NumPy, no framework dependency on the read side.
+Format: one array per state field plus a field-order manifest, the state
+class name, and a per-array CRC32 manifest; plain NumPy, no framework
+dependency on the read side.
+
+Durability contract (the serve/ fleet leans on both properties):
+
+- **atomic write**: :func:`save_state` writes to a same-directory temp
+  file and ``os.replace``\\ s it over the target, so a crash (or injected
+  exception) mid-write can never leave a torn ``.npz`` behind — the old
+  file, if any, survives intact;
+- **verified read**: :func:`load_state` checks every array against the
+  saved CRC32 manifest and raises the typed
+  :class:`CorruptCheckpointError` on any damage (truncation, bit flips,
+  an unreadable zip) instead of surfacing a numpy decode crash far from
+  the load site.  Pre-manifest checkpoints (no ``__crcs__`` field) load
+  with verification skipped — the legacy fallback.
 """
 
 from __future__ import annotations
+
+import os
+import tempfile
+import zlib
 
 import ml_dtypes
 import numpy as np
@@ -32,6 +50,12 @@ _CLASSES = {
 }
 
 
+class CorruptCheckpointError(ValueError):
+    """A checkpoint failed integrity verification: torn/truncated file,
+    CRC mismatch, or an undecodable archive.  Subclasses ValueError so
+    pre-existing ``except ValueError`` callers keep working."""
+
+
 def save_state(path: str, state, compress: bool = True) -> None:
     """Persist a DocState/DownState pytree (device arrays are fetched).
 
@@ -44,49 +68,104 @@ def save_state(path: str, state, compress: bool = True) -> None:
     ``compress=False`` skips zlib (``np.savez``): the serve/ eviction
     spool writes thousands of small checkpoints per drain and the
     deflate pass dominated its host cost; ``load_state`` reads both
-    forms transparently."""
+    forms transparently.
+
+    The write is ATOMIC: bytes land in a same-directory temp file that is
+    ``os.replace``\\ d over ``path`` only once fully written, so an
+    interrupted save (eviction killed mid-write, disk-full, crash) never
+    leaves a torn file — and never destroys a previous good checkpoint
+    at the same path."""
     cls = type(state).__name__
     if cls not in _CLASSES:
         raise TypeError(f"unsupported state type {cls}")
     arrays = {}
     dtypes = []
+    crcs = []
     for f in state._fields:
         a = np.asarray(getattr(state, f))
         dtypes.append(str(a.dtype))
         if a.dtype == _BF16:
             a = a.view(np.uint16)
         arrays[f] = a
+        crcs.append(zlib.crc32(np.ascontiguousarray(a).tobytes()))
     saver = np.savez_compressed if compress else np.savez
-    saver(
-        path, __class__=np.asarray(cls), __fields__=np.asarray(state._fields),
-        __dtypes__=np.asarray(dtypes), **arrays,
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
     )
+    try:
+        # np.savez on a FILE OBJECT (a str path would get ".npz" appended
+        # and orphan the temp file)
+        with os.fdopen(fd, "wb") as fh:
+            saver(
+                fh,
+                __class__=np.asarray(cls),
+                __fields__=np.asarray(state._fields),
+                __dtypes__=np.asarray(dtypes),
+                __crcs__=np.asarray(crcs, np.uint64),
+                **arrays,
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
-def load_state(path: str):
+def load_state(path: str, verify: bool = True):
     """Restore a state pytree saved by :func:`save_state` (host arrays;
-    device placement happens lazily on first use)."""
-    with np.load(path) as z:
-        cls = _CLASSES[str(z["__class__"])]
-        fields = [str(f) for f in z["__fields__"]]
-        dtypes = (
-            [str(d) for d in z["__dtypes__"]]
-            if "__dtypes__" in z else [""] * len(fields)
-        )
-        out = {}
-        for f, d in zip(fields, dtypes):
-            a = z[f]
-            if d == "bfloat16":
-                a = a.view(_BF16)
-            elif a.dtype.kind == "V":
-                # A void field with no dtype manifest is a pre-manifest
-                # checkpoint of a bf16-carrying state: unrecoverable
-                # (np.savez dropped the dtype) — fail loudly here rather
-                # than when jnp.asarray chokes far from the load site.
-                raise ValueError(
-                    f"checkpoint field {f!r} has opaque dtype {a.dtype}: "
-                    "legacy checkpoint saved before the bfloat16 manifest "
-                    "fix; re-create it with the current save_state"
-                )
-            out[f] = a
+    device placement happens lazily on first use).
+
+    Every array is checked against the saved CRC32 manifest; any damage
+    raises :class:`CorruptCheckpointError`.  Checkpoints written before
+    the CRC manifest existed (no ``__crcs__`` field) load with the
+    verification skipped — the legacy fallback."""
+    try:
+        z = np.load(path)
+    except Exception as e:  # BadZipFile / OSError / EOFError / ValueError
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r}: unreadable ({type(e).__name__}: {e})"
+        ) from e
+    with z:
+        try:
+            cls = _CLASSES[str(z["__class__"])]
+            fields = [str(f) for f in z["__fields__"]]
+            dtypes = (
+                [str(d) for d in z["__dtypes__"]]
+                if "__dtypes__" in z else [""] * len(fields)
+            )
+            crcs = z["__crcs__"] if "__crcs__" in z else None
+            out = {}
+            for i, (f, d) in enumerate(zip(fields, dtypes)):
+                a = z[f]
+                if verify and crcs is not None:
+                    got = zlib.crc32(np.ascontiguousarray(a).tobytes())
+                    if got != int(crcs[i]):
+                        raise CorruptCheckpointError(
+                            f"checkpoint {path!r}: field {f!r} CRC mismatch "
+                            f"(stored {int(crcs[i]):#010x}, got {got:#010x})"
+                        )
+                if d == "bfloat16":
+                    a = a.view(_BF16)
+                elif a.dtype.kind == "V":
+                    # A void field with no dtype manifest is a pre-manifest
+                    # checkpoint of a bf16-carrying state: unrecoverable
+                    # (np.savez dropped the dtype) — fail loudly here rather
+                    # than when jnp.asarray chokes far from the load site.
+                    raise CorruptCheckpointError(
+                        f"checkpoint field {f!r} has opaque dtype {a.dtype}: "
+                        "legacy checkpoint saved before the bfloat16 "
+                        "manifest fix; re-create it with the current "
+                        "save_state"
+                    )
+                out[f] = a
+        except CorruptCheckpointError:
+            raise
+        except Exception as e:  # truncated zip member, missing key, ...
+            raise CorruptCheckpointError(
+                f"checkpoint {path!r}: damaged archive "
+                f"({type(e).__name__}: {e})"
+            ) from e
         return cls(**out)

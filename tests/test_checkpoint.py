@@ -1,6 +1,10 @@
 """Checkpoint/resume: stop a replay after any op batch, restore from disk,
 finish, and get a bit-identical document (the subsystem the reference lacks,
-SURVEY.md section 5)."""
+SURVEY.md section 5).  Durability half: saves are atomic (a kill mid-write
+can't tear a file) and loads are CRC-verified (damage raises the typed
+CorruptCheckpointError, with a legacy fallback for pre-manifest spools)."""
+
+import os
 
 import numpy as np
 
@@ -67,6 +71,86 @@ def test_checkpoint_bf16_state4_roundtrip(tmp_path):
     for f in st._fields:
         a, b = np.asarray(getattr(st, f)), np.asarray(getattr(st2, f))
         assert a.dtype == b.dtype and (a == b).all(), f
+
+
+def _small_state(r=2, c=256):
+    from crdt_benches_tpu.ops.apply2 import PackedState
+
+    rng = np.random.default_rng(5)
+    return PackedState(
+        doc=rng.integers(0, 1 << 20, (r, c)).astype(np.int32),
+        length=np.asarray([c] * r, np.int32),
+        nvis=np.asarray([c // 2] * r, np.int32),
+    )
+
+
+def test_save_state_atomic_on_midwrite_crash(tmp_path, monkeypatch):
+    """A save killed mid-write (injected exception after partial bytes)
+    leaves the PREVIOUS checkpoint intact and no temp litter — the
+    eviction spool can never be torn."""
+    from crdt_benches_tpu.utils import checkpoint as cp
+
+    st = _small_state()
+    path = str(tmp_path / "spool.npz")
+    cp.save_state(path, st, compress=False)
+    good = open(path, "rb").read()
+
+    def boom(fh, **kw):
+        fh.write(b"partial garbage that must never reach the target")
+        raise RuntimeError("killed mid-write")
+
+    monkeypatch.setattr(np, "savez", boom)
+    with pytest.raises(RuntimeError, match="killed mid-write"):
+        cp.save_state(path, _small_state(3, 128), compress=False)
+    assert open(path, "rb").read() == good  # old checkpoint untouched
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+    st2 = cp.load_state(path)
+    for f in st._fields:
+        assert (np.asarray(getattr(st, f)) == getattr(st2, f)).all()
+
+
+@pytest.mark.parametrize("damage", ["bitflip", "truncate"])
+def test_load_state_detects_damage(tmp_path, damage):
+    """Any on-disk damage (flipped bytes, truncation) surfaces as the
+    typed CorruptCheckpointError, not a numpy decode crash."""
+    from crdt_benches_tpu.utils.checkpoint import (
+        CorruptCheckpointError,
+        load_state,
+        save_state,
+    )
+
+    path = str(tmp_path / "st.npz")
+    save_state(path, _small_state(), compress=False)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if damage == "bitflip":
+            f.seek(size // 2)
+            chunk = f.read(8)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        else:
+            f.truncate(int(size * 0.6))
+    with pytest.raises(CorruptCheckpointError):
+        load_state(path)
+
+
+def test_load_state_legacy_no_crc_manifest(tmp_path):
+    """Pre-CRC checkpoints (no __crcs__ field) still load — the legacy
+    fallback skips verification instead of rejecting old spools."""
+    from crdt_benches_tpu.utils.checkpoint import load_state
+
+    st = _small_state()
+    path = str(tmp_path / "legacy.npz")
+    arrays = {f: np.asarray(getattr(st, f)) for f in st._fields}
+    np.savez(
+        path, __class__=np.asarray("PackedState"),
+        __fields__=np.asarray(st._fields),
+        __dtypes__=np.asarray([str(a.dtype) for a in arrays.values()]),
+        **arrays,
+    )
+    st2 = load_state(path)
+    for f in st._fields:
+        assert (np.asarray(getattr(st, f)) == getattr(st2, f)).all()
 
 
 def test_checkpoint_legacy_void_fails_loudly(tmp_path):

@@ -1,0 +1,221 @@
+"""Write-ahead journal + snapshot barriers + crash recovery.
+
+The recovery contract under test: after ANY crash point, restoring the
+last consistent snapshot set and replaying the journal tail through the
+normal macro-round path yields final documents byte-identical to an
+uninterrupted run — and to the oracle."""
+
+import json
+import os
+import zlib
+
+import numpy as np
+
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.journal import (
+    OpJournal,
+    list_snapshots,
+    read_journal,
+    recover_fleet,
+)
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, prepare_streams
+from crdt_benches_tpu.serve.workload import Session, build_fleet, trace_prefix
+
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+def _sessions():
+    """Synth + real-trace docs so recovery spans capacity classes."""
+    sessions = build_fleet(
+        10, mix=TINY_MIX, seed=7, arrival_span=3, bands=TINY_BANDS
+    )
+    nxt = len(sessions)
+    sessions += [
+        Session(doc_id=nxt, band="trace-small", source="automerge-paper",
+                trace=trace_prefix("automerge-paper", 240), arrival=1),
+        Session(doc_id=nxt + 1, band="trace-medium",
+                source="sveltecomponent",
+                trace=trace_prefix("sveltecomponent", 500)),
+    ]
+    return sessions
+
+
+def _fresh(sessions, tmp_path, sub):
+    pool = DocPool(classes=(256, 1024), slots=(6, 3),
+                   spool_dir=str(tmp_path / sub))
+    streams = prepare_streams(sessions, pool, batch=16, batch_chars=64)
+    return pool, streams
+
+
+def test_journal_records_crc_framed_and_torn_tail(tmp_path):
+    """Records round-trip; a torn tail (partial line, flipped bytes) is
+    dropped at read time, never parsed into garbage."""
+    jd = str(tmp_path / "j")
+    j = OpJournal(jd)
+    j.round_record(0, {256: [[1, 0, 16], [2, 0, 8]]})
+    j.event("quarantine", r=3, doc=2, at=8, ops=5, reason="test")
+    j.round_record(4, {256: [[1, 16, 32]]})
+    j.close()
+    recs, dropped = read_journal(jd)
+    assert dropped == 0 and len(recs) == 3
+    assert recs[0] == {"t": "round", "r": 0,
+                       "lanes": {"256": [[1, 0, 16], [2, 0, 8]]}}
+    assert recs[1]["t"] == "quarantine" and recs[1]["doc"] == 2
+
+    # crash tear: a partial final line is dropped, the prefix survives
+    with open(os.path.join(jd, "journal.log"), "a") as f:
+        f.write('deadbeef {"t":"round","r":8')  # no newline, bad crc
+    recs2, dropped2 = read_journal(jd)
+    assert len(recs2) == 3 and dropped2 == 1
+
+    # reopening for append TRUNCATES the torn tail first — records
+    # appended behind a damaged line would be invisible to the next
+    # recovery (readers stop at the first bad line)
+    j2 = OpJournal(jd)
+    j2.round_record(8, {256: [[1, 32, 40]]})
+    j2.close()
+    recs2b, dropped2b = read_journal(jd)
+    assert dropped2b == 0 and len(recs2b) == 4
+    assert recs2b[-1]["r"] == 8
+
+    # mid-file damage: reading stops at the first bad line (append-only
+    # discipline means everything after is suspect)
+    path = os.path.join(jd, "journal.log")
+    lines = open(path).readlines()
+    payload = lines[1].split(" ", 1)[1].rstrip("\n")
+    bad = f"{zlib.crc32(payload.encode()) ^ 1:08x} {payload}\n"
+    with open(path, "w") as f:
+        f.writelines([lines[0], bad] + lines[2:])
+    recs3, dropped3 = read_journal(jd)
+    assert len(recs3) == 1 and dropped3 >= 1
+
+
+def test_snapshot_commit_is_atomic(tmp_path):
+    """A staging directory without the final rename is invisible to
+    recovery; committed snapshots are pruned to the keep count."""
+    sessions = _sessions()
+    pool, streams = _fresh(sessions, tmp_path, "p")
+    jd = str(tmp_path / "j")
+    sched = FleetScheduler(pool, streams, batch=16, macro_k=4,
+                           batch_chars=64, journal=OpJournal(jd),
+                           snapshot_every=1, snapshot_keep=2)
+    sched.run(max_rounds=4)
+    snaps = list_snapshots(jd)
+    assert 1 <= len(snaps) <= 2  # pruned to keep=2
+    # a torn (uncommitted) staging dir must be ignored
+    os.makedirs(os.path.join(jd, "snap_99999999.tmp"))
+    assert "snap_99999999.tmp" not in list_snapshots(jd)
+    m = json.load(open(os.path.join(jd, snaps[-1], "MANIFEST.json")))
+    assert set(m) >= {"round", "classes", "resident", "spooled", "docs"}
+    assert len(m["docs"]) == len(sessions)
+
+
+def test_crash_recovery_parity_seeded_kill(tmp_path):
+    """THE recovery gate (satellite): kill the fleet at a seeded random
+    macro-round, recover from snapshot + journal into a FRESH pool, and
+    drain — final documents are byte-identical to an uninterrupted run
+    across capacity classes, and to the oracle."""
+    sessions = _sessions()
+
+    # ground truth: uninterrupted drain of the identical fleet
+    pool_a, streams_a = _fresh(sessions, tmp_path, "a")
+    FleetScheduler(pool_a, streams_a, batch=16, macro_k=4,
+                   batch_chars=64).run()
+    want = {s.doc_id: pool_a.decode(s.doc_id) for s in sessions}
+
+    rng = np.random.default_rng(0xC0FFEE)
+    # seeded random kill point, odd so the crash lands BETWEEN snapshot
+    # barriers (snapshot_every=2) and leaves a real journal redo tail
+    kill = 3 + 2 * int(rng.integers(0, 2))
+    jd = str(tmp_path / "journal")
+    pool_b, streams_b = _fresh(sessions, tmp_path, "b")
+    jb = OpJournal(jd)
+    sb = FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                        batch_chars=64, journal=jb, snapshot_every=2)
+    sb.run(max_rounds=kill)
+    assert not sb.done  # the crash interrupts real pending work
+    del pool_b, streams_b, sb  # host state lost; disk survives
+
+    # simulate a torn final append on top of the kill
+    with open(os.path.join(jd, "journal.log"), "a") as f:
+        f.write('0bad0bad {"t":"round"')
+
+    pool_c, streams_c = _fresh(sessions, tmp_path, "c")
+    rep = recover_fleet(pool_c, streams_c, jd)
+    assert rep.torn_records >= 1
+    assert rep.snapshot_round >= 0  # a barrier was used, not cold start
+    assert rep.docs_restored + rep.spools_restored > 0
+    # the WAL tip is ahead of the barrier: there is a real redo tail
+    assert rep.ops_replayed > 0
+    sc = FleetScheduler(pool_c, streams_c, batch=16, macro_k=4,
+                        batch_chars=64, journal=OpJournal(jd),
+                        snapshot_every=2, start_round=rep.resume_round)
+    sc.run()
+    assert sc.done
+    hosted = set()
+    for s in sessions:
+        assert pool_c.decode(s.doc_id) == want[s.doc_id], (
+            f"doc {s.doc_id} diverged after recovery"
+        )
+        assert want[s.doc_id] == replay_trace(s.trace)
+        rec = pool_c.docs[s.doc_id]
+        hosted.add(rec.cls or pool_c.class_for(max(rec.length, 1)))
+    assert len(hosted) >= 2  # parity really spans capacity classes
+
+
+def test_recovery_falls_back_on_damaged_snapshot(tmp_path):
+    """A snapshot whose class state fails its CRC is skipped — recovery
+    uses an older barrier (or a cold start) and parity still holds."""
+    sessions = _sessions()
+    pool_a, streams_a = _fresh(sessions, tmp_path, "a")
+    FleetScheduler(pool_a, streams_a, batch=16, macro_k=4,
+                   batch_chars=64).run()
+    want = {s.doc_id: pool_a.decode(s.doc_id) for s in sessions}
+
+    jd = str(tmp_path / "journal")
+    pool_b, streams_b = _fresh(sessions, tmp_path, "b")
+    sb = FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                        batch_chars=64, journal=OpJournal(jd),
+                        snapshot_every=2)
+    sb.run(max_rounds=5)
+    del pool_b, streams_b, sb
+
+    snaps = list_snapshots(jd)
+    assert snaps
+    newest = os.path.join(jd, snaps[-1])
+    victim = next(
+        os.path.join(newest, f) for f in sorted(os.listdir(newest))
+        if f.startswith("class_")
+    )
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(b"\xff" * 16)
+
+    pool_c, streams_c = _fresh(sessions, tmp_path, "c")
+    rep = recover_fleet(pool_c, streams_c, jd)
+    assert rep.snapshot_round < int(snaps[-1].split("_")[1])
+    sc = FleetScheduler(pool_c, streams_c, batch=16, macro_k=4,
+                        batch_chars=64, start_round=rep.resume_round)
+    sc.run()
+    for s in sessions:
+        assert pool_c.decode(s.doc_id) == want[s.doc_id]
+
+
+def test_recovery_cold_start_without_journal(tmp_path):
+    """No journal directory at all: recovery degrades to a cold start
+    (streams are deterministic, the fleet rebuilds from nothing)."""
+    sessions = build_fleet(
+        6, mix=TINY_MIX, seed=9, arrival_span=2, bands=TINY_BANDS
+    )
+    pool, streams = _fresh(sessions, tmp_path, "p")
+    rep = recover_fleet(pool, streams, str(tmp_path / "nonexistent"))
+    assert rep.snapshot_round == -1 and rep.resume_round == 0
+    FleetScheduler(pool, streams, batch=16, macro_k=4,
+                   batch_chars=64).run()
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)

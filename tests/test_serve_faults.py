@@ -1,0 +1,293 @@
+"""Deterministic chaos: injected faults, in-run repair, backpressure,
+quarantine, and graceful degradation.
+
+Every test's ground truth is the oracle: whatever the injector breaks,
+non-lossy documents must finish byte-identical — loss is only ever the
+result of an EXPLICIT, surfaced decision (shed / quarantine)."""
+
+import json
+
+import pytest
+
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from crdt_benches_tpu.serve.journal import OpJournal
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, prepare_streams
+from crdt_benches_tpu.serve.workload import build_fleet
+from crdt_benches_tpu.traces.synth import synth_trace
+from crdt_benches_tpu.serve.workload import Session
+
+TINY_BANDS = {"synth-small": ("synth", (40, 120))}
+TINY_MIX = {"synth-small": 1.0}
+
+
+def _fleet(tmp_path, n=5, seed=11, classes=(128,), slots=(2,), **kw):
+    """A deliberately over-subscribed fleet (more docs than rows) so
+    eviction spools churn — the surface most faults target."""
+    sessions = build_fleet(
+        n, mix=TINY_MIX, seed=seed, arrival_span=2, bands=TINY_BANDS
+    )
+    pool = DocPool(classes=classes, slots=slots,
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32, **kw)
+    return sessions, pool, streams, sched
+
+
+def _assert_oracle_parity(sessions, pool, streams, skip_lossy=True):
+    for s in sessions:
+        if skip_lossy and streams[s.doc_id].lossy:
+            continue
+        assert pool.decode(s.doc_id) == replay_trace(s.trace), (
+            f"doc {s.doc_id} diverged"
+        )
+
+
+@pytest.mark.parametrize("kind", ["spool_corrupt", "spool_truncate"])
+def test_spool_damage_healed_by_rebuild(tmp_path, kind):
+    """A spool that fails its CRC on restore is rebuilt from the stream
+    through the macro replay path — every doc still matches the oracle
+    and the event is recovered."""
+    plan = FaultPlan([FaultEvent(kind=kind, round=2)], seed=3)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, faults=FaultInjector(plan)
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired and ev.recovered
+    assert sched.stats.recoveries >= 1
+    assert sched.stats.ops_replayed > 0
+    assert sched.stats.mttr_rounds  # MTTR recorded per recovery
+    assert not sched.stats.quarantines
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_spool_heal_uses_snapshot_base(tmp_path):
+    """With snapshot barriers enabled, the rebuild starts from the last
+    snapshot base instead of replaying the whole stream — the redo span
+    is bounded by the barrier."""
+    plan = FaultPlan([FaultEvent(kind="spool_corrupt", round=4)], seed=3)
+    jd = str(tmp_path / "journal")
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, faults=FaultInjector(plan),
+        journal=OpJournal(jd), snapshot_every=1,
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired and ev.recovered and sched.stats.recoveries >= 1
+    victim = ev.detail["doc"]
+    # the rebuilt span must be shorter than the victim's full stream
+    assert sched.stats.ops_replayed < streams[victim].cursor or (
+        sched.stats.ops_replayed <= streams[victim].n_total
+    )
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_device_loss_mid_macro_round_recovers(tmp_path):
+    """Clobbering a class's device state right after a macro dispatch:
+    that round's lanes are dropped un-advanced, every resident row is
+    rebuilt at its applied cursor, and the drain converges to oracle
+    parity."""
+    plan = FaultPlan([FaultEvent(kind="device_loss", round=3)], seed=5)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, faults=FaultInjector(plan)
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired and ev.recovered
+    assert ev.detail["docs"] >= 1
+    assert sched.stats.recoveries >= 1
+    assert sched.stats.mttr_rounds
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_duplicated_batch_clamped_not_reapplied(tmp_path):
+    """Redelivered (duplicate/stale-reordered) batches are clamped at
+    the cursor high-water mark: counted, dropped, and the final state is
+    unaffected."""
+    plan = FaultPlan([FaultEvent(kind="dup_batch", round=2),
+                      FaultEvent(kind="dup_batch", round=3)], seed=1)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, faults=FaultInjector(plan)
+    )
+    sched.run()
+    assert sched.done
+    assert all(e.fired and e.recovered for e in plan.events)
+    assert sched.stats.dup_ops_dropped > 0
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_bounded_queue_backpressure_defer_loses_nothing(tmp_path):
+    """A small queue cap clips delivery (backpressure) but defers, never
+    drops: deferred_ops counts the pushback, the drain still completes,
+    and every doc matches the oracle."""
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, queue_cap=8, overflow_policy="defer"
+    )
+    sched.run()
+    assert sched.done
+    assert sched.stats.deferred_ops > 0
+    assert sched.stats.backpressure_rounds > 0
+    assert sched.stats.shed_ops == 0
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_banded_delivery_burst_flows_through(tmp_path):
+    """workload.build_fleet(delivery='banded') attaches per-band
+    producer rates that the bounded queue consumes."""
+    sessions = build_fleet(
+        4, mix=TINY_MIX, seed=2, arrival_span=1, bands=TINY_BANDS,
+        delivery="banded",
+    )
+    assert all(s.burst is not None and s.burst > 0 for s in sessions)
+    pool = DocPool(classes=(128,), slots=(4,),
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    assert all(st.burst == s.burst
+               for s, st in zip(sessions, streams.values()))
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=2,
+                           batch_chars=32, queue_cap=16)
+    sched.run()
+    assert sched.done
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_queue_overflow_shed_policy_is_explicit_and_surfaced(tmp_path):
+    """Under the shed policy an overflow burst tail-drops ONE session's
+    remaining ops: the loss is counted, the doc marked lossy (excluded
+    from verification), and every other doc still matches the oracle."""
+    plan = FaultPlan(
+        [FaultEvent(kind="queue_overflow", round=2, param=64)], seed=9
+    )
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, faults=FaultInjector(plan),
+        queue_cap=8, overflow_policy="shed",
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired and ev.recovered and ev.detail["shed"] > 0
+    assert sched.stats.overflow_events == 1
+    assert sched.stats.shed_ops == ev.detail["shed"]
+    lossy = [d for d, st in streams.items() if st.lossy]
+    assert lossy == [ev.detail["doc"]]
+    st = streams[lossy[0]]
+    assert st.limit is not None and st.remaining == 0
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=True)
+
+
+def test_poisoned_rebuild_quarantines_and_fleet_survives(tmp_path):
+    """When repair itself fails, the doc is quarantined — remaining ops
+    shed, row freed — and the REST of the fleet drains to oracle
+    parity.  Availability beats completeness for one tenant."""
+    plan = FaultPlan([
+        FaultEvent(kind="spool_corrupt", round=2),
+        FaultEvent(kind="poison_rebuild", round=0),
+    ], seed=3)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, faults=FaultInjector(plan)
+    )
+    sched.run()
+    assert sched.done
+    assert len(sched.stats.quarantines) == 1
+    q = sched.stats.quarantines[0]
+    assert streams[q["doc"]].lossy
+    assert sched.stats.shed_ops >= q["shed_ops"] >= 0
+    assert pool.docs[q["doc"]].cls is None  # row freed, fleet serving
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=True)
+
+
+def test_repeated_faults_degrade_to_k1_then_restore(tmp_path):
+    """Fault density trips the macro-K -> K=1 synchronous fallback for a
+    cooldown window, then K restores automatically."""
+    plan = FaultPlan([FaultEvent(kind="stall", round=2, param=1),
+                      FaultEvent(kind="stall", round=3, param=1),
+                      FaultEvent(kind="stall", round=4, param=1)], seed=0)
+    # long enough streams that the drain outlives the cooldown window
+    traces = [synth_trace(seed=300 + i, n_ops=600) for i in range(3)]
+    sessions = [
+        Session(doc_id=i, band="synth-small", source="synth", trace=t)
+        for i, t in enumerate(traces)
+    ]
+    pool = DocPool(classes=(1024,), slots=(3,),
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(
+        pool, streams, batch=8, macro_k=4, batch_chars=32,
+        faults=FaultInjector(plan), degrade_after=2, degrade_window=8,
+        degrade_rounds=3,
+    )
+    sched.run()
+    assert sched.done
+    assert sched.stats.stall_rounds == 3
+    assert sched.stats.degraded_rounds >= 3  # the K=1 cooldown ran
+    assert sched.effective_k == 4  # ...and K restored afterwards
+    _assert_oracle_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_fault_spec_grammar():
+    plan = FaultPlan.from_spec(
+        "seed=7,span=6,stall_ms=5,burst=32,"
+        "spool_corrupt=2,device_loss@4=1,queue_overflow=1"
+    )
+    kinds = sorted(e.kind for e in plan.events)
+    assert kinds == ["device_loss", "queue_overflow",
+                     "spool_corrupt", "spool_corrupt"]
+    assert next(e for e in plan.events if e.kind == "device_loss").round == 4
+    assert all(2 <= e.round <= 6 for e in plan.events)
+    assert plan.stall_ms == 5 and plan.burst == 32
+    # same spec -> same schedule (seeded determinism)
+    plan2 = FaultPlan.from_spec(plan.spec or
+                                "seed=7,span=6,stall_ms=5,burst=32,"
+                                "spool_corrupt=2,device_loss@4=1,"
+                                "queue_overflow=1")
+    assert [(e.kind, e.round) for e in plan.events] == \
+        [(e.kind, e.round) for e in plan2.events]
+    with pytest.raises(ValueError, match="unknown kind"):
+        FaultPlan.from_spec("meteor_strike=1")
+
+
+def test_bench_chaos_artifact_and_gates(tmp_path):
+    """run_serve_bench in chaos mode: verify_ok AND faults_ok, with the
+    full robustness surface (faults block, recovery metrics, journal
+    stats, shed/deferred counters) persisted in the artifact."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=8, batch=8,
+        classes=(128, 512), slots=(3, 2), seed=3, arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS, macro_k=4, batch_chars=32,
+        spool_dir=str(tmp_path / "spool"),
+        journal_dir=str(tmp_path / "journal"),
+        snapshot_every=2,
+        faults="seed=5,span=4,spool_corrupt=1,device_loss=1,"
+               "queue_overflow=1,dup_batch=1,stall=1,stall_ms=1",
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"] and info["faults_ok"]
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    ex = d["extra"]
+    f = ex["faults"]
+    assert f["injected"] == 5 and f["unrecovered"] == 0
+    assert f["not_fired"] == 0
+    kinds = {e["kind"] for e in f["events"] if e["fired"]}
+    assert kinds == {"spool_corrupt", "device_loss", "queue_overflow",
+                     "dup_batch", "stall"}
+    assert ex["queue_cap"] > 0  # auto-defaulted for queue_overflow
+    assert ex["mttr_rounds"]["n"] >= 1
+    assert ex["recoveries"] >= 1 and ex["ops_replayed"] > 0
+    assert ex["journal"]["records"] > 0
+    assert ex["journal"]["snapshots"] >= 1
+    assert ex["shed_ops"] == 0  # defer policy: chaos without data loss
+    assert ex["verify_ok"] is True

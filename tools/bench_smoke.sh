@@ -1,15 +1,23 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve]     (from the repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-faults]   (repo root)
 #
-# The serve family (the default and currently only family) drains a tiny
-# document fleet through the macro-round engine (K=4) on host CPU and
-# exits NONZERO when the in-run oracle byte-verification fails
-# (`verify_ok: false`) — the runner's exit code carries the gate, so a
-# correctness regression in the serving hot path fails CI even when every
-# unit test was green.  The artifact lands in bench_results/ under a
-# smoke-specific name so it never clobbers committed headline numbers.
+# The serve family (the default) drains a tiny document fleet through the
+# macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
+# oracle byte-verification fails (`verify_ok: false`) — the runner's exit
+# code carries the gate, so a correctness regression in the serving hot
+# path fails CI even when every unit test was green.
+#
+# The serve-faults family is the CHAOS smoke: the same tiny fleet drained
+# under a seeded FaultPlan (spool corruption, mid-macro device-state
+# loss, queue-overflow burst, duplicated batch, host stall) with the
+# write-ahead journal + snapshot barriers enabled.  It exits NONZERO when
+# the byte-verify fails OR any injected fault goes unfired/unrecovered —
+# recovery itself is the thing under test.
+#
+# Artifacts land in bench_results/ under smoke-specific names so they
+# never clobber committed headline numbers.
 set -euo pipefail
 
 family="serve"
@@ -31,8 +39,21 @@ case "$family" in
         --serve-arrival-span 2 --serve-verify-sample 6 \
         --serve-save-name serve_smoke
     ;;
+  serve-faults)
+    exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 3 \
+        --serve-queue-cap 128 \
+        --serve-faults "seed=5,span=5,spool_corrupt=1,device_loss=1,queue_overflow=1,dup_batch=1,stall=1" \
+        --serve-save-name serve_faults_smoke
+    ;;
   *)
-    echo "unknown family: $family (expected: serve)" >&2
+    echo "unknown family: $family (expected: serve, serve-faults)" >&2
     exit 2
     ;;
 esac
