@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..ops.apply import init_state
 from ..traces.loader import TestData
 from ..traces.tensorize import INSERT, TensorizedTrace, tensorize
@@ -503,6 +504,11 @@ def _apply_update_batch5(doc, length, nvis, snap, levels, ins, anchor,
     return doc2, length2, nvis + n_live - n_del_eff, level
 
 
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32"),
+    shapes=(None, "N B", "N B", "N B", "N B"),
+    donates=(0,),
+)
 @partial(jax.jit, static_argnames=("nbits", "epoch"), donate_argnums=(0,))
 def apply_updates5(
     state: DownPacked, ins_b, anchor_b, rank_b, dslot_b,

@@ -233,7 +233,7 @@ def flatten_runs(
         )
 
     R = n_replicas
-    doc = jax.vmap(materialize)(jnp.arange(R))
+    doc = jax.vmap(materialize)(jnp.arange(R, dtype=jnp.int32))
     return DownPacked(
         doc=doc,
         snap=jnp.broadcast_to(pos, (R, C)),

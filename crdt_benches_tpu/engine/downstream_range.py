@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..traces.loader import TestData
 from ..traces.tensorize import tensorize
 from .downstream import DownPacked
@@ -424,6 +425,13 @@ def _apply_range_update_batch5(
     return doc2, length2, nvis + n_live - n_del_eff, level
 
 
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32", None,
+            "int32", "int32"),
+    shapes=(None, "N B", "N B", "N B", "N B", "N B", "N B",
+            "N B"),
+    donates=(0,),
+)
 @partial(jax.jit, static_argnames=("nbits", "epoch"), donate_argnums=(0,))
 def apply_range_updates5(
     state: DownPacked,

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..ops.apply import init_state
 from ..traces.tensorize import DELETE, INSERT, PAD, TensorizedTrace
 from .downstream import DownState, init_down_state
@@ -499,6 +500,12 @@ def _chain_structure(kind, elem, origin):
     return ins, anchor, jnp.where(is_ins, rank, 0), dslot
 
 
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32", "int32",
+            "int32"),
+    shapes=(None, "N", "N", "N", "N", "N", "N"),
+    donates=(0,),
+)
 @partial(
     jax.jit,
     static_argnames=("batch", "epoch", "nbits", "max_unique", "segments"),

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..traces.tensorize import DELETE, INSERT
 from .downstream import DownPacked, down_packed_init
 from .merge import MAX_AGENTS, MergeSimulation, OpLog
@@ -175,7 +176,11 @@ def check_no_skip(runlogs: list[RunLog]) -> bool:
 
 # ---- device integration -----------------------------------------------------
 
-BIGKEY = jnp.int32(2**31 - 1)
+# Host-side on purpose (np, not jnp): a module-scope DEVICE scalar is
+# created inside whatever trace context is live at first import and gets
+# captured by every jit as a committed buffer (the ops/idpos.py BIG
+# tracer-leak incident; graftlint G001 enforces this now).
+BIGKEY = np.int32(2**31 - 1)
 
 
 def _run_batch_fragments(key, slot0, rlen, origin):
@@ -348,6 +353,11 @@ def _run_batch_fragments(key, slot0, rlen, origin):
     return f_anchor, f_rank, f_slot0, f_rlen
 
 
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32", "int32"),
+    shapes=(None, "N", "N", "N", "N", "N"),
+    donates=(0,),
+)
 @partial(
     jax.jit,
     static_argnames=("batch", "epoch", "nbits"),

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..ops.apply import (
     DocState,
     apply_batch,
@@ -108,6 +109,11 @@ def decode_to_str(state, chars) -> str:
     return "".join(map(chr, codes.tolist()))
 
 
+@boundary(
+    dtypes=(None, "int32", "int32", "int32"),
+    shapes=(None, "N B", "N B", "N B"),
+    donates=(0,),
+)
 @partial(jax.jit, donate_argnums=(0,))
 def replay_batches(state: DocState, kind_b, pos_b, slot_b) -> DocState:
     """Scan all op batches into the document state.  Shapes:

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..ops.apply2 import PackedState, init_state3, init_state4
 from ..ops.apply_range import apply_range_batch
 from ..traces.tensorize import INSERT, RangeTrace
@@ -37,6 +38,11 @@ def _grow_state3(state: PackedState, new_cap: int) -> PackedState:
     )
 
 
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32"),
+    shapes=(None, "N B", "N B", "N B", "N B"),
+    donates=(0,),
+)
 @partial(
     jax.jit,
     static_argnames=("nbits", "pack", "interpret", "token_cap", "engine"),

@@ -1,0 +1,34 @@
+"""graftlint — JAX-hygiene static analysis + jit-boundary contracts.
+
+Run it: ``python -m crdt_benches_tpu.lint <paths>`` (or ``tools/lint.sh``).
+Suppress a finding: trailing ``# graftlint: disable=G00X`` on the line,
+or ``# graftlint: disable-file=G00X`` anywhere in the file.
+"""
+
+from .boundary import (  # noqa: F401
+    REGISTRY,
+    BoundaryContract,
+    BoundaryError,
+    boundary,
+    boundary_table,
+    checks_enabled,
+)
+from .core import (  # noqa: F401
+    Finding,
+    format_json,
+    format_text,
+    run_lint,
+)
+
+__all__ = [
+    "REGISTRY",
+    "BoundaryContract",
+    "BoundaryError",
+    "boundary",
+    "boundary_table",
+    "checks_enabled",
+    "Finding",
+    "format_json",
+    "format_text",
+    "run_lint",
+]

@@ -1,0 +1,70 @@
+"""graftlint CLI.
+
+    python -m crdt_benches_tpu.lint [paths...] [--format text|json]
+                                    [--select G001,G002] [--boundaries]
+
+Exits nonzero when any finding survives suppression (CI gates on this).
+``--boundaries`` dumps the jit-boundary contract registry as JSON by
+importing the package modules that declare them (the only mode that
+imports anything heavy; plain linting is pure-AST and jax-free).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import format_json, format_text, run_lint
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint")
+    ap.add_argument(
+        "paths", nargs="*", default=["crdt_benches_tpu"],
+        help="files or directories to lint (default: the package)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--boundaries", action="store_true",
+        help="dump the jit-boundary contract registry as JSON and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.boundaries:
+        # importing serve/engine registers every @boundary contract
+        import importlib
+
+        for mod in (
+            "crdt_benches_tpu.serve.pool",
+            "crdt_benches_tpu.engine.replay",
+            "crdt_benches_tpu.engine.replay_range",
+            "crdt_benches_tpu.engine.merge",
+            "crdt_benches_tpu.engine.merge_range",
+            "crdt_benches_tpu.engine.downstream",
+            "crdt_benches_tpu.engine.downstream_range",
+        ):
+            importlib.import_module(mod)
+        from .boundary import boundary_table
+
+        print(json.dumps(boundary_table(), indent=2))
+        return 0
+
+    select = {
+        s.strip() for s in args.select.split(",") if s.strip()
+    } or None
+    findings = run_lint(args.paths, select=select)
+    out = (
+        format_json(findings) if args.format == "json"
+        else format_text(findings)
+    )
+    print(out)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,207 @@
+"""jit-boundary contract registry: declared shapes/dtypes/donation.
+
+Every public jitted entry point in this repo is a *boundary*: host-built
+tensors cross into a traced region, and the two historical classes of
+silent breakage (a wrong dtype causing an unplanned recompile, a donated
+buffer read after the donating call) both happen exactly there.  The
+``@boundary`` decorator records each entry point's contract in a
+machine-readable table (:data:`REGISTRY`):
+
+    @boundary(dtypes=(None, "int32", "int32"), shapes=(None, "R B", "R B"),
+              donates=(0,))
+    @partial(jax.jit, donate_argnums=(0,))
+    def fleet_step(state, kind, pos): ...
+
+- ``dtypes``: per-positional-arg dtype name (``"int32"``), applied to
+  every array leaf of that argument; ``None`` = unchecked.
+- ``shapes``: per-arg symbolic dim spec (``"K R B"``); letters must bind
+  consistently across the call's arguments, integer tokens are exact.
+  Only checked for single-array arguments; ``None`` = unchecked.
+- ``donates``: positions whose buffers the jitted callee donates.  The
+  runtime check rejects *aliased donation* — a donated argument sharing
+  an array object with any other argument (XLA would read a freed
+  buffer, or silently copy).
+
+The table is consumed three ways:
+
+1. **statically** — graftlint rule G007 cross-checks the declared
+   ``donates`` against the ``jax.jit(donate_argnums=...)`` in the same
+   decorator stack, and call sites against declared dtypes;
+2. **at runtime** — with ``CRDT_BENCH_CHECK_BOUNDARIES=1`` in the
+   environment at import time, every decorated call validates its
+   arguments (works on tracers too: checks read only ``.dtype``/
+   ``.shape``);
+3. **zero-overhead default** — with the variable unset the decorator
+   returns the function object *unchanged* (identity), so production
+   dispatch pays nothing (asserted by tests/test_boundary.py).
+
+This module is stdlib-only on purpose: the hot modules import it, and it
+must never drag jax into import-time of the lint CLI.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+from dataclasses import dataclass
+
+_ENV = "CRDT_BENCH_CHECK_BOUNDARIES"
+
+
+def checks_enabled() -> bool:
+    """True when the debug enforcement mode is switched on.  Read at
+    DECORATION time (module import), not per call — the off switch must
+    cost zero, so there is no per-call branch to mispredict."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+class BoundaryError(TypeError):
+    """A call violated its declared jit-boundary contract."""
+
+
+@dataclass(frozen=True)
+class BoundaryContract:
+    name: str  # "module.qualname" — the registry key
+    dtypes: tuple  # per-positional-arg dtype name or None
+    shapes: tuple  # per-positional-arg "K R B" spec or None
+    donates: tuple  # donated positional indices
+
+    def describe(self) -> dict:
+        return {
+            "dtypes": list(self.dtypes),
+            "shapes": list(self.shapes),
+            "donates": list(self.donates),
+        }
+
+
+#: The machine-readable contract table, keyed by "module.qualname".
+REGISTRY: dict[str, BoundaryContract] = {}
+
+
+def boundary_table() -> dict[str, dict]:
+    """The registry as plain JSON-ready data (``--boundaries`` dump)."""
+    return {name: c.describe() for name, c in sorted(REGISTRY.items())}
+
+
+def _leaves(x):
+    """Array leaves of a minimal pytree (NamedTuple / tuple / list /
+    dict) — no jax import; anything with a ``.dtype`` is a leaf."""
+    if hasattr(x, "_fields"):  # NamedTuple state pytrees
+        for f in x._fields:
+            yield from _leaves(getattr(x, f))
+    elif isinstance(x, (tuple, list)):
+        for v in x:
+            yield from _leaves(v)
+    elif isinstance(x, dict):
+        for v in x.values():
+            yield from _leaves(v)
+    elif hasattr(x, "dtype"):
+        yield x
+
+
+def _check_call(c: BoundaryContract, args: tuple) -> None:
+    # dtypes: every array leaf of arg i must match the declared name
+    for i, want in enumerate(c.dtypes):
+        if want is None or i >= len(args):
+            continue
+        for leaf in _leaves(args[i]):
+            got = str(leaf.dtype)
+            if got != want:
+                raise BoundaryError(
+                    f"{c.name}: arg {i} dtype {got!r} != declared {want!r}"
+                )
+    # shapes: symbolic dims bind consistently across the call
+    env: dict[str, int] = {}
+    for i, spec in enumerate(c.shapes):
+        if spec is None or i >= len(args):
+            continue
+        leaves = list(_leaves(args[i]))
+        if len(leaves) != 1:  # pytree arg: spec applies to arrays only
+            continue
+        shape = tuple(leaves[0].shape)
+        toks = spec.split()
+        if len(shape) != len(toks):
+            raise BoundaryError(
+                f"{c.name}: arg {i} rank {len(shape)} != declared "
+                f"{spec!r}"
+            )
+        for tok, dim in zip(toks, shape):
+            if tok.isdigit():
+                if int(tok) != dim:
+                    raise BoundaryError(
+                        f"{c.name}: arg {i} dim {dim} != declared {tok} "
+                        f"in {spec!r}"
+                    )
+            elif env.setdefault(tok, dim) != dim:
+                raise BoundaryError(
+                    f"{c.name}: arg {i} dim {tok}={dim} contradicts "
+                    f"{tok}={env[tok]} bound earlier in the call"
+                )
+    # donation: a donated buffer must not alias any other argument
+    for i in c.donates:
+        if i >= len(args):
+            continue
+        donated = {id(leaf) for leaf in _leaves(args[i])}
+        for j, other in enumerate(args):
+            if j == i:
+                continue
+            for leaf in _leaves(other):
+                if id(leaf) in donated:
+                    raise BoundaryError(
+                        f"{c.name}: arg {j} aliases donated arg {i} — "
+                        "the donated buffer would be read after free"
+                    )
+
+
+def boundary(*, dtypes=(), shapes=(), donates=(), check=None):
+    """Declare a jit-boundary contract (see module docstring).
+
+    ``check`` overrides the environment switch (tests use it to build
+    enforced wrappers without re-importing the world)."""
+
+    def deco(fn):
+        c = BoundaryContract(
+            name=f"{fn.__module__}.{fn.__qualname__}",
+            dtypes=tuple(dtypes),
+            shapes=tuple(shapes),
+            donates=tuple(donates),
+        )
+        REGISTRY[c.name] = c
+        enabled = checks_enabled() if check is None else check
+        if not enabled:
+            try:
+                fn.__boundary__ = c  # discoverable, still the bare fn
+            except (AttributeError, TypeError):  # pragma: no cover
+                pass
+            return fn
+
+        # positional parameter names, so keyword call sites are bound
+        # back to their contract positions — `f(state, kind=k)` must be
+        # checked exactly like `f(state, k)`
+        try:
+            pos_params = [
+                p.name
+                for p in inspect.signature(fn).parameters.values()
+                if p.kind in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                )
+            ]
+        except (ValueError, TypeError):  # pragma: no cover
+            pos_params = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            full = list(args)
+            for name in pos_params[len(args):]:
+                if name not in kwargs:
+                    break
+                full.append(kwargs[name])
+            _check_call(c, tuple(full))
+            return fn(*args, **kwargs)
+
+        wrapper.__boundary__ = c
+        return wrapper
+
+    return deco

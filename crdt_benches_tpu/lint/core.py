@@ -1,0 +1,483 @@
+"""graftlint core: file model, suppressions, rule driver, reporters.
+
+graftlint is an AST-based JAX-hygiene linter for this repository (stdlib
+``ast`` only — it must run in CI before anything heavy imports).  The
+design is deliberately small:
+
+- every ``.py`` file is parsed once into a :class:`ModuleInfo` (AST +
+  per-function facts: jit decoration, donation, boundary contracts,
+  hot-path / fence markers, suppression comments);
+- the :class:`PackageIndex` aggregates modules so rules can resolve
+  cross-module calls by name (best-effort, the repo's idiom is flat
+  enough for this to work);
+- each rule in :mod:`crdt_benches_tpu.lint.rules` is a function
+  ``rule(index) -> list[Finding]``;
+- findings carrying a same-line ``# graftlint: disable=G00X`` (or a
+  file-level ``# graftlint: disable-file=G00X``) are dropped.
+
+Marker comments (on the ``def`` line):
+
+- ``# graftlint: hot-path`` — the function is a serving hot-path root:
+  G002 walks its call graph for host syncs;
+- ``# graftlint: fence`` — the function is a DECLARED sync boundary
+  (e.g. the scheduler's boundary bucket pulls): G002 does not descend
+  into it.  Fences are the allowlist — a new sync belongs behind one, or
+  it is a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+
+# ---------------------------------------------------------------------------
+# configuration
+
+#: G002 hot-path roots that hold even on an unannotated tree (qualnames).
+DEFAULT_HOT_ROOTS = {
+    "fleet_step",
+    "DocPool.step",
+    "DocPool.macro_step",
+    "FleetScheduler.run_round",
+}
+
+#: Method names never linked by the bare-name call resolver (container /
+#: stdlib traffic would otherwise swamp the call graph).
+_GENERIC_METHODS = {
+    "append", "add", "get", "pop", "popleft", "items", "keys", "values",
+    "update", "extend", "sort", "clear", "copy", "discard", "remove",
+    "insert", "index", "count", "join", "split", "strip", "format",
+    "startswith", "endswith", "setdefault", "write", "read", "close",
+    "open", "mkdir", "exists", "unlink", "encode", "decode", "flush",
+    "reshape", "astype", "sum", "max", "min", "mean", "all", "any",
+    "fire", "pick", "event", "describe", "bit_length", "put", "take",
+    "dump", "dumps", "load", "loads",
+}
+
+#: Directories whose modules are in scope for G005 (implicit dtype) and
+#: G006 (nondeterminism in journaled paths).
+G005_DIRS = ("ops", "engine", "serve", "parallel", "traces")
+G006_DIRS = ("serve",)
+G006_FILES = ("tensorize.py",)
+
+#: Recognized dtype spellings for "an explicit dtype was passed".
+DTYPE_NAMES = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bfloat16", "bool_",
+    "complex64", "complex128",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Z0-9,\s]+)"
+)
+_MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|fence)\b")
+
+
+def dotted(e: ast.expr) -> str | None:
+    """``a.b.c`` as a string, or None for non-trivial expressions."""
+    parts = []
+    while isinstance(e, ast.Attribute):
+        parts.append(e.attr)
+        e = e.value
+    if isinstance(e, ast.Name):
+        parts.append(e.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    msg: str
+
+    def key(self):
+        return (self.path, self.line, self.rule, self.msg)
+
+
+@dataclass
+class FuncInfo:
+    """Per-function facts extracted from the decorator stack + markers."""
+
+    qualname: str  # "func" or "Class.method"
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    module: "ModuleInfo"
+    cls: str | None = None
+    jitted: bool = False
+    donate_argnums: tuple | None = None  # statically parsed, else None
+    static_argnames: tuple = ()
+    boundary: dict | None = None  # parsed @boundary(...) kwargs
+    boundary_line: int = 0
+    hot: bool = False
+    fence: bool = False
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+class ModuleInfo:
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.suppress: dict[int, set[str]] = {}
+        self.suppress_file: set[str] = set()
+        self.jnp_aliases: set[str] = set()  # names bound to jax.numpy
+        self.np_aliases: set[str] = set()  # names bound to numpy
+        self.time_aliases: set[str] = set()  # names bound to time
+        self.random_aliases: set[str] = set()  # stdlib random module
+        self.imports: dict[str, str] = {}  # local name -> dotted source
+        self.functions: dict[str, FuncInfo] = {}
+        self._scan_comments()
+        self._scan_imports()
+        self._scan_functions()
+
+    # -- comments ----------------------------------------------------------
+
+    def _scan_comments(self) -> None:
+        """Directives live in REAL comments only (tokenize, not line
+        regex): a docstring that *documents* the escape hatch must not
+        trigger it."""
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.src).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass  # ast.parse already surfaced the syntax problem
+        for i, text in self.comments.items():
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self.suppress.setdefault(i, set()).update(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self.suppress_file.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+
+    def _marker(self, lineno: int) -> str | None:
+        m = _MARKER_RE.search(self.comments.get(lineno, ""))
+        return m.group(1) if m else None
+
+    # -- imports -----------------------------------------------------------
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    name = al.asname or al.name.split(".")[0]
+                    self.imports[name] = al.name
+                    if al.name == "jax.numpy":
+                        self.jnp_aliases.add(al.asname or "jax.numpy")
+                    elif al.name == "numpy":
+                        self.np_aliases.add(al.asname or "numpy")
+                    elif al.name == "time":
+                        self.time_aliases.add(al.asname or "time")
+                    elif al.name == "random":
+                        self.random_aliases.add(al.asname or "random")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for al in node.names:
+                    local = al.asname or al.name
+                    self.imports[local] = f"{mod}.{al.name}"
+                    if mod == "jax" and al.name == "numpy":
+                        self.jnp_aliases.add(local)
+
+    # -- functions ---------------------------------------------------------
+
+    def _scan_functions(self) -> None:
+        def visit(node, cls: str | None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qual = (
+                        f"{cls}.{child.name}" if cls else child.name
+                    )
+                    self.functions[qual] = self._func_info(
+                        child, qual, cls
+                    )
+                    # nested defs are part of the enclosing body for
+                    # sync scanning; they are not indexed separately.
+
+        visit(self.tree, None)
+
+    def _func_info(self, node, qual: str, cls: str | None) -> FuncInfo:
+        fi = FuncInfo(qualname=qual, node=node, module=self, cls=cls)
+        marker = self._marker(node.lineno)
+        fi.hot = marker == "hot-path"
+        fi.fence = marker == "fence"
+        for dec in node.decorator_list:
+            self._parse_decorator(fi, dec)
+        return fi
+
+    def _parse_decorator(self, fi: FuncInfo, dec: ast.expr) -> None:
+        # @jax.jit / @jit
+        if self._is_jit_expr(dec):
+            fi.jitted = True
+            if fi.donate_argnums is None:
+                fi.donate_argnums = ()
+            return
+        if not isinstance(dec, ast.Call):
+            return
+        # @partial(jax.jit, ...) or @functools.partial(jax.jit, ...)
+        f = dec.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname == "partial" and dec.args and self._is_jit_expr(
+            dec.args[0]
+        ):
+            fi.jitted = True
+            fi.donate_argnums = ()
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    fi.donate_argnums = self._literal_tuple(kw.value)
+                elif kw.arg == "static_argnames":
+                    v = self._literal_tuple(kw.value)
+                    fi.static_argnames = v or ()
+            return
+        # @jax.jit(...) used directly as a decorator factory
+        if self._is_jit_expr(f):
+            fi.jitted = True
+            fi.donate_argnums = ()
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    fi.donate_argnums = self._literal_tuple(kw.value)
+                elif kw.arg == "static_argnames":
+                    fi.static_argnames = self._literal_tuple(kw.value) or ()
+            return
+        # @boundary(...)
+        if fname == "boundary":
+            spec: dict = {}
+            for kw in dec.keywords:
+                if kw.arg in ("dtypes", "shapes", "donates"):
+                    spec[kw.arg] = self._literal_tuple(kw.value)
+            fi.boundary = spec
+            fi.boundary_line = dec.lineno
+
+    @staticmethod
+    def _is_jit_expr(e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id == "jit"
+        return (
+            isinstance(e, ast.Attribute)
+            and e.attr == "jit"
+            and isinstance(e.value, ast.Name)
+            and e.value.id == "jax"
+        )
+
+    @staticmethod
+    def _literal_tuple(e: ast.expr):
+        """A decorator kwarg as a tuple of literals, or None when it is
+        not statically evaluable (rules then skip the comparison)."""
+        try:
+            v = ast.literal_eval(e)
+        except (ValueError, TypeError, SyntaxError):
+            return None
+        if isinstance(v, (list, tuple)):
+            return tuple(v)
+        return (v,)
+
+    # -- helpers for rules -------------------------------------------------
+
+    def is_jnp_attr(self, e: ast.expr) -> str | None:
+        """'zeros' for an expression like ``jnp.zeros`` (any alias)."""
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+            if e.value.id in self.jnp_aliases:
+                return e.attr
+        return None
+
+    def is_np_attr(self, e: ast.expr) -> str | None:
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+            if e.value.id in self.np_aliases:
+                return e.attr
+        return None
+
+    def dotted(self, e: ast.expr) -> str | None:
+        """``a.b.c`` as a string, or None for non-trivial expressions."""
+        return dotted(e)
+
+
+class PackageIndex:
+    """All parsed modules + name-based cross-module call resolution."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        for m in modules:
+            for fi in m.functions.values():
+                bare = fi.qualname.split(".")[-1]
+                self.by_name.setdefault(bare, []).append(fi)
+
+    def resolve_call(self, call: ast.Call, fi: FuncInfo) -> list[FuncInfo]:
+        """Best-effort callee resolution (see module docstring)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            m = fi.module
+            if f.id in m.functions:
+                return [m.functions[f.id]]
+            # from .sibling import helper
+            src = m.imports.get(f.id)
+            if src is not None:
+                bare = src.split(".")[-1]
+                return [
+                    g for g in self.by_name.get(bare, [])
+                    if g.cls is None
+                ]
+            return []
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                if fi.cls:
+                    own = fi.module.functions.get(f"{fi.cls}.{name}")
+                    if own is not None:
+                        return [own]
+            if name in _GENERIC_METHODS:
+                return []
+            # obj.method(...): link every same-named package function —
+            # conservative, fences/suppressions handle the rare FP.
+            return self.by_name.get(name, [])
+        return []
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+def collect_files(paths: list[str]) -> tuple[list[str], list[Finding]]:
+    """Expand paths to .py files.  A target that does not exist (or
+    names no Python file at all) is a G000 finding, NOT a silent skip —
+    a typo'd path in a CI script must fail the gate, never turn it
+    permanently green."""
+    out, errors = [], []
+    for p in paths:
+        if os.path.isdir(p):
+            n0 = len(out)
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+            if len(out) == n0:
+                errors.append(Finding(
+                    rule="G000", path=p, line=0, col=0,
+                    msg=(
+                        "lint target directory contains no .py files — "
+                        "refusing to report a clean run on nothing"
+                    ),
+                ))
+        elif os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        else:
+            errors.append(Finding(
+                rule="G000", path=p, line=0, col=0,
+                msg=(
+                    "lint target does not exist or is not a .py "
+                    "file/directory — refusing to report a clean run "
+                    "on nothing"
+                ),
+            ))
+    return out, errors
+
+
+def build_index(paths: list[str]) -> tuple[PackageIndex, list[Finding]]:
+    files, errors = collect_files(paths)
+    modules = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append(ModuleInfo(path, src))
+        except SyntaxError as e:
+            errors.append(Finding(
+                rule="G000", path=path, line=e.lineno or 0, col=0,
+                msg=f"syntax error: {e.msg}",
+            ))
+        except OSError as e:
+            errors.append(Finding(
+                rule="G000", path=path, line=0, col=0,
+                msg=f"unreadable: {e}",
+            ))
+    return PackageIndex(modules), errors
+
+
+def run_lint(paths: list[str], select: set[str] | None = None
+             ) -> list[Finding]:
+    from . import rules as _rules
+
+    index, findings = build_index(paths)
+    for rule_id, fn in _rules.RULES.items():
+        if select and rule_id not in select:
+            continue
+        findings.extend(fn(index))
+    # apply suppressions
+    by_path = {m.path: m for m in index.modules}
+    out = []
+    for f in findings:
+        if select and f.rule not in select and f.rule != "G000":
+            continue
+        m = by_path.get(f.path)
+        if m is not None:
+            if f.rule in m.suppress_file:
+                continue
+            if f.rule in m.suppress.get(f.line, ()):
+                continue
+        out.append(f)
+    out.sort(key=Finding.key)
+    # de-dup (the bare-name resolver can reach a function twice)
+    seen, uniq = set(), []
+    for f in out:
+        if f.key() not in seen:
+            seen.add(f.key())
+            uniq.append(f)
+    return uniq
+
+
+# ---------------------------------------------------------------------------
+# reporters
+
+def format_text(findings: list[Finding]) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col}: {f.rule} {f.msg}" for f in findings
+    ]
+    lines.append(
+        f"graftlint: {len(findings)} finding(s)"
+        if findings else "graftlint: clean"
+    )
+    return "\n".join(lines)
+
+
+def format_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [
+                {
+                    "rule": f.rule, "path": f.path, "line": f.line,
+                    "col": f.col, "message": f.msg,
+                }
+                for f in findings
+            ],
+            "count": len(findings),
+        },
+        indent=2,
+    )

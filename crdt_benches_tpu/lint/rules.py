@@ -1,0 +1,664 @@
+"""graftlint rules G001-G007.
+
+Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
+registered in :data:`RULES`.  Every rule is motivated by a real hazard
+this repository has already hit (see README "Static analysis" for the
+rule table and the incident each one encodes).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    DEFAULT_HOT_ROOTS,
+    DTYPE_NAMES,
+    G005_DIRS,
+    G006_DIRS,
+    G006_FILES,
+    Finding,
+    FuncInfo,
+    PackageIndex,
+    dotted,
+)
+
+_JNP_CREATORS = {
+    "array", "zeros", "ones", "empty", "full", "arange", "linspace",
+    "eye",
+}
+
+_NP_LEGACY_RANDOM = {
+    "seed", "rand", "randn", "randint", "random", "choice", "shuffle",
+    "permutation", "uniform", "normal", "sample",
+}
+
+_JOURNAL_SINKS = {
+    "round_record", "event", "write_snapshot", "tensorize_ranges",
+}
+
+
+def _in_dirs(path: str, dirs: tuple, files: tuple = ()) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(d in parts for d in dirs) or any(
+        path.endswith(f) for f in files
+    )
+
+
+def _has_dtype_arg(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return True
+    for a in call.args:
+        if isinstance(a, ast.Name) and a.id in ("bool", "int", "float"):
+            return True
+        if isinstance(a, ast.Attribute) and (
+            a.attr in DTYPE_NAMES or a.attr == "dtype"
+        ):
+            return True  # jnp.int32 / arr.dtype passed positionally
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return True
+    return False
+
+
+def _explicit_dtype_name(call: ast.Call) -> str | None:
+    """The dtype NAME a creation call passes explicitly, if literal."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            if isinstance(kw.value, ast.Attribute):
+                return kw.value.attr
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, str
+            ):
+                return kw.value.value
+            return None
+    for a in call.args:
+        if isinstance(a, ast.Attribute) and a.attr in DTYPE_NAMES:
+            return a.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# G001 — tracer leak: module-level device constants
+
+def g001_tracer_leak(index: PackageIndex) -> list[Finding]:
+    """A module-scope ``jnp.*`` constant is a DEVICE value created in
+    whatever trace context is live at first import — the historical
+    ``ops/idpos.py BIG`` bug leaked a tracer into
+    ``__graft_entry__.dryrun_multichip``; a committed module constant
+    also forces the slow dispatch path per executable launch.  Use a
+    host-side ``np.*`` scalar (identical arithmetic under jit)."""
+    out = []
+    for m in index.modules:
+        for node in ast.iter_child_nodes(m.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            hit = None
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Call):
+                    if m.is_jnp_attr(sub.func) is not None:
+                        hit = m.dotted(sub.func)
+                        break
+                    if m.dotted(sub.func) == "jax.device_put":
+                        hit = "jax.device_put"
+                        break
+            if hit is None:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            names = [
+                t.id for t in targets if isinstance(t, ast.Name)
+            ]
+            used_in = sorted({
+                fi.qualname
+                for fi in m.functions.values() if fi.jitted
+                for sub in ast.walk(fi.node)
+                if isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load) and sub.id in names
+            })
+            closure = (
+                f"; closed over by jitted {', '.join(used_in)}"
+                if used_in else ""
+            )
+            out.append(Finding(
+                rule="G001", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"module-level device constant `{' = '.join(names) or '<target>'}"
+                    f" = {hit}(...)` — created inside whatever trace "
+                    f"context is live at import (the idpos.py BIG tracer "
+                    f"leak){closure}; use a host-side np.* value"
+                ),
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G002 — host sync reachable from the serving hot path
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_SYNC_FUNCS = {"asarray", "array", "copy"}
+
+
+def _sync_findings(fi: FuncInfo, index: PackageIndex, chain: str
+                   ) -> list[Finding]:
+    m = fi.module
+    out = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+            out.append(Finding(
+                rule="G002", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"host sync `.{f.attr}()` on the serving hot path "
+                    f"({chain}); move it behind a declared fence "
+                    "(# graftlint: fence)"
+                ),
+            ))
+            continue
+        np_attr = m.is_np_attr(f)
+        if np_attr in _NP_SYNC_FUNCS:
+            out.append(Finding(
+                rule="G002", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"`np.{np_attr}(...)` device->host transfer on the "
+                    f"serving hot path ({chain}); stage with jnp/"
+                    "device_put or move behind a fence"
+                ),
+            ))
+            continue
+        if m.dotted(f) == "jax.device_get":
+            out.append(Finding(
+                rule="G002", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=f"`jax.device_get` on the serving hot path ({chain})",
+            ))
+            continue
+        if (
+            isinstance(f, ast.Name)
+            and f.id in ("int", "float", "bool")
+            and len(node.args) == 1
+        ):
+            arg = node.args[0]
+            device_like = any(
+                (isinstance(s, ast.Attribute) and s.attr == "state")
+                for s in ast.walk(arg)
+            ) or any(
+                isinstance(s, ast.Call)
+                and any(
+                    g.jitted for g in index.resolve_call(s, fi)
+                )
+                for s in ast.walk(arg)
+            )
+            if device_like:
+                out.append(Finding(
+                    rule="G002", path=m.path, line=node.lineno,
+                    col=node.col_offset,
+                    msg=(
+                        f"`{f.id}(...)` forces a device sync on the "
+                        f"serving hot path ({chain})"
+                    ),
+                ))
+    return out
+
+
+def g002_host_sync(index: PackageIndex) -> list[Finding]:
+    """Walk the call graph from the serving hot-path roots
+    (``# graftlint: hot-path`` markers + the built-in root set) and flag
+    host-synchronizing calls.  Functions marked ``# graftlint: fence``
+    are DECLARED sync boundaries (the scheduler's bucket pulls, the
+    drain fence): the walk does not descend into them."""
+    roots = [
+        fi for m in index.modules for fi in m.functions.values()
+        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
+    ]
+    out: list[Finding] = []
+    seen: set[int] = set()
+    queue: list[tuple[FuncInfo, str]] = [
+        (r, f"reached from {r.qualname}") for r in roots
+    ]
+    while queue:
+        fi, chain = queue.pop()
+        if id(fi) in seen:
+            continue
+        seen.add(id(fi))
+        if fi.fence:
+            continue
+        out.extend(_sync_findings(fi, index, chain))
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                for callee in index.resolve_call(node, fi):
+                    if id(callee) not in seen and not callee.fence:
+                        queue.append(
+                            (callee, f"{chain} -> {callee.qualname}")
+                        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G003 — recompile / version-drift hazards
+
+def g003_recompile_hazard(index: PackageIndex) -> list[Finding]:
+    """Three recompile/drift hazards: (a) ``print``/f-strings on traced
+    parameters inside a jitted body (retrace side effects, tracer
+    formatting); (b) importing ``jax.experimental.pallas.tpu`` outside
+    ``ops/pallas_compat.py`` — the jax-0.4 ``CompilerParams`` rename is
+    papered over in exactly one shim, a direct import reintroduces the
+    drift; (c) list/dict/set literals passed for a declared
+    ``static_argnames`` kwarg (unhashable statics fail or retrace)."""
+    out = []
+    for m in index.modules:
+        # (b) pre-shim pallas-TPU import
+        if not m.path.endswith("pallas_compat.py"):
+            for node in ast.walk(m.tree):
+                bad = None
+                if isinstance(node, ast.ImportFrom):
+                    if node.module == "jax.experimental.pallas" and any(
+                        al.name == "tpu" for al in node.names
+                    ):
+                        bad = "from jax.experimental.pallas import tpu"
+                    elif node.module == "jax.experimental.pallas.tpu":
+                        bad = "from jax.experimental.pallas.tpu import ..."
+                elif isinstance(node, ast.Import):
+                    if any(
+                        al.name.startswith("jax.experimental.pallas.tpu")
+                        for al in node.names
+                    ):
+                        bad = "import jax.experimental.pallas.tpu"
+                if bad:
+                    out.append(Finding(
+                        rule="G003", path=m.path, line=node.lineno,
+                        col=node.col_offset,
+                        msg=(
+                            f"`{bad}` bypasses ops/pallas_compat.py — "
+                            "the CompilerParams jax-0.4 rename shim "
+                            "lives there; import `pltpu` from the shim"
+                        ),
+                    ))
+        for fi in m.functions.values():
+            # (a) print / f-string on traced params
+            if fi.jitted:
+                params = set(fi.params) - set(
+                    fi.static_argnames or ()
+                ) - {"self"}
+                for node in ast.walk(fi.node):
+                    traced = None
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                    ):
+                        traced = [
+                            s.id for a in node.args
+                            for s in ast.walk(a)
+                            if isinstance(s, ast.Name) and s.id in params
+                        ]
+                    elif isinstance(node, ast.JoinedStr):
+                        traced = [
+                            s.id for v in node.values
+                            if isinstance(v, ast.FormattedValue)
+                            for s in ast.walk(v.value)
+                            if isinstance(s, ast.Name) and s.id in params
+                        ]
+                    if traced:
+                        out.append(Finding(
+                            rule="G003", path=m.path, line=node.lineno,
+                            col=node.col_offset,
+                            msg=(
+                                f"formatting traced value(s) "
+                                f"{sorted(set(traced))} inside jitted "
+                                f"`{fi.qualname}` — runs at trace time "
+                                "only (or leaks a tracer repr); use "
+                                "jax.debug.print"
+                            ),
+                        ))
+            # (c) unhashable literals for static kwargs at call sites
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in index.resolve_call(node, fi):
+                    statics = set(callee.static_argnames or ())
+                    if not statics:
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg in statics and isinstance(
+                            kw.value, (ast.List, ast.Dict, ast.Set)
+                        ):
+                            out.append(Finding(
+                                rule="G003", path=m.path,
+                                line=kw.value.lineno,
+                                col=kw.value.col_offset,
+                                msg=(
+                                    f"unhashable literal for static arg "
+                                    f"`{kw.arg}` of `{callee.qualname}` "
+                                    "— statics must hash stably or "
+                                    "every call recompiles/fails"
+                                ),
+                            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G004 — donated buffer referenced after the donating call
+
+def _collect_assign_lines(fn_node: ast.AST) -> dict[str, list[int]]:
+    lines: dict[str, list[int]] = {}
+    for node in ast.walk(fn_node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        for t in targets:
+            for leaf in ast.walk(t):
+                if isinstance(leaf, (ast.Name, ast.Attribute)):
+                    s = dotted(leaf)
+                    if s:
+                        lines.setdefault(s, []).append(node.lineno)
+    return lines
+
+
+def g004_donation_misuse(index: PackageIndex) -> list[Finding]:
+    """A buffer passed at a donated position is dead after the call —
+    XLA may have reused its memory.  Flag any later read of the donated
+    variable in the same function body (unless rebound first).  Donation
+    positions come from ``jax.jit(donate_argnums=...)`` and
+    ``@boundary(donates=...)``."""
+    out = []
+    for m in index.modules:
+        for fi in m.functions.values():
+            assigns = None
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callees = index.resolve_call(node, fi)
+                for callee in callees:
+                    donated = set(callee.donate_argnums or ())
+                    if callee.boundary and callee.boundary.get("donates"):
+                        donated |= set(callee.boundary["donates"])
+                    if not donated:
+                        continue
+                    offset = 0
+                    if (
+                        callee.cls
+                        and callee.params
+                        and callee.params[0] == "self"
+                        and isinstance(node.func, ast.Attribute)
+                    ):
+                        offset = 1
+                    for d in sorted(donated):
+                        i = d - offset
+                        if not 0 <= i < len(node.args):
+                            continue
+                        expr = m.dotted(node.args[i])
+                        if expr is None:
+                            continue
+                        if assigns is None:
+                            assigns = _collect_assign_lines(fi.node)
+                        rebinds = [
+                            ln for ln in assigns.get(expr, ())
+                            if ln >= node.lineno
+                        ]
+                        for read in ast.walk(fi.node):
+                            if not isinstance(
+                                read, (ast.Name, ast.Attribute)
+                            ):
+                                continue
+                            if not isinstance(
+                                getattr(read, "ctx", None), ast.Load
+                            ):
+                                continue
+                            # the donating call may span lines; its own
+                            # argument expressions are not "later" reads
+                            call_end = getattr(
+                                node, "end_lineno", node.lineno
+                            )
+                            if read.lineno <= call_end:
+                                continue
+                            if m.dotted(read) != expr:
+                                continue
+                            if any(
+                                node.lineno <= ln <= read.lineno
+                                for ln in rebinds
+                            ):
+                                continue
+                            out.append(Finding(
+                                rule="G004", path=m.path,
+                                line=read.lineno, col=read.col_offset,
+                                msg=(
+                                    f"`{expr}` read after being donated "
+                                    f"to `{callee.qualname}` (line "
+                                    f"{node.lineno}) — the buffer may "
+                                    "already be reused; rebind or copy"
+                                ),
+                            ))
+                            break  # one finding per donated arg
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G005 — implicit dtype at array creation
+
+def g005_implicit_dtype(index: PackageIndex) -> list[Finding]:
+    """``jnp.zeros/array/arange/...`` without an explicit dtype follows
+    the x64 flag and weak-type promotion — an int32-keyed kernel fed an
+    accidental int64 recompiles (or worse, silently widens a packed
+    layout).  Everything in ops/engine/serve/parallel/traces states its
+    dtype."""
+    out = []
+    for m in index.modules:
+        if not _in_dirs(m.path, G005_DIRS):
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = m.is_jnp_attr(node.func)
+            if attr not in _JNP_CREATORS:
+                continue
+            if _has_dtype_arg(node):
+                continue
+            out.append(Finding(
+                rule="G005", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"`jnp.{attr}(...)` without an explicit dtype — "
+                    "dtype follows the x64 flag / promotion rules and "
+                    "can silently recompile int32-shaped kernels"
+                ),
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G006 — nondeterminism feeding journaled paths
+
+def g006_nondeterminism(index: PackageIndex) -> list[Finding]:
+    """The write-ahead journal assumes replay parity: the same streams
+    re-produce the same tensors.  Wall-clock or unseeded randomness
+    feeding tensorization/journal records, and set-order iteration,
+    break that parity (a recovered fleet diverges byte-wise)."""
+    out = []
+    for m in index.modules:
+        if not _in_dirs(m.path, G006_DIRS, G006_FILES):
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                d = m.dotted(f) or ""
+                root = d.split(".")[0] if d else ""
+                # stdlib random module (always unseeded-global here)
+                if root in m.random_aliases:
+                    out.append(Finding(
+                        rule="G006", path=m.path, line=node.lineno,
+                        col=node.col_offset,
+                        msg=(
+                            f"stdlib `{d}(...)` in a journaled path — "
+                            "global unseeded RNG breaks replay parity; "
+                            "use np.random.default_rng(seed)"
+                        ),
+                    ))
+                # numpy legacy global RNG / unseeded default_rng
+                elif (
+                    root in m.np_aliases
+                    and d.split(".")[1:2] == ["random"]
+                ):
+                    tail = d.split(".")[-1]
+                    if tail in _NP_LEGACY_RANDOM:
+                        out.append(Finding(
+                            rule="G006", path=m.path, line=node.lineno,
+                            col=node.col_offset,
+                            msg=(
+                                f"`{d}(...)` uses numpy's GLOBAL RNG — "
+                                "journal replay parity needs a seeded "
+                                "default_rng instance"
+                            ),
+                        ))
+                    elif tail == "default_rng" and not (
+                        node.args or node.keywords
+                    ):
+                        out.append(Finding(
+                            rule="G006", path=m.path, line=node.lineno,
+                            col=node.col_offset,
+                            msg=(
+                                "`default_rng()` without a seed in a "
+                                "journaled path — recovery replay "
+                                "cannot reproduce it"
+                            ),
+                        ))
+                # wall-clock feeding a journal/tensorize sink
+                sink = (
+                    f.attr if isinstance(f, ast.Attribute)
+                    else (f.id if isinstance(f, ast.Name) else "")
+                )
+                if sink in _JOURNAL_SINKS:
+                    for a in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        for s in ast.walk(a):
+                            if (
+                                isinstance(s, ast.Call)
+                                and isinstance(s.func, ast.Attribute)
+                                and isinstance(s.func.value, ast.Name)
+                                and s.func.value.id in m.time_aliases
+                            ):
+                                out.append(Finding(
+                                    rule="G006", path=m.path,
+                                    line=s.lineno, col=s.col_offset,
+                                    msg=(
+                                        f"wall-clock `{m.dotted(s.func)}"
+                                        f"()` feeds journaled sink "
+                                        f"`{sink}` — replay cannot "
+                                        "reproduce it; journal round "
+                                        "counters instead"
+                                    ),
+                                ))
+            elif isinstance(node, ast.For):
+                it = node.iter
+                is_set = isinstance(it, ast.Set) or (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")
+                )
+                if is_set:
+                    out.append(Finding(
+                        rule="G006", path=m.path, line=it.lineno,
+                        col=it.col_offset,
+                        msg=(
+                            "iteration over a set in a journaled path — "
+                            "order is salted per process; wrap in "
+                            "sorted(...)"
+                        ),
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G007 — boundary contract cross-check
+
+def g007_boundary_contract(index: PackageIndex) -> list[Finding]:
+    """Static cross-checks of the ``@boundary`` registry: the declared
+    ``donates`` must equal the ``donate_argnums`` of the jit wrapper in
+    the same decorator stack, and call sites passing an explicit literal
+    dtype must match the declared one."""
+    out = []
+    for m in index.modules:
+        for fi in m.functions.values():
+            if fi.boundary is None:
+                continue
+            declared = fi.boundary.get("donates")
+            if (
+                fi.jitted
+                and declared is not None
+                and fi.donate_argnums is not None
+                and set(declared) != set(fi.donate_argnums)
+            ):
+                out.append(Finding(
+                    rule="G007", path=m.path, line=fi.boundary_line,
+                    col=0,
+                    msg=(
+                        f"`{fi.qualname}`: @boundary donates="
+                        f"{tuple(declared)} but jax.jit donate_argnums="
+                        f"{tuple(fi.donate_argnums)} — the contract "
+                        "table lies about buffer lifetime"
+                    ),
+                ))
+    # call-site dtype literals vs declared contract
+    for m in index.modules:
+        for fi in m.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in index.resolve_call(node, fi):
+                    spec = callee.boundary
+                    if not spec or not spec.get("dtypes"):
+                        continue
+                    dtypes = spec["dtypes"]
+                    offset = 1 if (
+                        callee.cls
+                        and callee.params
+                        and callee.params[0] == "self"
+                        and isinstance(node.func, ast.Attribute)
+                    ) else 0
+                    for j, a in enumerate(node.args):
+                        k = j + offset
+                        if k >= len(dtypes) or dtypes[k] is None:
+                            continue
+                        if not isinstance(a, ast.Call):
+                            continue
+                        if m.is_jnp_attr(a.func) is None and (
+                            m.is_np_attr(a.func) is None
+                        ):
+                            continue
+                        got = _explicit_dtype_name(a)
+                        if got is not None and got != dtypes[k]:
+                            out.append(Finding(
+                                rule="G007", path=m.path,
+                                line=a.lineno, col=a.col_offset,
+                                msg=(
+                                    f"arg {k} of `{callee.qualname}` "
+                                    f"built as {got} but the boundary "
+                                    f"contract declares {dtypes[k]}"
+                                ),
+                            ))
+    return out
+
+
+RULES = {
+    "G001": g001_tracer_leak,
+    "G002": g002_host_sync,
+    "G003": g003_recompile_hazard,
+    "G004": g004_donation_misuse,
+    "G005": g005_implicit_dtype,
+    "G006": g006_nondeterminism,
+    "G007": g007_boundary_contract,
+}

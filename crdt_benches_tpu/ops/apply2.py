@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from .resolve import ORIGIN_BATCH, ResolvedBatch
 
 LANE = 128
@@ -307,6 +308,7 @@ def _mxu_spread(idx, vals_7bit_chunks, C: int, cb: int = 512):
     return _mxu_spread_tc(idx, vals_7bit_chunks, C, cb=cb)[0]
 
 
+@boundary(dtypes=("int32", None, "int32"))
 def apply_batch3(
     state: PackedState, resolved: ResolvedBatch, slots: jax.Array
 ) -> PackedState:

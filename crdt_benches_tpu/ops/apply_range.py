@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from .apply2 import (
     LANE,
     PackedState,
@@ -94,6 +95,7 @@ def extract_range_tokens(ttype, ta, tch, tlen, v0):
     return live, gvis, cumlen
 
 
+@boundary(dtypes=("int32", "int32", "int32"))
 def apply_range_batch(
     state: PackedState,
     tokens,  # (ttype, ta, tch, tlen) int32[R, T]; TINS ta = slot0

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..traces.tensorize import DELETE, INSERT
 
 # Token types.
@@ -76,6 +77,7 @@ class ResolvedBatch(NamedTuple):
     #                       name every delete's target element.
 
 
+@boundary(dtypes=("int32", "int32", "int32"), shapes=("B", "B", None))
 def resolve_batch(kind: jax.Array, pos: jax.Array, v0: jax.Array) -> ResolvedBatch:
     """Resolve one batch of unit ops against a document with ``v0`` visible
     chars.  ``kind``/``pos``: int32[B].  Fully jit/vmap-compatible."""

@@ -33,8 +33,7 @@ import functools
 import jax
 import numpy as np
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from .pallas_compat import pl, pltpu  # CompilerParams shim for jax 0.4
 
 from ..traces.tensorize import DELETE, INSERT
 from .resolve import (

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..traces.tensorize import DELETE, INSERT
 from .resolve import FREE, RUN, TINS
 
@@ -192,6 +193,10 @@ def resolve_ranges_scan(kind, pos, rlen, slot0, v0):
     return (ttype, ta, tch, tlen), (dlo, dhi, dn), nused
 
 
+@boundary(
+    dtypes=("int32", "int32", "int32", "int32", "int32"),
+    shapes=("R B", "R B", "R B", "R B", "R"),
+)
 def resolve_ranges_rows(kind, pos, rlen, slot0, v0):
     """Per-row fleet form: kind/pos/rlen/slot0 int32[R, B] (a different
     op batch per document lane), v0 int32[R].  Returns token arrays

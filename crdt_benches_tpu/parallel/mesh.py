@@ -140,7 +140,9 @@ def sharded_merge_and_converge(
             st = init_down_state(capacity, n_base)
             return merge_oplogs(st, *union, batch=batch)
 
-        states = jax.vmap(integrate)(jnp.arange(lam.shape[0]))
+        states = jax.vmap(integrate)(
+            jnp.arange(lam.shape[0], dtype=jnp.int32)
+        )
         digests = jax.vmap(
             lambda st: doc_digest(st.order, st.visible, st.length, chars)
         )(states)

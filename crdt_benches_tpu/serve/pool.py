@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..lint.boundary import boundary
 from ..ops.apply2 import LANE, PackedState, apply_batch3
 from ..ops.apply_range import apply_range_batch
 from ..ops.resolve import resolve_batch
@@ -60,6 +61,11 @@ from ..utils.checkpoint import (
 )
 
 
+@boundary(
+    dtypes=("int32", "int32", "int32", "int32"),
+    shapes=(None, "R B", "R B", "R B"),
+    donates=(0,),
+)
 @partial(jax.jit, donate_argnums=(0,))
 def fleet_step(state: PackedState, kind, pos, slot) -> PackedState:
     """One UNIT-op batch per resident doc (the pre-macro hot path, kept
@@ -302,7 +308,7 @@ class DocPool:
 
     # ---- row movement (host round-trips: off the macro hot path) ----
 
-    def _pull_row(self, rec: DocRecord) -> PackedState:
+    def _pull_row(self, rec: DocRecord) -> PackedState:  # graftlint: fence
         b = self.buckets[rec.cls]
         doc, length, nvis = _read_row(b.state, rec.row)
         return PackedState(
@@ -338,8 +344,9 @@ class DocPool:
     def _spool_path(self, doc_id: int) -> str:
         return os.path.join(self.spool_dir, f"doc{doc_id}.npz")
 
-    def spool_save(self, doc_id: int, doc_row: np.ndarray, length: int,
-                   nvis: int) -> str:
+    def spool_save(  # graftlint: fence
+            self, doc_id: int, doc_row: np.ndarray, length: int,
+            nvis: int) -> str:
         """Write one doc's checkpoint to the spool.  Only the used
         ``length`` prefix is stored (the tail is the constant
         beyond-length coding ``2`` that ``_install`` re-pads), and the
@@ -357,7 +364,7 @@ class DocPool:
         )
         return path
 
-    def evict(self, doc_id: int) -> str:
+    def evict(self, doc_id: int) -> str:  # graftlint: fence
         """Round-trip a resident doc out to the checkpoint spool
         (``utils/checkpoint.py`` .npz) and free its row."""
         rec = self.docs[doc_id]
@@ -413,7 +420,7 @@ class DocPool:
 
     # ---- boundary bulk movement (one sync, one upload per class) ----
 
-    def pull_bucket(self, cls: int):
+    def pull_bucket(self, cls: int):  # graftlint: fence
         """Host snapshot of a whole bucket (doc, length, nvis as numpy).
         SYNCS with any in-flight macro step — this is the forced
         boundary the scheduler pays only when rows actually move."""
@@ -507,6 +514,10 @@ class DocPool:
 
         return jax.jit(fn, donate_argnums=(0,))
 
+    @boundary(
+        dtypes=(None, None, "int32", "int32", "int32", "int32"),
+        shapes=(None, None, "K R B", "K R B", "K R B", "K R B"),
+    )
     def macro_step(self, cls: int, kind: np.ndarray, pos: np.ndarray,
                    rlen: np.ndarray, slot0: np.ndarray, nbits: int) -> bool:
         """ONE async dispatch applying K staged rounds to class ``cls``:
@@ -531,7 +542,7 @@ class DocPool:
         b.steps += K
         return fresh
 
-    def block(self) -> None:
+    def block(self) -> None:  # graftlint: fence
         """Fence all outstanding bucket steps (honest drain timing)."""
         for b in self.buckets.values():
             b.state.doc.block_until_ready()

@@ -734,7 +734,7 @@ class FleetScheduler:
             for d, row in self.pool.residents(cls)
         ]
 
-    def _fire_spool_fault(self, plan: _Plan) -> None:
+    def _fire_spool_fault(self, plan: _Plan) -> None:  # graftlint: fence
         """Corrupt/truncate an eviction spool on disk.  Prefers an
         existing spool of a doc with pending ops (its restore — and so
         the detection — is guaranteed); with none live, tears a spool as
@@ -795,7 +795,7 @@ class FleetScheduler:
                 ops=shed, reason=reason[:120],
             )
 
-    def _heal_spool(self, doc_id: int, cls: int, err: str):
+    def _heal_spool(self, doc_id: int, cls: int, err: str):  # graftlint: fence
         """A spool failed its integrity check on restore: rebuild the
         doc's row at its applied cursor from the last snapshot base (or
         from scratch — streams are deterministic) through the macro
@@ -844,7 +844,8 @@ class FleetScheduler:
         finally:
             self._bases.release()  # don't pin snapshot arrays post-heal
 
-    def _recover_class(self, cls: int, plan: _Plan, ev) -> None:
+    def _recover_class(  # graftlint: fence
+            self, cls: int, plan: _Plan, ev) -> None:
         """Device-state loss mid-macro-round: the class's bucket is gone.
         This round's staged ops for the class never became durable —
         their lanes are dropped un-advanced (the WAL already recorded
@@ -935,7 +936,7 @@ class FleetScheduler:
 
     # ---- boundary execution (the only device syncs) ----
 
-    def _execute_moves(self, plan: _Plan) -> None:
+    def _execute_moves(self, plan: _Plan) -> None:  # graftlint: fence
         """Apply the plan's row movement: pull affected buckets once
         (syncing with any in-flight macro step), write eviction spools,
         compose installs on host, upload each touched bucket once.  A
@@ -1054,7 +1055,7 @@ class FleetScheduler:
         self.round = plan.base_round + max(plan.k_eff.values())
         self._n_rounds += 1
 
-    def _maybe_snapshot(self) -> None:
+    def _maybe_snapshot(self) -> None:  # graftlint: fence
         """Periodic fleet snapshot barrier (journal mode): pull every
         bucket once and persist the consistent set.  The barrier is a
         forced sync — its round is flagged so steady-state latency

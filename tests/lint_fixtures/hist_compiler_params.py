@@ -1,0 +1,12 @@
+"""Historical bug 2 (minimized): pre-shim CompilerParams drift.  jax
+<= 0.4 names the Pallas-TPU params class ``TPUCompilerParams``; the
+rename to ``CompilerParams`` landed in 0.5.  Importing the tpu namespace
+directly ties the module to whichever jax happens to be installed — the
+repo's kernels broke exactly this way until PR 1 centralized the import
+behind ops/pallas_compat.py (which pins the shim in ONE place)."""
+
+from jax.experimental.pallas import tpu as pltpu  # expect: G003
+
+
+def kernel_params(dims):
+    return pltpu.CompilerParams(dimension_semantics=dims)

@@ -1,0 +1,18 @@
+"""Historical bug 1 (minimized): the ops/idpos.py module-level device
+constant.  ``BIG`` is created at import time — if the first import
+happens inside a live trace (the serve runner imports engines lazily
+from jitted regions), the "constant" is a TRACER, and every @jit that
+closes over it dies with a leaked-tracer error in a completely different
+stack (__graft_entry__.dryrun_multichip was the victim).  Fixed in PR 1
+by making it a host-side np.int32."""
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.int32(2**30)  # expect: G001
+
+
+@jax.jit
+def level_shift(sub, p):
+    # BIG closed over by a jitted body — the leak vector
+    return jnp.where(sub <= p, sub, BIG)

@@ -1,0 +1,14 @@
+"""Seeded G001: module-scope device arrays, with and without a jitted
+closure (both are flagged — the committed buffer alone forces the slow
+dispatch path per executable launch on the axon tunnel)."""
+
+import jax
+import jax.numpy as jnp
+
+PAD_ROW = jnp.zeros(128, jnp.int32)  # expect: G001
+SENTINEL = jnp.int32(-1)  # expect: G001
+
+
+@jax.jit
+def mask_tail(doc):
+    return jnp.where(doc < 0, SENTINEL, doc)

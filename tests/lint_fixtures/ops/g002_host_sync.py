@@ -1,0 +1,21 @@
+"""Seeded G002: a host sync buried two calls deep under a hot-path
+root.  ``macro_dispatch`` is the declared hot path; ``_occupancy``
+looks like innocent bookkeeping but ``.item()`` fences the device —
+exactly the class of stray sync that melted the round-loop engine."""
+
+import numpy as np
+
+
+def _occupancy(lanes):
+    return lanes.sum().item()  # expect: G002
+
+
+def _plan_round(state, lanes):
+    depth = _occupancy(lanes)
+    host_view = np.asarray(state.doc)  # expect: G002
+    return depth, host_view
+
+
+def macro_dispatch(state, lanes):  # graftlint: hot-path
+    depth, view = _plan_round(state, lanes)
+    return depth, view

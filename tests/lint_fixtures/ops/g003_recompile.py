@@ -1,0 +1,19 @@
+"""Seeded G003: tracer formatting inside a jitted body (runs at trace
+time only — or leaks a tracer repr into logs), and an unhashable
+literal passed for a declared static argument (fails or retraces every
+call)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("tiles",))
+def apply_tiles(doc, shift, *, tiles=4):
+    print("applying shift", shift)  # expect: G003
+    return doc + shift * tiles
+
+
+def run(doc, shift):
+    return apply_tiles(doc, shift, tiles=[4, 8])  # expect: G003

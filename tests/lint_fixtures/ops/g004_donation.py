@@ -1,0 +1,19 @@
+"""Seeded G004: a donated buffer read after the donating call.  With
+``donate_argnums=(0,)`` XLA may reuse ``state``'s memory for the
+output; the later ``state.sum()`` reads a dead buffer (on TPU this is
+garbage, on CPU it "works" — the worst kind of portability bug)."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def fold(state, ops):
+    return state + ops
+
+
+def drain(state, ops):
+    out = fold(state, ops)
+    checksum = state.sum()  # expect: G004
+    return out, checksum

@@ -1,0 +1,13 @@
+"""Seeded G005: array creation without an explicit dtype.  Under
+JAX_ENABLE_X64 (or a future default flip) these become int64/float64,
+silently recompiling every int32-keyed kernel downstream — and the
+packed doc layout assumes 32-bit lanes."""
+
+import jax.numpy as jnp
+
+
+def staging_buffers(rows, batch):
+    kind = jnp.zeros((rows, batch))  # expect: G005
+    lanes = jnp.arange(rows)  # expect: G005
+    ok = jnp.zeros((rows, batch), jnp.int32)  # explicit: clean
+    return kind, lanes, ok

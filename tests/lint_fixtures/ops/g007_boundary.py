@@ -1,0 +1,15 @@
+"""Seeded G007: the @boundary contract lies about donation.  The
+registry says nothing is donated, the jit wrapper donates arg 0 — a
+caller trusting the table would keep using the buffer."""
+
+from functools import partial
+
+import jax
+
+from crdt_benches_tpu.lint.boundary import boundary
+
+
+@boundary(dtypes=("int32",), donates=())  # expect: G007
+@partial(jax.jit, donate_argnums=(0,))
+def entry(doc):
+    return doc * 2

@@ -1,0 +1,22 @@
+"""The suppression escape hatch: every violation here carries a
+same-line ``graftlint: disable=G00X`` comment (or is covered by the
+file-wide comment directive below) and the file must lint CLEAN —
+tests pin the contract that suppressions are honored exactly, and that
+they only work as REAL comments (this docstring mentioning the
+directive does not count)."""
+
+# graftlint: disable-file=G005
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.int32(2**30)  # graftlint: disable=G001
+
+
+@jax.jit
+def shift(x):
+    return x + BIG
+
+
+def make(n):
+    return jnp.zeros((n, 4))  # covered by the file-wide G005 disable

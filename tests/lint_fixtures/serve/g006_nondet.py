@@ -1,0 +1,25 @@
+"""Seeded G006: nondeterminism feeding journaled paths.  Recovery
+replays the journal assuming the same inputs re-produce the same
+tensors; wall-clock, unseeded RNGs, and set iteration order all break
+byte parity between the original run and its replay."""
+
+import random
+import time
+
+import numpy as np
+
+
+def pick_victim(doc_ids):
+    return random.choice(doc_ids)  # expect: G006
+
+
+def shuffle_lanes(lanes):
+    rng = np.random.default_rng()  # expect: G006
+    np.random.shuffle(lanes)  # expect: G006
+    return rng, lanes
+
+
+def journal_round(journal, lanes):
+    journal.round_record(time.time(), lanes)  # expect: G006
+    for lane in {1, 2, 3}:  # expect: G006
+        journal.event("lane", lane=lane)

@@ -1,0 +1,211 @@
+"""jit-boundary contract runtime enforcement (lint/boundary.py).
+
+Covers the satellite's three claims: a wrong-dtype call and an
+aliased-donation call are caught under CRDT_BENCH_CHECK_BOUNDARIES=1;
+with the flag unset the decorator is a NO-OP wrapper (the identical
+function object — asserted directly and via a timing smoke)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import NamedTuple
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.lint.boundary import (
+    REGISTRY,
+    BoundaryError,
+    boundary,
+    boundary_table,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+_ENV = "CRDT_BENCH_CHECK_BOUNDARIES"
+
+
+# ---- enforcement under the env flag ---------------------------------------
+
+def test_wrong_dtype_caught_under_env(monkeypatch):
+    monkeypatch.setenv(_ENV, "1")
+
+    @boundary(dtypes=("int32", "int32"))
+    def f(kind, pos):
+        return kind
+
+    f(np.zeros(4, np.int32), np.zeros(4, np.int32))
+    with pytest.raises(BoundaryError, match="dtype"):
+        f(np.zeros(4, np.float32), np.zeros(4, np.int32))
+
+
+def test_aliased_donation_caught_under_env(monkeypatch):
+    monkeypatch.setenv(_ENV, "1")
+
+    @boundary(donates=(0,))
+    def g(state, ops):
+        return state
+
+    x = np.zeros(8, np.int32)
+    g(x, x.copy())  # distinct buffers: fine
+    with pytest.raises(BoundaryError, match="alias"):
+        g(x, x)  # the donated buffer IS the other argument
+
+
+def test_keyword_args_bound_to_contract_positions(monkeypatch):
+    """`f(state, kind=k)` is checked exactly like `f(state, k)` —
+    keyword call sites must not bypass enforcement."""
+    monkeypatch.setenv(_ENV, "1")
+
+    @boundary(dtypes=("int32", "int32"), donates=(0,))
+    def f(state, kind):
+        return state
+
+    s = np.zeros(4, np.int32)
+    f(s, kind=np.zeros(4, np.int32))
+    with pytest.raises(BoundaryError, match="dtype"):
+        f(s, kind=np.zeros(4, np.float32))
+    with pytest.raises(BoundaryError, match="alias"):
+        f(s, kind=s)
+
+
+def test_pytree_state_leaves_checked(monkeypatch):
+    monkeypatch.setenv(_ENV, "1")
+
+    class State(NamedTuple):
+        doc: np.ndarray
+        length: np.ndarray
+
+    @boundary(dtypes=("int32",), donates=(0,))
+    def step(state):
+        return state
+
+    ok = State(np.zeros((2, 8), np.int32), np.zeros(2, np.int32))
+    step(ok)
+    bad = State(np.zeros((2, 8), np.int32), np.zeros(2, np.float64))
+    with pytest.raises(BoundaryError, match="dtype"):
+        step(bad)
+    # aliased pytree leaf inside another arg
+    @boundary(donates=(0,))
+    def step2(state, extra):
+        return state
+
+    with pytest.raises(BoundaryError, match="alias"):
+        step2(ok, ok.doc)
+
+
+def test_shape_symbols_bind_across_args():
+    @boundary(shapes=("R B", "R"), check=True)
+    def h(ops, v0):
+        return ops
+
+    h(np.zeros((3, 4), np.int32), np.zeros(3, np.int32))
+    with pytest.raises(BoundaryError, match="contradicts"):
+        h(np.zeros((3, 4), np.int32), np.zeros(5, np.int32))
+    with pytest.raises(BoundaryError, match="rank"):
+        h(np.zeros(3, np.int32), np.zeros(3, np.int32))
+
+
+# ---- zero overhead when unset ---------------------------------------------
+
+def test_identity_when_unset(monkeypatch):
+    monkeypatch.delenv(_ENV, raising=False)
+
+    def raw(x):
+        return x
+
+    decorated = boundary(dtypes=("int32",), donates=(0,))(raw)
+    assert decorated is raw  # literally no wrapper
+    assert decorated.__boundary__.donates == (0,)
+
+
+def test_noop_timing_smoke(monkeypatch):
+    """The production path must not grow a per-call wrapper: with the
+    flag unset, calling the decorated function costs the same as the
+    raw one (identity makes this exact; the timing bound is a tripwire
+    should the identity shortcut ever be lost)."""
+    monkeypatch.delenv(_ENV, raising=False)
+
+    def raw(x):
+        return x + 1
+
+    decorated = boundary(dtypes=(None,))(raw)
+    N = 50_000
+    t0 = time.perf_counter()
+    for _ in range(N):
+        raw(1)
+    t_raw = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(N):
+        decorated(1)
+    t_dec = time.perf_counter() - t0
+    assert t_dec < max(2.5 * t_raw, t_raw + 0.05), (t_raw, t_dec)
+
+
+# ---- the registry ----------------------------------------------------------
+
+def test_registry_covers_the_public_entry_points():
+    import crdt_benches_tpu.engine.downstream  # noqa: F401
+    import crdt_benches_tpu.engine.downstream_range  # noqa: F401
+    import crdt_benches_tpu.engine.merge  # noqa: F401
+    import crdt_benches_tpu.engine.merge_range  # noqa: F401
+    import crdt_benches_tpu.engine.replay  # noqa: F401
+    import crdt_benches_tpu.engine.replay_range  # noqa: F401
+    import crdt_benches_tpu.serve.pool  # noqa: F401
+
+    expected = {
+        "crdt_benches_tpu.serve.pool.fleet_step",
+        "crdt_benches_tpu.serve.pool.DocPool.macro_step",
+        "crdt_benches_tpu.ops.apply2.apply_batch3",
+        "crdt_benches_tpu.ops.apply_range.apply_range_batch",
+        "crdt_benches_tpu.ops.resolve.resolve_batch",
+        "crdt_benches_tpu.ops.resolve_range_scan.resolve_ranges_rows",
+        "crdt_benches_tpu.engine.replay.replay_batches",
+        "crdt_benches_tpu.engine.replay_range.replay_ranges",
+        "crdt_benches_tpu.engine.merge.merge_oplogs_packed",
+        "crdt_benches_tpu.engine.merge_range.merge_runlogs",
+        "crdt_benches_tpu.engine.downstream.apply_updates5",
+        "crdt_benches_tpu.engine.downstream_range.apply_range_updates5",
+    }
+    assert expected <= set(REGISTRY)
+    table = boundary_table()
+    assert table["crdt_benches_tpu.serve.pool.fleet_step"]["donates"] == [0]
+
+
+# ---- end to end: a REAL entry point under the env flag ---------------------
+
+def test_real_entry_enforced_in_subprocess():
+    """fleet_step is decorated at import time, so flipping the env var
+    needs a fresh interpreter: call it with an aliased donated buffer
+    and with a wrong dtype; both must raise BoundaryError."""
+    code = """
+import numpy as np
+from crdt_benches_tpu.lint.boundary import BoundaryError
+from crdt_benches_tpu.ops.apply2 import init_state3
+from crdt_benches_tpu.serve.pool import fleet_step
+
+state = init_state3(2, 128, n_init=1)
+k = np.zeros((2, 4), np.int32)
+try:
+    fleet_step(state, k.astype(np.float32), k, k)
+    raise SystemExit("wrong dtype NOT caught")
+except BoundaryError:
+    pass
+try:
+    fleet_step(state, state.doc, state.doc, state.doc)
+    raise SystemExit("aliased donation NOT caught")
+except BoundaryError:
+    pass
+print("ENFORCED_OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[_ENV] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ENFORCED_OK" in proc.stdout

@@ -1,0 +1,167 @@
+"""graftlint regression tests: the fixture corpus is flagged exactly
+(rule id + line), the real package lints clean, suppressions are
+honored, and the CLI carries the gate in its exit code."""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from crdt_benches_tpu.lint import format_json, run_lint
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lint_fixtures"
+PACKAGE = REPO / "crdt_benches_tpu"
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*(G\d{3})")
+
+
+def expected_markers(path: Path) -> set[tuple[str, int]]:
+    out = set()
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                out.add((m.group(1), i))
+    return out
+
+
+FIXTURE_FILES = sorted(
+    p for p in FIXTURES.glob("**/*.py")
+)
+
+
+def test_corpus_is_nonempty():
+    assert len(FIXTURE_FILES) >= 8
+
+
+@pytest.mark.parametrize(
+    "path", FIXTURE_FILES, ids=lambda p: p.relative_to(FIXTURES).as_posix()
+)
+def test_fixture_flagged_exactly(path: Path):
+    """Every `# expect: G00X` line is flagged with that rule — and
+    NOTHING else fires (false positives in the corpus are bugs too)."""
+    expected = expected_markers(path)
+    findings = run_lint([str(path)])
+    got = {(f.rule, f.line) for f in findings}
+    assert got == expected, (
+        f"{path.name}: expected {sorted(expected)}, got {sorted(got)}\n"
+        + "\n".join(f"  {f.rule} L{f.line}: {f.msg}" for f in findings)
+    )
+
+
+def test_every_rule_has_a_detection_case():
+    covered = set()
+    for p in FIXTURE_FILES:
+        covered |= {r for r, _ in expected_markers(p)}
+    assert {
+        "G001", "G002", "G003", "G004", "G005", "G006", "G007"
+    } <= covered
+
+
+def test_historical_bugs_caught_by_the_right_rule():
+    """The two bugs this linter exists for: the idpos tracer leak is a
+    G001, the pre-shim CompilerParams drift is a G003."""
+    leak = run_lint([str(FIXTURES / "hist_idpos_tracer_leak.py")])
+    assert any(f.rule == "G001" for f in leak)
+    drift = run_lint([str(FIXTURES / "hist_compiler_params.py")])
+    assert any(f.rule == "G003" for f in drift)
+
+
+def test_suppression_escape_hatch():
+    findings = run_lint([str(FIXTURES / "ops" / "suppressed_clean.py")])
+    assert findings == []
+
+
+def test_real_package_lints_clean():
+    findings = run_lint([str(PACKAGE)])
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.msg}" for f in findings
+    )
+
+
+def test_select_filters_rules():
+    path = str(FIXTURES / "ops" / "g002_host_sync.py")
+    only_g5 = run_lint([path], select={"G005"})
+    assert only_g5 == []
+    only_g2 = run_lint([path], select={"G002"})
+    assert {f.rule for f in only_g2} == {"G002"}
+
+
+def test_missing_target_fails_the_gate(tmp_path):
+    """A typo'd path must FAIL lint, never report clean on nothing —
+    otherwise a renamed package turns the CI gate permanently green."""
+    findings = run_lint([str(tmp_path / "no_such_dir")])
+    assert findings and findings[0].rule == "G000"
+    findings = run_lint([str(tmp_path / "no_such_file.py")])
+    assert findings and findings[0].rule == "G000"
+    empty = tmp_path / "empty_pkg"
+    empty.mkdir()
+    findings = run_lint([str(empty)])  # exists, but holds no .py at all
+    assert findings and findings[0].rule == "G000"
+    proc = _cli("definitely_not_a_real_path")
+    assert proc.returncode == 1
+
+
+def test_docstring_text_is_not_a_suppression(tmp_path):
+    """Only real comments carry directives: a module that *documents*
+    the escape hatch in its docstring must not trigger it."""
+    mod = tmp_path / "ops" / "doc_mention.py"
+    mod.parent.mkdir()
+    mod.write_text(
+        '"""Suppress G001 findings with `# graftlint: disable-file=G001`\n'
+        'on any line of the file."""\n'
+        "import jax.numpy as jnp\n"
+        "BIG = jnp.int32(7)\n"
+    )
+    findings = run_lint([str(mod)])
+    assert {f.rule for f in findings} == {"G001"}
+
+
+def test_json_reporter_roundtrips():
+    findings = run_lint([str(FIXTURES / "ops" / "g004_donation.py")])
+    blob = json.loads(format_json(findings))
+    assert blob["count"] == len(findings) > 0
+    assert blob["findings"][0]["rule"] == "G004"
+
+
+def _cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "crdt_benches_tpu.lint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exit_codes():
+    """The CI contract: nonzero on any finding, zero on the shipped
+    tree — graftlint is pure-AST so this spawns fast (no jax import)."""
+    clean = _cli("crdt_benches_tpu")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    for fixture in FIXTURE_FILES:
+        if fixture.name in ("suppressed_clean.py",):
+            continue
+        dirty = _cli(str(fixture))
+        assert dirty.returncode == 1, (
+            f"{fixture.name}: expected exit 1\n{dirty.stdout}"
+        )
+
+
+def test_lint_sh_gate():
+    """tools/lint.sh: exit 0 on the shipped tree, nonzero on a
+    fixture."""
+    ok = subprocess.run(
+        ["bash", "tools/lint.sh"], cwd=REPO,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        ["bash", "tools/lint.sh",
+         str(FIXTURES / "hist_idpos_tracer_leak.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert bad.returncode != 0

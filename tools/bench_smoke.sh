@@ -20,6 +20,11 @@
 # never clobber committed headline numbers.
 set -euo pipefail
 
+# Stage 0: the fast lint gate (graftlint + ruff-if-installed, sub-10s)
+# — a hygiene regression fails the bench smoke before any fleet spins
+# up.  See tools/lint.sh for the suppression escape hatch.
+bash "$(dirname "$0")/lint.sh" || { echo "bench_smoke: lint gate failed" >&2; exit 1; }
+
 family="serve"
 while [ $# -gt 0 ]; do
   case "$1" in
