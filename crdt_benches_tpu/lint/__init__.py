@@ -19,14 +19,26 @@ from .core import (  # noqa: F401
     format_text,
     run_lint,
 )
+from .sanitizer import (  # noqa: F401
+    UndeclaredSyncError,
+    fence,
+    fenced,
+    hot_path,
+    sanitizing,
+)
 
 __all__ = [
     "REGISTRY",
     "BoundaryContract",
     "BoundaryError",
+    "UndeclaredSyncError",
     "boundary",
     "boundary_table",
     "checks_enabled",
+    "fence",
+    "fenced",
+    "hot_path",
+    "sanitizing",
     "Finding",
     "format_json",
     "format_text",

@@ -2,8 +2,24 @@
 
     python -m crdt_benches_tpu.lint [paths...] [--format text|json]
                                     [--select G001,G002] [--boundaries]
+                                    [--changed] [--fix]
+                                    [--sync-artifact bench.json]
 
 Exits nonzero when any finding survives suppression (CI gates on this).
+
+``--changed`` lints only the .py files touched in the working tree
+(``git diff --name-only HEAD`` + untracked), the pre-commit fast path —
+no changed Python files is a clean exit, not a G000 (nothing was
+skipped, there was nothing to check).
+
+``--fix`` applies the G005 implicit-dtype autofixer (lint/fix.py) to
+the targets, then lints what remains; refused sites are reported and
+still fail the gate.
+
+``--sync-artifact`` hands G011 a serve bench artifact whose
+``boundary_syncs`` block is the runtime fence ground truth (dead
+declared fences / unattributed runtime fences become findings).
+
 ``--boundaries`` dumps the jit-boundary contract registry as JSON by
 importing the package modules that declare them (the only mode that
 imports anything heavy; plain linting is pure-AST and jax-free).
@@ -13,9 +29,57 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from .core import format_json, format_text, run_lint
+
+
+def changed_py_files() -> list[str] | None:
+    """Working-tree .py changes vs HEAD (tracked mods + untracked), with
+    the intentionally-dirty fixture corpus excluded.  None = git failed
+    (not a repo / no HEAD) — the caller falls back to a full lint rather
+    than silently checking nothing.  git emits TOPLEVEL-relative names,
+    so they are resolved against the toplevel — running from a
+    subdirectory must not silently drop (and skip linting) every file
+    outside it."""
+
+    def git(*args) -> subprocess.CompletedProcess | None:
+        try:
+            proc = subprocess.run(
+                ["git", *args], capture_output=True, text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc if proc.returncode == 0 else None
+
+    top = git("rev-parse", "--show-toplevel")
+    if top is None or not top.stdout.strip():
+        return None
+    root = top.stdout.strip()
+    files: list[str] = []
+    for cmd in (
+        ("diff", "--name-only", "HEAD", "--"),
+        ("ls-files", "--others", "--exclude-standard"),
+    ):
+        proc = git(*cmd)
+        if proc is None:
+            return None
+        files.extend(
+            ln.strip() for ln in proc.stdout.splitlines() if ln.strip()
+        )
+    out = []
+    for f in dict.fromkeys(files):  # de-dup, keep order
+        if not f.endswith(".py"):
+            continue
+        if "lint_fixtures" in f.replace("\\", "/").split("/"):
+            continue
+        path = os.path.join(root, f)
+        if os.path.isfile(path):  # deleted files have nothing to lint
+            out.append(path)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -28,6 +92,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--select", default="",
         help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only .py files changed vs HEAD (plus untracked)",
+    )
+    ap.add_argument(
+        "--fix", action="store_true",
+        help="apply the G005 implicit-dtype autofixer, then lint",
+    )
+    ap.add_argument(
+        "--sync-artifact", default=None, metavar="JSON",
+        help="serve bench artifact for the G011 fence-cost cross-check",
     )
     ap.add_argument(
         "--boundaries", action="store_true",
@@ -54,10 +130,34 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(boundary_table(), indent=2))
         return 0
 
+    paths = args.paths
+    if args.changed:
+        changed = changed_py_files()
+        if changed is None:
+            print(
+                "graftlint: --changed needs a git worktree; "
+                "linting the full targets instead",
+                file=sys.stderr,
+            )
+        elif not changed:
+            print("graftlint: no changed python files")
+            return 0
+        else:
+            paths = changed
+
+    if args.fix:
+        from .fix import fix_g005
+
+        for r in fix_g005(paths):
+            verdict = "fixed" if r.applied else "NOT fixed"
+            print(f"{r.path}:{r.line}: G005 {verdict}: {r.detail}")
+
     select = {
         s.strip() for s in args.select.split(",") if s.strip()
     } or None
-    findings = run_lint(args.paths, select=select)
+    findings = run_lint(
+        paths, select=select, sync_artifact=args.sync_artifact
+    )
     out = (
         format_json(findings) if args.format == "json"
         else format_text(findings)

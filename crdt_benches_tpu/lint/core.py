@@ -23,6 +23,18 @@ Marker comments (on the ``def`` line):
   (e.g. the scheduler's boundary bucket pulls): G002 does not descend
   into it.  Fences are the allowlist — a new sync belongs behind one, or
   it is a bug.
+
+Fence tags (``# graftlint: fence=<tag>``) scope the G011 dead-fence
+accounting against serve bench artifacts:
+
+- bare ``fence`` — expected to cross in EVERY serve drain; a zero
+  counter in a ``boundary_syncs`` artifact block is a G011 finding;
+- ``fence=chaos`` — crosses only under fault injection; accounted only
+  against chaos artifacts;
+- ``fence=journal`` — crosses only with the write-ahead journal on;
+  accounted only against journaled artifacts;
+- ``fence=cold`` — an off-drain API boundary (direct pool calls from
+  tests/tools): still a G002 barrier, never dead-fence accounted.
 """
 
 from __future__ import annotations
@@ -76,7 +88,12 @@ _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
 _SUPPRESS_FILE_RE = re.compile(
     r"#\s*graftlint:\s*disable-file=([A-Z0-9,\s]+)"
 )
-_MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|fence)\b")
+_MARKER_RE = re.compile(
+    r"#\s*graftlint:\s*(hot-path|fence)(?:=([a-z-]+))?\b"
+)
+
+#: Recognized ``fence=<tag>`` spellings (see module docstring).
+FENCE_TAGS = ("chaos", "journal", "cold")
 
 
 def dotted(e: ast.expr) -> str | None:
@@ -118,6 +135,7 @@ class FuncInfo:
     boundary_line: int = 0
     hot: bool = False
     fence: bool = False
+    fence_tag: str | None = None  # None | "chaos" | "journal" | "cold"
 
     @property
     def params(self) -> list[str]:
@@ -170,9 +188,9 @@ class ModuleInfo:
                     r.strip() for r in m.group(1).split(",") if r.strip()
                 )
 
-    def _marker(self, lineno: int) -> str | None:
+    def _marker(self, lineno: int) -> tuple[str, str | None] | None:
         m = _MARKER_RE.search(self.comments.get(lineno, ""))
-        return m.group(1) if m else None
+        return (m.group(1), m.group(2)) if m else None
 
     # -- imports -----------------------------------------------------------
 
@@ -222,8 +240,11 @@ class ModuleInfo:
     def _func_info(self, node, qual: str, cls: str | None) -> FuncInfo:
         fi = FuncInfo(qualname=qual, node=node, module=self, cls=cls)
         marker = self._marker(node.lineno)
-        fi.hot = marker == "hot-path"
-        fi.fence = marker == "fence"
+        if marker is not None:
+            kind, tag = marker
+            fi.hot = kind == "hot-path"
+            fi.fence = kind == "fence"
+            fi.fence_tag = tag if fi.fence else None
         for dec in node.decorator_list:
             self._parse_decorator(fi, dec)
         return fi
@@ -361,6 +382,12 @@ class PackageIndex:
 # ---------------------------------------------------------------------------
 # driver
 
+#: Directory names pruned from directory walks: the fixture corpus is
+#: INTENTIONALLY dirty (linting ``tests/`` must not fail on it).  A
+#: fixture file passed as an explicit path still lints.
+_WALK_PRUNE = ("__pycache__", "lint_fixtures")
+
+
 def collect_files(paths: list[str]) -> tuple[list[str], list[Finding]]:
     """Expand paths to .py files.  A target that does not exist (or
     names no Python file at all) is a G000 finding, NOT a silent skip —
@@ -373,7 +400,7 @@ def collect_files(paths: list[str]) -> tuple[list[str], list[Finding]]:
             for root, dirs, files in os.walk(p):
                 dirs[:] = sorted(
                     d for d in dirs
-                    if not d.startswith(".") and d != "__pycache__"
+                    if not d.startswith(".") and d not in _WALK_PRUNE
                 )
                 for f in sorted(files):
                     if f.endswith(".py"):
@@ -421,13 +448,33 @@ def build_index(paths: list[str]) -> tuple[PackageIndex, list[Finding]]:
     return PackageIndex(modules), errors
 
 
-def run_lint(paths: list[str], select: set[str] | None = None
-             ) -> list[Finding]:
+def run_lint(paths: list[str], select: set[str] | None = None,
+             sync_artifact: str | None = None) -> list[Finding]:
+    """Run the rule suite over ``paths``.  ``sync_artifact`` names a
+    serve bench artifact (or raw ``boundary_syncs`` JSON) to enable the
+    G011 fence-cost cross-check — without it G011 is skipped (it has no
+    runtime ground truth to compare the static fence graph against)."""
     from . import rules as _rules
 
     index, findings = build_index(paths)
     for rule_id, fn in _rules.RULES.items():
         if select and rule_id not in select:
+            continue
+        if rule_id == "G011":
+            if sync_artifact is not None:
+                findings.extend(fn(index, sync_artifact))
+            elif select and "G011" in select:
+                # explicitly selecting G011 with no ground truth must
+                # FAIL, not no-op: a dropped --sync-artifact in a CI
+                # script would otherwise turn the gate permanently green
+                findings.append(Finding(
+                    rule="G000", path="<G011>", line=0, col=0,
+                    msg=(
+                        "G011 selected but no --sync-artifact given — "
+                        "the fence-cost check has no runtime counters "
+                        "to validate against"
+                    ),
+                ))
             continue
         findings.extend(fn(index))
     # apply suppressions

@@ -1,0 +1,462 @@
+"""Pallas kernel-launch sanity: rules G009 (grid/spec consistency) and
+G010 (VMEM block lane alignment).
+
+``ops/apply_range_fused.py`` alone carries ~20 ``BlockSpec``s feeding
+three ``pl.pallas_call`` launches; nothing type-checks that the spec
+list, the kernel signature, the grid rank and the block shapes agree —
+a dropped spec or a stale index-map arity compiles into garbage reads
+(or a Mosaic error naming none of this).  These rules parse every
+``pl.pallas_call`` statically (resolving spec/kernel locals within the
+enclosing function and dimension names like ``LANE`` through
+:class:`crdt_benches_tpu.lint.flow.ConstEnv`) and check what is
+decidable without running anything:
+
+G009 — launch-geometry consistency:
+
+- kernel positional arity == len(in_specs) + len(out_specs) +
+  len(scratch_shapes) (``functools.partial``-bound positionals are
+  discounted; kernels with ``*args`` are skipped);
+- len(out_specs) == len(out_shape);
+- the immediate call's argument count == len(in_specs);
+- every BlockSpec index map takes exactly ``len(grid)`` parameters and
+  returns one coordinate per block-shape dimension;
+- where both a block-shape dim and the matching ``out_shape`` extent
+  resolve to ints, the block must divide the extent it tiles (the
+  "non-dividing grid" class: a partial edge block silently reads and
+  writes out-of-tile data in interpret mode and miscompiles on Mosaic).
+
+G010 — VMEM lane alignment: a resolved block-shape *minor* dimension
+must be a multiple of ``LANE`` (128).  A minor dim of 1 is exempt — the
+``(Rt, nt, 1)`` per-tile-scalar blocks this repo uses are padded to a
+full lane by Mosaic, while an unaligned 8/64/96 silently serializes
+every VMEM copy.  Symbolic dims that do not resolve are left alone:
+the rule never guesses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FuncInfo, ModuleInfo, PackageIndex
+from .flow import ConstEnv
+
+_PALLAS_MODULE = "jax.experimental.pallas"
+
+
+def _pallas_alias(m: ModuleInfo) -> str | None:
+    for local, src in m.imports.items():
+        if src == _PALLAS_MODULE:
+            return local
+    return None
+
+
+def _is_pl_attr(m: ModuleInfo, e: ast.expr, attr: str,
+                alias: str | None) -> bool:
+    return (
+        alias is not None
+        and isinstance(e, ast.Attribute)
+        and e.attr == attr
+        and isinstance(e.value, ast.Name)
+        and e.value.id == alias
+    )
+
+
+class _Spec:
+    """One statically-parsed BlockSpec."""
+
+    def __init__(self, node: ast.Call, shape_node: ast.expr | None,
+                 shape: tuple | None, map_params: int | None,
+                 map_rank: int | None):
+        self.node = node
+        self.shape_node = shape_node
+        self.shape = shape  # tuple of int|None, or None when unknown
+        self.map_params = map_params
+        self.map_rank = map_rank
+
+
+def _local_env(fn_node: ast.AST) -> dict[str, ast.expr]:
+    """Single-assignment locals of the enclosing function (a name bound
+    more than once is dropped — resolution must never guess)."""
+    env: dict[str, ast.expr] = {}
+    dead: set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                if t.id in env or t.id in dead:
+                    env.pop(t.id, None)
+                    dead.add(t.id)
+                else:
+                    env[t.id] = node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                env.pop(t.id, None)
+                dead.add(t.id)
+    return env
+
+
+def _deref(e: ast.expr, env: dict[str, ast.expr],
+           depth: int = 0) -> ast.expr:
+    while isinstance(e, ast.Name) and e.id in env and depth < 8:
+        e = env[e.id]
+        depth += 1
+    return e
+
+
+def _parse_spec(m: ModuleInfo, e: ast.expr, env: dict, cenv: ConstEnv,
+                alias: str | None) -> _Spec | None:
+    e = _deref(e, env)
+    if not (isinstance(e, ast.Call)
+            and _is_pl_attr(m, e.func, "BlockSpec", alias)):
+        return None
+    kw = {k.arg: k.value for k in e.keywords if k.arg}
+    shape_node = e.args[0] if e.args else kw.get("block_shape")
+    map_node = e.args[1] if len(e.args) > 1 else kw.get("index_map")
+    shape = None
+    if isinstance(shape_node, (ast.Tuple, ast.List)):
+        shape = tuple(
+            v if isinstance(v, int) else None
+            for v in (cenv.fold(m, el) for el in shape_node.elts)
+        )
+    map_params = map_rank = None
+    map_node = _deref(map_node, env) if map_node is not None else None
+    if isinstance(map_node, ast.Lambda):
+        a = map_node.args
+        if not (a.vararg or a.kwarg):
+            map_params = len(a.posonlyargs + a.args)
+        body = map_node.body
+        map_rank = len(body.elts) if isinstance(
+            body, (ast.Tuple, ast.List)
+        ) else 1
+    return _Spec(e, shape_node, shape, map_params, map_rank)
+
+
+def _spec_list(m: ModuleInfo, e: ast.expr | None, env: dict,
+               cenv: ConstEnv, alias: str | None
+               ) -> tuple[int | None, list[_Spec | None]]:
+    """(count, parsed elements).  Count folds ``[x]*k`` and ``a + b``;
+    a single BlockSpec counts as one.  (None, []) = undecidable."""
+    if e is None:
+        return None, []
+    e = _deref(e, env)
+    if isinstance(e, (ast.List, ast.Tuple)):
+        specs = [_parse_spec(m, el, env, cenv, alias) for el in e.elts]
+        return len(e.elts), specs
+    if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+        nl, sl = _spec_list(m, e.left, env, cenv, alias)
+        nr, sr = _spec_list(m, e.right, env, cenv, alias)
+        if nl is None or nr is None:
+            return None, []
+        return nl + nr, sl + sr
+    if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Mult):
+        base, mult = e.left, e.right
+        if isinstance(base, ast.Constant):
+            base, mult = mult, base
+        n = cenv.fold(m, mult)
+        nb, sb = _spec_list(m, base, env, cenv, alias)
+        if isinstance(n, int) and nb is not None and 0 <= n < 1024:
+            return nb * n, sb * n
+        return None, []
+    spec = _parse_spec(m, e, env, cenv, alias)
+    if spec is not None:
+        return 1, [spec]
+    # anything else (a factory call, an unresolvable name) could hide
+    # any number of specs — undecidable, never guess
+    return None, []
+
+
+def _sds_shapes(m: ModuleInfo, e: ast.expr | None, env: dict,
+                cenv: ConstEnv) -> tuple[int | None, list[tuple | None]]:
+    """out_shape as (count, per-entry resolved shape tuples)."""
+    if e is None:
+        return None, []
+    e = _deref(e, env)
+    if isinstance(e, (ast.List, ast.Tuple)):
+        shapes = []
+        for el in e.elts:
+            shapes.append(_one_sds(m, el, env, cenv))
+        return len(e.elts), shapes
+    if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+        nl, sl = _sds_shapes(m, e.left, env, cenv)
+        nr, sr = _sds_shapes(m, e.right, env, cenv)
+        if nl is None or nr is None:
+            return None, []
+        return nl + nr, sl + sr
+    if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Mult):
+        base, mult = e.left, e.right
+        if isinstance(base, ast.Constant):
+            base, mult = mult, base
+        n = cenv.fold(m, mult)
+        nb, sb = _sds_shapes(m, base, env, cenv)
+        if isinstance(n, int) and nb is not None and 0 <= n < 1024:
+            return nb * n, sb * n
+        return None, []
+    if (
+        isinstance(e, ast.Call)
+        and isinstance(e.func, ast.Attribute)
+        and e.func.attr == "ShapeDtypeStruct"
+    ):
+        return 1, [_one_sds(m, e, env, cenv)]
+    return None, []  # opaque expression: undecidable, never guess
+
+
+def _one_sds(m: ModuleInfo, e: ast.expr, env: dict,
+             cenv: ConstEnv) -> tuple | None:
+    e = _deref(e, env)
+    if not (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+            and e.func.attr == "ShapeDtypeStruct"):
+        return None
+    kw = {k.arg: k.value for k in e.keywords if k.arg}
+    shape_node = e.args[0] if e.args else kw.get("shape")
+    if not isinstance(shape_node, (ast.Tuple, ast.List)):
+        return None
+    return tuple(
+        v if isinstance(v, int) else None
+        for v in (cenv.fold(m, el) for el in shape_node.elts)
+    )
+
+
+def _kernel_arity(m: ModuleInfo, e: ast.expr, env: dict,
+                  index: PackageIndex, fi: FuncInfo) -> int | None:
+    """Positional-ref count of the kernel argument, or None (varargs,
+    unresolvable, or positionally-bound partials)."""
+    e = _deref(e, env)
+    bound = 0
+    if isinstance(e, ast.Call):
+        f = e.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname != "partial" or not e.args:
+            return None
+        bound = len(e.args) - 1
+        e = _deref(e.args[0], env)
+    if not isinstance(e, ast.Name):
+        return None
+    target = m.functions.get(e.id)
+    if target is None:
+        cands = [
+            g for g in index.by_name.get(e.id, ()) if g.cls is None
+        ]
+        if len(cands) != 1:
+            return None
+        target = cands[0]
+    a = target.node.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs + a.args) - bound
+
+
+def _grid_len(m: ModuleInfo, e: ast.expr | None, env: dict) -> int | None:
+    if e is None:
+        return None
+    e = _deref(e, env)
+    if isinstance(e, (ast.Tuple, ast.List)):
+        return len(e.elts)
+    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+        return 1
+    return None
+
+
+def _pallas_calls(m: ModuleInfo, fi: FuncInfo, alias: str):
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call) and _is_pl_attr(
+            m, node.func, "pallas_call", alias
+        ):
+            yield node
+
+
+def g009_g010_pallas(index: PackageIndex) -> list[Finding]:
+    cached = getattr(index, "_pallas_findings", None)
+    if cached is not None:
+        return cached
+    _annotate_parents(index)
+    cenv = ConstEnv.of(index)
+    g9: list[Finding] = []
+    g10: list[Finding] = []
+    for m in index.modules:
+        alias = _pallas_alias(m)
+        if alias is None:
+            continue
+        lane = cenv.lane_for(m) or 128
+        for fi in m.functions.values():
+            env = _local_env(fi.node)
+            for call in _pallas_calls(m, fi, alias):
+                kw = {k.arg: k.value for k in call.keywords if k.arg}
+                n_in, in_specs = _spec_list(
+                    m, kw.get("in_specs"), env, cenv, alias
+                )
+                n_out, out_specs = _spec_list(
+                    m, kw.get("out_specs"), env, cenv, alias
+                )
+                n_oshape, oshapes = _sds_shapes(
+                    m, kw.get("out_shape"), env, cenv
+                )
+                n_scratch, _ = _spec_list(
+                    m, kw.get("scratch_shapes"), env, cenv, alias
+                )
+                if "scratch_shapes" not in kw:
+                    n_scratch = 0
+                glen = _grid_len(m, kw.get("grid"), env)
+
+                # ---- out_specs vs out_shape count ----
+                if (
+                    n_out is not None and n_oshape is not None
+                    and n_out != n_oshape
+                ):
+                    g9.append(Finding(
+                        rule="G009", path=m.path, line=call.lineno,
+                        col=call.col_offset,
+                        msg=(
+                            f"pallas_call declares {n_out} out_specs but "
+                            f"{n_oshape} out_shape entries — every output "
+                            "needs exactly one block spec"
+                        ),
+                    ))
+
+                # ---- kernel arity vs spec list ----
+                karity = _kernel_arity(
+                    m, call.args[0], env, index, fi
+                ) if call.args else None
+                if (
+                    karity is not None
+                    and None not in (n_in, n_out, n_scratch)
+                ):
+                    want = n_in + n_out + n_scratch
+                    if karity != want:
+                        g9.append(Finding(
+                            rule="G009", path=m.path, line=call.lineno,
+                            col=call.col_offset,
+                            msg=(
+                                f"kernel takes {karity} positional refs "
+                                f"but the spec lists supply {want} "
+                                f"({n_in} in + {n_out} out + "
+                                f"{n_scratch} scratch) — refs and specs "
+                                "pair positionally"
+                            ),
+                        ))
+
+                # ---- immediate invocation arity vs in_specs ----
+                parent = getattr(call, "_graft_parent_call", None)
+                if (
+                    parent is not None and n_in is not None
+                    and not any(
+                        isinstance(a, ast.Starred) for a in parent.args
+                    )
+                    and len(parent.args) != n_in
+                ):
+                    g9.append(Finding(
+                        rule="G009", path=m.path, line=parent.lineno,
+                        col=parent.col_offset,
+                        msg=(
+                            f"pallas_call invoked with "
+                            f"{len(parent.args)} arrays but declares "
+                            f"{n_in} in_specs"
+                        ),
+                    ))
+
+                # ---- per-spec checks ----
+                for si, (spec, where) in enumerate(
+                    [(s, "in") for s in in_specs]
+                    + [(s, "out") for s in out_specs]
+                ):
+                    if spec is None:
+                        continue
+                    oi = si - len(in_specs)
+                    if glen is not None and spec.map_params is not None \
+                            and spec.map_params != glen:
+                        g9.append(Finding(
+                            rule="G009", path=m.path,
+                            line=spec.node.lineno,
+                            col=spec.node.col_offset,
+                            msg=(
+                                f"BlockSpec index map takes "
+                                f"{spec.map_params} grid indices but the "
+                                f"grid has {glen} dimension(s)"
+                            ),
+                        ))
+                    if (
+                        spec.shape is not None
+                        and spec.map_rank is not None
+                        and spec.map_rank != len(spec.shape)
+                    ):
+                        g9.append(Finding(
+                            rule="G009", path=m.path,
+                            line=spec.node.lineno,
+                            col=spec.node.col_offset,
+                            msg=(
+                                f"BlockSpec block shape has "
+                                f"{len(spec.shape)} dims but its index "
+                                f"map returns {spec.map_rank} "
+                                "coordinate(s)"
+                            ),
+                        ))
+                    # divisibility: out blocks vs declared out extents
+                    if (
+                        where == "out" and spec.shape is not None
+                        and 0 <= oi < len(oshapes)
+                        and oshapes[oi] is not None
+                        and len(oshapes[oi]) == len(spec.shape)
+                    ):
+                        for d, (blk, ext) in enumerate(
+                            zip(spec.shape, oshapes[oi])
+                        ):
+                            if (
+                                isinstance(blk, int)
+                                and isinstance(ext, int)
+                                and blk > 0 and ext % blk
+                            ):
+                                g9.append(Finding(
+                                    rule="G009", path=m.path,
+                                    line=spec.node.lineno,
+                                    col=spec.node.col_offset,
+                                    msg=(
+                                        f"block dim {d} = {blk} does "
+                                        f"not divide the output extent "
+                                        f"{ext} it tiles — the edge "
+                                        "block reads/writes out of "
+                                        "bounds"
+                                    ),
+                                ))
+                    # G010: VMEM minor-dim lane alignment
+                    if spec.shape:
+                        minor = spec.shape[-1]
+                        if (
+                            isinstance(minor, int)
+                            and minor != 1 and minor % lane
+                        ):
+                            g10.append(Finding(
+                                rule="G010", path=m.path,
+                                line=spec.node.lineno,
+                                col=spec.node.col_offset,
+                                msg=(
+                                    f"VMEM block minor dim {minor} is "
+                                    f"not a multiple of LANE={lane} — "
+                                    "unaligned blocks serialize every "
+                                    "VMEM copy on TPU (minor dim 1 is "
+                                    "the padded-scalar exemption)"
+                                ),
+                            ))
+    index._pallas_findings = g9 + g10
+    return index._pallas_findings
+
+
+def _annotate_parents(index: PackageIndex) -> None:
+    """Mark pallas_call nodes that are immediately invoked:
+    ``pl.pallas_call(...)(args)`` — the outer Call is stashed on the
+    inner one for the invocation-arity check."""
+    for m in index.modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Call
+            ):
+                node.func._graft_parent_call = node
+
+
+def g009_pallas_grid(index: PackageIndex) -> list[Finding]:
+    return [f for f in g009_g010_pallas(index) if f.rule == "G009"]
+
+
+def g010_block_lane(index: PackageIndex) -> list[Finding]:
+    return [f for f in g009_g010_pallas(index) if f.rule == "G010"]

@@ -1,14 +1,20 @@
-"""graftlint rules G001-G007.
+"""graftlint rules G001-G011.
 
 Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
 registered in :data:`RULES`.  Every rule is motivated by a real hazard
 this repository has already hit (see README "Static analysis" for the
-rule table and the incident each one encodes).
+rule table and the incident each one encodes).  G008 lives in
+:mod:`crdt_benches_tpu.lint.flow` (the interprocedural constant pass),
+G009/G010 in :mod:`crdt_benches_tpu.lint.pallas_rules`; G011 (below)
+cross-validates the static fence graph against a serve bench artifact's
+``boundary_syncs`` counters and only runs when the driver hands it one.
 """
 
 from __future__ import annotations
 
 import ast
+import json
+import os
 
 from .core import (
     DEFAULT_HOT_ROOTS,
@@ -21,6 +27,8 @@ from .core import (
     PackageIndex,
     dotted,
 )
+from .flow import g008_shape_drift
+from .pallas_rules import g009_pallas_grid, g010_block_lane
 
 _JNP_CREATORS = {
     "array", "zeros", "ones", "empty", "full", "arange", "linspace",
@@ -653,6 +661,91 @@ def g007_boundary_contract(index: PackageIndex) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# G011 — fence-cost cross-check (static fence graph vs runtime counters)
+
+def _load_boundary_syncs(path: str) -> tuple[dict | None, str | None]:
+    """The ``boundary_syncs`` block of a serve bench artifact (a
+    ``save_results`` list of BenchResult dicts) or of a raw JSON fixture.
+    Returns (block, error)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable sync artifact: {e}"
+    if isinstance(data, dict):
+        block = data.get("boundary_syncs")
+        return (block, None) if isinstance(block, dict) else (
+            None, "artifact has no boundary_syncs block"
+        )
+    if isinstance(data, list):
+        for entry in data:
+            extra = entry.get("extra") if isinstance(entry, dict) else None
+            if isinstance(extra, dict) and isinstance(
+                extra.get("boundary_syncs"), dict
+            ):
+                return extra["boundary_syncs"], None
+        return None, "artifact has no boundary_syncs block"
+    return None, "artifact is neither a result list nor a dict"
+
+
+def g011_fence_cost(index: PackageIndex, artifact_path: str
+                    ) -> list[Finding]:
+    """Cross-validate the static fence model against a serve run's
+    ``boundary_syncs`` counters (the runtime ground truth the sanitizer
+    records): a declared fence the run never crossed is DEAD — either
+    the annotation is stale (delete it) or the boundary moved (re-fence
+    the real one); a runtime counter with no matching ``# graftlint:
+    fence`` marker is an UNATTRIBUTED sync boundary the static model
+    does not know about.  ``fence=chaos`` / ``fence=journal`` fences are
+    accounted only against artifacts whose run had faults / a journal;
+    ``fence=cold`` fences (off-drain APIs) are never dead-checked."""
+    block, err = _load_boundary_syncs(artifact_path)
+    if block is None:
+        return [Finding(
+            rule="G011", path=artifact_path, line=0, col=0, msg=err,
+        )]
+    entries = block.get("entries") or {}
+    chaos = bool(block.get("chaos"))
+    journal = bool(block.get("journal"))
+    out = []
+    fences = {
+        fi.qualname: fi
+        for m in index.modules for fi in m.functions.values() if fi.fence
+    }
+    for qual, fi in sorted(fences.items()):
+        tag = fi.fence_tag
+        if tag == "cold":
+            continue
+        if tag == "chaos" and not chaos:
+            continue
+        if tag == "journal" and not journal:
+            continue
+        if not entries.get(qual):
+            out.append(Finding(
+                rule="G011", path=fi.module.path, line=fi.node.lineno,
+                col=fi.node.col_offset,
+                msg=(
+                    f"declared fence `{qual}` never crossed in "
+                    f"{os.path.basename(artifact_path)} — dead fence: "
+                    "delete the stale annotation or re-fence the real "
+                    "boundary (tag it fence=chaos/journal/cold if it is "
+                    "only reachable there)"
+                ),
+            ))
+    for qual in sorted(entries):
+        if qual not in fences:
+            out.append(Finding(
+                rule="G011", path=artifact_path, line=0, col=0,
+                msg=(
+                    f"runtime fence counter `{qual}` has no matching "
+                    "`# graftlint: fence` marker — an unattributed sync "
+                    "boundary the static G002 model does not know about"
+                ),
+            ))
+    return out
+
+
 RULES = {
     "G001": g001_tracer_leak,
     "G002": g002_host_sync,
@@ -661,4 +754,8 @@ RULES = {
     "G005": g005_implicit_dtype,
     "G006": g006_nondeterminism,
     "G007": g007_boundary_contract,
+    "G008": g008_shape_drift,
+    "G009": g009_pallas_grid,
+    "G010": g010_block_lane,
+    "G011": g011_fence_cost,  # artifact-driven; see run_lint
 }

@@ -1,0 +1,219 @@
+"""Runtime sync sanitizer: the dynamic half of the G002 fence model.
+
+graftlint's G002 proves *statically* that no host sync is reachable from
+the serving hot path outside a ``# graftlint: fence`` function — but the
+static model trusts the annotations.  This module supplies the runtime
+evidence:
+
+- every declared fence routes through :func:`fence` (usually via the
+  :func:`fenced` decorator, keyed by the function's ``__qualname__`` so
+  runtime counters line up with the static fence graph) and counts its
+  **entries** — always, in every mode, a dict increment per boundary
+  crossing (nanoseconds against a multi-ms macro-round);
+- with ``CRDT_BENCH_SANITIZE_SYNCS=1``, :func:`hot_path` (wrapped around
+  ``FleetScheduler.run_round``) arms the sanitizer: the exact host-sync
+  surface G002 models (``Array.__array__`` — the ``np.asarray``/
+  ``device_get`` funnel — ``.item()``, ``.tolist()``,
+  ``block_until_ready``, ``__int__``/``__float__``/``__bool__``/
+  ``__index__``) is interposed, and any such call OUTSIDE an active
+  fence raises :class:`UndeclaredSyncError` **at the offending
+  callsite**.  Inside a fence the sync is allowed and counted against
+  that fence (innermost wins), giving per-fence **sync** counters.
+  ``jax.transfer_guard_device_to_host("disallow")`` is entered too —
+  a no-op on the zero-copy CPU backend (which is exactly why the
+  interposition exists) but a second, independent tripwire on real
+  accelerators, re-allowed inside fences;
+- the serve bench snapshots :func:`counters` into its artifact as the
+  ``boundary_syncs`` block, and lint rule G011 cross-validates that
+  ground truth against the static fence graph (dead declared fences,
+  unattributed runtime fences).
+
+Everything here is import-light on purpose: jax is imported lazily and
+only once the sanitizer actually arms, so the serve modules can import
+:func:`fenced` without changing cold-start, and with the flag unset the
+only cost anywhere is the per-entry counter bump.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from contextlib import contextmanager
+
+_ENV = "CRDT_BENCH_SANITIZE_SYNCS"
+
+#: Host-sync surface interposed on the jax Array type — the runtime
+#: twin of rules.py G002's ``_SYNC_METHODS`` model.
+_SYNC_SURFACE = (
+    "__array__", "item", "tolist", "block_until_ready",
+    "__int__", "__float__", "__bool__", "__index__", "__complex__",
+)
+
+#: numpy module-level converters interposed for CONCRETE jax arrays:
+#: the CPU backend satisfies ``np.asarray`` through the zero-copy C
+#: buffer protocol, never calling ``__array__`` — the exact reason the
+#: native transfer guard is silent on CPU and these wrappers exist.
+#: This is G002's ``_NP_SYNC_FUNCS`` surface plus ``ascontiguousarray``.
+_NP_SURFACE = ("asarray", "array", "copy", "ascontiguousarray")
+
+
+class UndeclaredSyncError(RuntimeError):
+    """A host sync fired on the serving hot path outside every declared
+    fence — the static G002 model just met a counterexample."""
+
+
+_tls = threading.local()
+_entries: dict[str, int] = {}
+_syncs: dict[str, int] = {}
+_hooks_installed = False
+
+
+def sanitizing() -> bool:
+    """True when ``CRDT_BENCH_SANITIZE_SYNCS`` arms the sanitizer.
+    Read per hot-scope entry (not at import) so tests can flip it."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+def _fence_stack() -> list:
+    s = getattr(_tls, "fences", None)
+    if s is None:
+        s = _tls.fences = []
+    return s
+
+
+def _hot_depth() -> int:
+    return getattr(_tls, "hot", 0)
+
+
+def reset_counters() -> None:
+    """Zero both counter tables (each bench run owns its window)."""
+    _entries.clear()
+    _syncs.clear()
+
+
+def counters() -> dict[str, dict[str, int]]:
+    """Snapshot: ``{"entries": {fence: n}, "syncs": {fence: n}}``.
+    ``syncs`` is only populated while the sanitizer is armed (the
+    interposition is what attributes individual host syncs)."""
+    return {
+        "entries": dict(sorted(_entries.items())),
+        "syncs": dict(sorted(_syncs.items())),
+    }
+
+
+def _note_sync(label: str) -> None:
+    stack = _fence_stack()
+    if stack:
+        _syncs[stack[-1]] = _syncs.get(stack[-1], 0) + 1
+        return
+    if _hot_depth() > 0:
+        raise UndeclaredSyncError(
+            f"undeclared host sync `{label}` on the serving hot path "
+            "(CRDT_BENCH_SANITIZE_SYNCS=1): no `# graftlint: fence` "
+            "scope is active here — move the sync behind a declared "
+            "fence or declare this boundary"
+        )
+
+
+def _install_hooks() -> None:
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    from jax._src.array import ArrayImpl
+
+    def wrap(orig, label):
+        # NOT functools.wraps: several of these are pybind11-level
+        # methods whose metadata attributes reject copying
+        def hooked(self, *args, **kwargs):
+            _note_sync(label)
+            return orig(self, *args, **kwargs)
+
+        hooked.__name__ = label
+        hooked.__graft_sanitizer__ = True
+        return hooked
+
+    for name in _SYNC_SURFACE:
+        orig = getattr(ArrayImpl, name, None)
+        if orig is None or getattr(orig, "__graft_sanitizer__", False):
+            continue
+        setattr(ArrayImpl, name, wrap(orig, name))
+
+    import numpy as np
+
+    def wrap_np(orig, label):
+        def hooked(*args, **kwargs):
+            # the data operand may arrive by keyword (np.asarray(a=...),
+            # np.array(object=...)) — never constrain the signature
+            probe = args[0] if args else kwargs.get(
+                "a", kwargs.get("object")
+            )
+            if isinstance(probe, ArrayImpl):
+                _note_sync(f"np.{label}")
+            return orig(*args, **kwargs)
+
+        hooked.__name__ = label
+        hooked.__graft_sanitizer__ = True
+        return hooked
+
+    for name in _NP_SURFACE:
+        orig = getattr(np, name, None)
+        if orig is None or getattr(orig, "__graft_sanitizer__", False):
+            continue
+        setattr(np, name, wrap_np(orig, name))
+    _hooks_installed = True
+
+
+@contextmanager
+def hot_path():
+    """Arm the sanitizer for one hot-path scope (no-op unless the env
+    flag is set).  Inside: any interposed host sync outside a fence
+    raises; ``transfer_guard_device_to_host`` is set to disallow for
+    backends that enforce it."""
+    if not sanitizing():
+        yield
+        return
+    _install_hooks()
+    import jax
+
+    _tls.hot = _hot_depth() + 1
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    finally:
+        _tls.hot -= 1
+
+
+@contextmanager
+def fence(name: str):
+    """One declared-boundary crossing: count the entry, allow (and
+    attribute) host syncs within."""
+    _entries[name] = _entries.get(name, 0) + 1
+    stack = _fence_stack()
+    stack.append(name)
+    try:
+        if _hot_depth() > 0:
+            import jax
+
+            with jax.transfer_guard_device_to_host("allow"):
+                yield
+        else:
+            yield
+    finally:
+        stack.pop()
+
+
+def fenced(fn):
+    """Decorator form of :func:`fence`, keyed by ``__qualname__`` so the
+    runtime counter name equals the static fence graph's qualname.  Goes
+    on exactly the functions carrying ``# graftlint: fence`` markers —
+    G011 cross-checks that the two sets agree."""
+    name = fn.__qualname__
+
+    @functools.wraps(fn)
+    def crossing(*args, **kwargs):
+        with fence(name):
+            return fn(*args, **kwargs)
+
+    crossing.__graft_fence__ = name
+    return crossing

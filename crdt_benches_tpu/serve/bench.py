@@ -48,6 +48,7 @@ from ..bench.harness import (
     steady_quantiles,
     summarize,
 )
+from ..lint import sanitizer
 from ..oracle.text_oracle import replay_trace
 from .faults import FaultInjector, FaultPlan
 from .journal import OpJournal
@@ -201,6 +202,13 @@ def run_serve_bench(
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
         )
+        # per-fence boundary-sync counters cover drain + verify; with
+        # CRDT_BENCH_SANITIZE_SYNCS=1 any sync outside a declared fence
+        # raises inside run() at its callsite
+        sanitizer.reset_counters()
+        sanitized = sanitizer.sanitizing()
+        if sanitized:
+            log("serve: sync sanitizer ARMED (CRDT_BENCH_SANITIZE_SYNCS)")
         stats = sched.run()
         assert sched.done, "scheduler stopped with pending work"
         # steady-state latency excludes BOTH compile rounds and snapshot
@@ -290,6 +298,26 @@ def run_serve_bench(
                 f"{fault_summary['not_fired']} never fired"
             )
 
+        # ---- boundary-sync ground truth (lint G011 cross-checks the
+        # static fence graph against exactly this block) ----
+        sync_counts = sanitizer.counters()
+        boundary_syncs = {
+            "sanitized": sanitized,
+            "chaos": plan is not None,
+            "journal": journal is not None,
+            "entries": sync_counts["entries"],
+            "syncs": sync_counts["syncs"] if sanitized else None,
+        }
+        log(
+            "serve: boundary syncs — "
+            + (", ".join(
+                f"{k.split('.')[-1]}={v}"
+                for k, v in sync_counts["entries"].items()
+            ) or "none")
+            + (f"; observed {sum(sync_counts['syncs'].values())} fenced "
+               f"transfers" if sanitized else "")
+        )
+
         occ = float(np.mean(stats.occupancy)) if stats.occupancy else 0.0
         qd = stats.queue_depth or [0]
         r = BenchResult(
@@ -351,6 +379,7 @@ def run_serve_bench(
                     "snapshot_time": stats.snapshot_time,
                 },
                 "faults": fault_summary,
+                "boundary_syncs": boundary_syncs,
                 "docs_per_class": {
                     str(c): len(v) for c, v in sorted(by_class.items())
                 },

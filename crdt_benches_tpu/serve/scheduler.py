@@ -77,6 +77,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..lint.sanitizer import fenced, hot_path
 from ..traces.tensorize import (
     INSERT,
     PAD,
@@ -734,7 +735,8 @@ class FleetScheduler:
             for d, row in self.pool.residents(cls)
         ]
 
-    def _fire_spool_fault(self, plan: _Plan) -> None:  # graftlint: fence
+    @fenced
+    def _fire_spool_fault(self, plan: _Plan) -> None:  # graftlint: fence=chaos
         """Corrupt/truncate an eviction spool on disk.  Prefers an
         existing spool of a doc with pending ops (its restore — and so
         the detection — is guaranteed); with none live, tears a spool as
@@ -795,7 +797,8 @@ class FleetScheduler:
                 ops=shed, reason=reason[:120],
             )
 
-    def _heal_spool(self, doc_id: int, cls: int, err: str):  # graftlint: fence
+    @fenced
+    def _heal_spool(self, doc_id: int, cls: int, err: str):  # graftlint: fence=chaos
         """A spool failed its integrity check on restore: rebuild the
         doc's row at its applied cursor from the last snapshot base (or
         from scratch — streams are deterministic) through the macro
@@ -844,7 +847,8 @@ class FleetScheduler:
         finally:
             self._bases.release()  # don't pin snapshot arrays post-heal
 
-    def _recover_class(  # graftlint: fence
+    @fenced
+    def _recover_class(  # graftlint: fence=chaos
             self, cls: int, plan: _Plan, ev) -> None:
         """Device-state loss mid-macro-round: the class's bucket is gone.
         This round's staged ops for the class never became durable —
@@ -936,6 +940,7 @@ class FleetScheduler:
 
     # ---- boundary execution (the only device syncs) ----
 
+    @fenced
     def _execute_moves(self, plan: _Plan) -> None:  # graftlint: fence
         """Apply the plan's row movement: pull affected buckets once
         (syncing with any in-flight macro step), write eviction spools,
@@ -1055,16 +1060,27 @@ class FleetScheduler:
         self.round = plan.base_round + max(plan.k_eff.values())
         self._n_rounds += 1
 
-    def _maybe_snapshot(self) -> None:  # graftlint: fence
-        """Periodic fleet snapshot barrier (journal mode): pull every
-        bucket once and persist the consistent set.  The barrier is a
-        forced sync — its round is flagged so steady-state latency
-        quantiles exclude it, like compile rounds."""
+    def _maybe_snapshot(self) -> None:
+        """Cadence gate for the snapshot barrier.  PR 4 fenced THIS
+        function, which made the declared fence cross every round even
+        in journal-less runs where it never syncs — the sanitizer's
+        counters showed pure no-op crossings drowning the ground truth.
+        Repaired: the cadence check stays open, only the actual barrier
+        below is the fence."""
         self._snapped = False
         if self.journal is None or self.snapshot_every <= 0:
             return
         if self._n_rounds % self.snapshot_every:
             return
+        self._snapshot_barrier()
+        self._snapped = True
+
+    @fenced
+    def _snapshot_barrier(self) -> None:  # graftlint: fence=journal
+        """Periodic fleet snapshot barrier (journal mode): pull every
+        bucket once and persist the consistent set.  The barrier is a
+        forced sync — its round is flagged so steady-state latency
+        quantiles exclude it, like compile rounds."""
         t0 = time.perf_counter()
         d = write_snapshot(
             self.journal.dir, self.pool, self.streams, self.round,
@@ -1074,42 +1090,50 @@ class FleetScheduler:
         self.stats.snapshot_time += time.perf_counter() - t0
         self.journal.event("snap", r=self.round, dir=os.path.basename(d))
         self._bases.release()  # the new barrier may have pruned old dirs
-        self._snapped = True
 
     # ---- driver ----
 
     def run_round(self) -> bool:
         """One macro-round (plan -> WAL record -> stage -> boundary
         moves -> one async dispatch per class).  Returns False when no
-        work remains."""
-        t0 = time.perf_counter()
-        if self.faults is not None:
-            self._fire_overflow()
-        plan = self._plan()
-        if plan is None:
-            return False
-        if self.journal is not None:
-            # write-ahead: the lane set is durable BEFORE dispatch
-            self.journal.round_record(plan.base_round, {
-                cls: [[l.stream.doc_id, int(l.stream.cursor), int(l.end)]
-                      for l in lanes]
-                for cls, lanes in plan.lanes.items()
-            })
-        tensors = self._stage(plan)
-        if self.faults is not None:
-            self._maybe_stall(plan.base_round)
-        self._execute_moves(plan)
-        if self.faults is not None:
-            self._fire_spool_fault(plan)
-        compiled = self._dispatch(plan, tensors)
-        self._advance(plan)
-        if self._planned_degraded:
-            self.pool.block()  # degraded mode is SYNCHRONOUS K=1
-        self._maybe_snapshot()
-        self.stats.round_latencies.append(time.perf_counter() - t0)
-        self.stats.compile_flags.append(compiled)
-        self.stats.barrier_flags.append(self._snapped)
-        return True
+        work remains.
+
+        The whole round runs inside the sync sanitizer's hot scope
+        (``lint/sanitizer.py hot_path``, armed by
+        ``CRDT_BENCH_SANITIZE_SYNCS=1``): a host sync anywhere in here
+        that is not behind a ``# graftlint: fence`` function raises at
+        its exact callsite — the dynamic proof of the static G002
+        model.  Unarmed, the scope is a no-op."""
+        with hot_path():
+            t0 = time.perf_counter()
+            if self.faults is not None:
+                self._fire_overflow()
+            plan = self._plan()
+            if plan is None:
+                return False
+            if self.journal is not None:
+                # write-ahead: the lane set is durable BEFORE dispatch
+                self.journal.round_record(plan.base_round, {
+                    cls: [[l.stream.doc_id, int(l.stream.cursor),
+                           int(l.end)]
+                          for l in lanes]
+                    for cls, lanes in plan.lanes.items()
+                })
+            tensors = self._stage(plan)
+            if self.faults is not None:
+                self._maybe_stall(plan.base_round)
+            self._execute_moves(plan)
+            if self.faults is not None:
+                self._fire_spool_fault(plan)
+            compiled = self._dispatch(plan, tensors)
+            self._advance(plan)
+            if self._planned_degraded:
+                self.pool.block()  # degraded mode is SYNCHRONOUS K=1
+            self._maybe_snapshot()
+            self.stats.round_latencies.append(time.perf_counter() - t0)
+            self.stats.compile_flags.append(compiled)
+            self.stats.barrier_flags.append(self._snapped)
+            return True
 
     def run(self, max_rounds: int | None = None) -> ServeStats:
         """Drain every queue (or stop after ``max_rounds`` macro-rounds).

@@ -1,13 +1,19 @@
 """Seeded G005: array creation without an explicit dtype.  Under
 JAX_ENABLE_X64 (or a future default flip) these become int64/float64,
 silently recompiling every int32-keyed kernel downstream — and the
-packed doc layout assumes 32-bit lanes."""
+packed doc layout assumes 32-bit lanes.
+
+The three violations span the autofixer's outcomes: a value-less
+creator (zeros -> float32, today's default made explicit), an all-int
+literal arange (-> int32), and a runtime-typed arange bound the fixer
+must REFUSE (the dtype follows the argument's runtime type)."""
 
 import jax.numpy as jnp
 
 
 def staging_buffers(rows, batch):
     kind = jnp.zeros((rows, batch))  # expect: G005
-    lanes = jnp.arange(rows)  # expect: G005
+    lanes = jnp.arange(128)  # expect: G005
+    tiles = jnp.arange(rows)  # expect: G005
     ok = jnp.zeros((rows, batch), jnp.int32)  # explicit: clean
-    return kind, lanes, ok
+    return kind, lanes, tiles, ok

@@ -1,0 +1,37 @@
+"""Seeded G009 violations: pallas_call launch geometry that disagrees
+with itself — an index map built for a 2-D grid on a 1-D launch, an
+output block that does not divide the extent it tiles, and a kernel
+whose ref list is one spec short.  Every one of these compiles into
+out-of-bounds tile traffic (or a Mosaic error naming none of this)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def launch_bad_geometry(x):
+    stale_map = pl.BlockSpec((24, LANE), lambda i, j: (i, 0))  # expect: G009
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[stale_map],
+        out_specs=pl.BlockSpec((24, LANE), lambda i: (i, 0)),  # expect: G009
+        out_shape=jax.ShapeDtypeStruct((100, LANE), jnp.int32),
+    )(x)
+
+
+def launch_missing_ref(x, y):
+    spec = pl.BlockSpec((8, LANE), lambda i: (i, 0))
+    return pl.pallas_call(  # expect: G009
+        _kernel,
+        grid=(2,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((16, LANE), jnp.int32),
+    )(x, y)

@@ -1,0 +1,24 @@
+"""Seeded G010 violation: a VMEM block whose minor dimension is not a
+multiple of LANE=128 — every copy into and out of the block serializes
+on TPU (the (Rt, nt, 1) per-tile-scalar shape is the one exemption)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def launch_narrow_block(x):
+    narrow = pl.BlockSpec((8, 64), lambda i: (i, 0))  # expect: G010
+    return pl.pallas_call(
+        _kernel,
+        grid=(2,),
+        in_specs=[narrow],
+        out_specs=pl.BlockSpec((8, LANE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, LANE), jnp.int32),
+    )(x)

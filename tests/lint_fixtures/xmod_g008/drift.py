@@ -1,0 +1,6 @@
+"""G008 corpus, drift side: an independent module-level fork of the
+shared dimension — the producer/consumer pair above still agree with
+each other, so only the runtime would ever notice this copy diverging
+(a half-migrated LANE bump looks exactly like this)."""
+
+LANE = 64  # expect: G008

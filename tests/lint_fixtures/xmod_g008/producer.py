@@ -1,0 +1,6 @@
+"""G008 corpus, producer side: this module OWNS the shared dimension
+constant — consumers import it, so any independent redefinition
+elsewhere in the package is drift.  Linted as a directory with its
+siblings (cross-module rules see nothing in a single-file run)."""
+
+LANE = 128

@@ -1,0 +1,7 @@
+"""G008 corpus, shadow side: importing the shared dimension and then
+rebinding the same name module-level — the import is dead code and the
+local fork wins silently."""
+
+from producer import LANE
+
+LANE = 512  # expect: G008

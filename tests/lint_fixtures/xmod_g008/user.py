@@ -1,0 +1,16 @@
+"""G008 corpus, consumer side: importing ``LANE`` is what promotes it
+to a shared cross-module symbol; the capacity-class tuple below then
+disagrees with the imported dimension two different ways."""
+
+from producer import LANE
+
+
+def tiles(c):
+    return c // LANE
+
+
+def make_pool(classes=(256, 320),  # expect: G008
+              slots=(4, 2, 1)):  # expect: G008
+    """320 is not a LANE multiple (the serve/pool.py capacity-class
+    contract), and three slot counts cannot pair with two classes."""
+    return classes, slots

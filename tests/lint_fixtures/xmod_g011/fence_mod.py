@@ -1,0 +1,18 @@
+"""G011 corpus: a hot path with one LIVE declared fence and one STALE
+one.  ``artifact.json`` next door is the matching runtime ground truth
+(a ``boundary_syncs`` block as the serve bench emits it): ``pull_all``
+crossed three times, ``stale_boundary`` never, and the run also counted
+a fence the static model has no marker for."""
+
+
+def hot_loop():  # graftlint: hot-path
+    for _ in range(2):
+        pull_all()
+
+
+def pull_all():  # graftlint: fence
+    return 1
+
+
+def stale_boundary():  # graftlint: fence -- expect: G011
+    return 2

@@ -1,0 +1,184 @@
+"""The sync sanitizer (lint/sanitizer.py): the runtime proof of the
+static G002 fence model.
+
+Covers the three contract points ISSUE 5 names: an undeclared host sync
+on the hot path raises at its callsite; a drain whose every sync sits
+behind declared fences passes with the sanitizer armed; and the
+per-fence counters the serve bench emits (``boundary_syncs``) are in
+parity with the sanitizer's own tables — including that every observed
+sync attributes to a fence that exists in the STATIC fence graph (the
+set graftlint's G011 accounts against).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from crdt_benches_tpu.lint import sanitizer
+from crdt_benches_tpu.lint.core import build_index
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.bench import run_serve_bench
+
+#: same tiny two-class sizing as tests/test_serve.py: docs span both
+#: classes, the drain stays a few thousand unit ops
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_SYNCS", "1")
+    sanitizer.reset_counters()
+    yield
+    sanitizer.reset_counters()
+
+
+def _device_array():
+    return jnp.arange(16, dtype=jnp.int32)
+
+
+def test_undeclared_sync_raises_at_callsite(armed):
+    """Every modeled sync surface trips the sanitizer when no declared
+    fence is active — np.asarray (the CPU buffer-protocol funnel the
+    native transfer guard cannot see), scalar pulls, item/tolist, and
+    block_until_ready."""
+    x = _device_array()
+    for label, sync in [
+        ("np.asarray", lambda: np.asarray(x)),
+        ("np.array", lambda: np.array(x)),
+        ("item", lambda: x[0].item()),
+        ("tolist", lambda: x.tolist()),
+        ("int", lambda: int(x[1])),
+        ("float", lambda: float(x[2])),
+        ("block_until_ready", lambda: x.block_until_ready()),
+    ]:
+        with pytest.raises(sanitizer.UndeclaredSyncError):
+            with sanitizer.hot_path():
+                sync()
+        # the same sync OUTSIDE the hot scope is ordinary host traffic
+        sync()
+
+
+def test_declared_fence_allows_and_attributes(armed):
+    x = _device_array()
+    with sanitizer.hot_path():
+        with sanitizer.fence("test.boundary"):
+            np.asarray(x)
+            x.block_until_ready()
+    c = sanitizer.counters()
+    assert c["entries"]["test.boundary"] == 1
+    assert c["syncs"]["test.boundary"] == 2
+    # innermost fence wins the attribution
+    with sanitizer.hot_path():
+        with sanitizer.fence("outer"):
+            with sanitizer.fence("inner"):
+                np.asarray(x)
+    c = sanitizer.counters()
+    assert c["syncs"].get("inner") == 1
+    assert "outer" not in c["syncs"]
+
+
+def test_unarmed_mode_counts_entries_only(monkeypatch):
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_SYNCS", raising=False)
+    sanitizer.reset_counters()
+    x = _device_array()
+    with sanitizer.hot_path():  # no-op scope
+        np.asarray(x)  # must NOT raise
+    with sanitizer.fence("cheap.crossing"):
+        pass
+    assert sanitizer.counters()["entries"] == {"cheap.crossing": 1}
+
+
+def test_fenced_decorator_keys_by_qualname(armed):
+    class Pool:
+        @sanitizer.fenced
+        def pull(self):
+            return np.asarray(_device_array())
+
+    with sanitizer.hot_path():
+        Pool().pull()
+    c = sanitizer.counters()
+    key = "test_fenced_decorator_keys_by_qualname.<locals>.Pool.pull"
+    assert c["entries"][key] == 1 and c["syncs"][key] == 1
+
+
+def _static_fence_qualnames() -> set[str]:
+    import crdt_benches_tpu
+
+    pkg = crdt_benches_tpu.__path__[0]
+    index, errors = build_index([pkg])
+    assert not errors
+    return {
+        fi.qualname
+        for m in index.modules for fi in m.functions.values() if fi.fence
+    }
+
+
+def test_sanitized_drain_proves_the_fence_model(armed, tmp_path):
+    """A full (tiny) serve drain under CRDT_BENCH_SANITIZE_SYNCS=1:
+    completes verify-green (observed syncs are a subset of declared
+    fences — an undeclared one would have raised), the artifact's
+    boundary_syncs block is in exact parity with the sanitizer
+    counters, and every runtime fence name exists in the static fence
+    graph."""
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=12, batch=16, macro_k=2, batch_chars=64,
+        classes=(128, 512), slots=(8, 4), arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS, seed=7,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path), save_name="sanitized_smoke",
+    )
+    assert info["verify_ok"]
+    block = r.extra["boundary_syncs"]
+    assert block["sanitized"] is True
+    live = sanitizer.counters()
+    # parity with the artifact ON DISK, not just the in-memory result
+    disk = json.loads((tmp_path / "sanitized_smoke.json").read_text())
+    disk_block = disk[0]["extra"]["boundary_syncs"]
+    assert disk_block == block
+    assert block["entries"] == live["entries"]
+    assert block["syncs"] == live["syncs"]
+    static = _static_fence_qualnames()
+    assert set(block["entries"]) <= static
+    assert set(block["syncs"]) <= set(block["entries"])
+    # the drain actually crossed the serving boundaries
+    assert block["entries"].get("FleetScheduler._execute_moves")
+    assert block["entries"].get("DocPool.block")
+    assert sum(block["syncs"].values()) > 0
+
+
+def test_unsanitized_drain_still_records_entries(monkeypatch, tmp_path):
+    """The boundary_syncs entries block is ground truth in EVERY run
+    (G011's food), not only under the sanitizer."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_SYNCS", raising=False)
+    from crdt_benches_tpu.serve.pool import DocPool
+    from crdt_benches_tpu.serve.scheduler import (
+        FleetScheduler,
+        prepare_streams,
+    )
+    from crdt_benches_tpu.serve.workload import build_fleet
+
+    sanitizer.reset_counters()
+    sessions = build_fleet(
+        8, mix=TINY_MIX, seed=5, arrival_span=1, bands=TINY_BANDS
+    )
+    pool = DocPool(classes=(128, 512), slots=(6, 3),
+                   spool_dir=str(tmp_path))
+    streams = prepare_streams(sessions, pool, batch=16)
+    sched = FleetScheduler(pool, streams, batch=16, macro_k=2)
+    sched.run()
+    assert sched.done
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+    c = sanitizer.counters()
+    assert c["entries"].get("FleetScheduler._execute_moves")
+    assert c["entries"].get("DocPool.block") == 1
+    assert c["syncs"] == {} or not sanitizer.sanitizing()

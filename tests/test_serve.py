@@ -164,7 +164,8 @@ def test_mesh_fleet_matches_unsharded(tmp_path):
 
 def test_pool_rejects_bad_config(tmp_path):
     with pytest.raises(ValueError):
-        DocPool(classes=(100,), slots=(4,))  # not a LANE multiple
+        # the point IS the bad class: G008 now catches it statically too
+        DocPool(classes=(100,), slots=(4,))  # graftlint: disable=G008
     with pytest.raises(ValueError):
         DocPool(classes=(512, 128), slots=(2, 2))  # not ascending
     pool = DocPool(classes=(128,), slots=(2,), spool_dir=str(tmp_path))

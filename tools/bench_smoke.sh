@@ -35,7 +35,7 @@ done
 
 case "$family" in
   serve)
-    exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
       python -m crdt_benches_tpu.bench.runner --family serve \
         --serve-docs 24 --serve-mix mixed --serve-batch 16 \
         --serve-macro 4 --serve-batch-chars 64 \
@@ -43,6 +43,24 @@ case "$family" in
         --serve-slots 16,6,2,2,2 \
         --serve-arrival-span 2 --serve-verify-sample 6 \
         --serve-save-name serve_smoke
+    # Sanitized leg: the same drain under CRDT_BENCH_SANITIZE_SYNCS=1 —
+    # any host sync outside a declared `# graftlint: fence` raises at
+    # its callsite and fails this smoke (the dynamic proof of the G002
+    # "syncs only at boundaries" invariant)...
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_SYNCS=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-save-name serve_smoke_sanitized
+    # ...and the G011 fence-cost cross-check closes the loop: every
+    # declared fence must have crossed in that run's boundary_syncs
+    # counters (dead fences fail), every runtime counter must map back
+    # to a declared fence (unattributed boundaries fail).
+    exec python -m crdt_benches_tpu.lint crdt_benches_tpu --select G011 \
+      --sync-artifact bench_results/serve_smoke_sanitized.json
     ;;
   serve-faults)
     exec timeout -k 10 300 env JAX_PLATFORMS=cpu \

@@ -21,7 +21,9 @@ cd "$(dirname "$0")/.."
 
 targets=("$@")
 if [ ${#targets[@]} -eq 0 ]; then
-  targets=(crdt_benches_tpu tools)
+  # tests/ is part of the gate too (lint_fixtures/ is pruned by the
+  # walker — the corpus is intentionally dirty)
+  targets=(crdt_benches_tpu tools tests)
 fi
 
 python -m crdt_benches_tpu.lint "${targets[@]}"
