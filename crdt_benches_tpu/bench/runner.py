@@ -35,6 +35,16 @@ Families:
       bounds per-doc pending ops with --serve-overflow-policy deciding
       defer-vs-shed at the cap.  Chaos exit code is nonzero when the
       verify fails OR any injected fault goes unfired/unrecovered.
+
+      Observability: --serve-trace PATH arms the obs/trace.py span
+      tracer (Perfetto-loadable Chrome trace JSON with fence-crossing
+      instants); --serve-profile N embeds a jax.profiler top-ops table
+      of N steady rounds in the artifact's profile block; the artifact
+      always carries the versioned typed-metrics block (obs/metrics.py)
+      and per-doc admission-to-drain latency histograms by cause tag.
+      tools/bench_compare.py diffs an artifact against the committed
+      baseline (bench_results/serve_baseline.json) as the regression
+      gate.
 """
 
 from __future__ import annotations
@@ -663,6 +673,8 @@ def run_serve(args) -> int:
         queue_cap=args.serve_queue_cap,
         overflow_policy=args.serve_overflow_policy,
         save_name=args.serve_save_name,
+        trace_path=args.serve_trace,
+        profile_rounds=args.serve_profile,
         log=lambda m: print(m, file=sys.stderr),
     )
     print(
@@ -741,6 +753,16 @@ def main(argv=None) -> int:
                     help="resident rows per capacity class")
     ap.add_argument("--serve-mesh", type=int, default=0,
                     help="shard docs over N (virtual CPU) mesh devices")
+    ap.add_argument("--serve-trace", default=None, metavar="PATH",
+                    help="arm the obs/trace.py span tracer for the "
+                         "drain and write Perfetto-loadable Chrome "
+                         "trace JSON to PATH (CRDT_BENCH_TRACE=1 arms "
+                         "it too, defaulting next to the artifact)")
+    ap.add_argument("--serve-profile", type=int, default=0, metavar="N",
+                    help="capture a jax.profiler device trace of N "
+                         "steady (non-compile, non-barrier) macro-"
+                         "rounds; a top-ops table lands in the "
+                         "artifact's profile block")
     ap.add_argument("--serve-seed", type=int, default=0)
     ap.add_argument("--serve-arrival-span", type=int, default=8)
     ap.add_argument("--serve-verify-sample", type=int, default=8,

@@ -1,4 +1,4 @@
-"""graftlint rules G001-G011.
+"""graftlint rules G001-G012.
 
 Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
 registered in :data:`RULES`.  Every rule is motivated by a real hazard
@@ -746,6 +746,128 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
     return out
 
 
+# ---------------------------------------------------------------------------
+# G012 — observability hygiene in hot-path scopes
+
+#: obs-API calls that take a series NAME as their first argument.
+_OBS_NAME_CALLS = {"span", "instant", "counter", "gauge", "histogram"}
+
+#: Tracer lifecycle — never legal in a hot scope (arming inside the
+#: drain voids the disarmed-tracer no-op contract and skews timing).
+_OBS_LIFECYCLE = {"arm", "disarm", "write_trace", "SpanTracer"}
+
+
+def _is_obs_name(m, f: ast.expr) -> bool:
+    """Does this call expression denote the obs span/metric API?
+    Attribute calls (``registry.counter``, ``tracer.span``) match by
+    attr name; bare names must be imported from an obs module."""
+    if isinstance(f, ast.Attribute):
+        return f.attr in _OBS_NAME_CALLS
+    if isinstance(f, ast.Name) and f.id in _OBS_NAME_CALLS:
+        src = m.imports.get(f.id, "")
+        return "obs.trace" in src or "obs.metrics" in src
+    return False
+
+
+def _is_obs_lifecycle(m, f: ast.expr) -> str | None:
+    d = dotted(f)
+    if d is None:
+        return None
+    tail = d.split(".")[-1]
+    if tail not in _OBS_LIFECYCLE:
+        return None
+    if isinstance(f, ast.Name):
+        src = m.imports.get(f.id, "")
+        return tail if ("obs.trace" in src or tail == "SpanTracer") \
+            else None
+    root = d.split(".")[0]
+    src = m.imports.get(root, "")
+    return tail if "obs" in src else None
+
+
+def _obs_findings(fi: FuncInfo, chain: str) -> list[Finding]:
+    m = fi.module
+    out = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        life = _is_obs_lifecycle(m, f)
+        if life is not None:
+            out.append(Finding(
+                rule="G012", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"tracer lifecycle `{life}(...)` in a hot-path "
+                    f"scope ({chain}) — arming/writing belongs to the "
+                    "bench driver; inside the drain the tracer must "
+                    "stay a no-op when disarmed"
+                ),
+            ))
+            continue
+        if not _is_obs_name(m, f):
+            continue
+        name_arg = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "name"), None
+        )
+        if name_arg is None:
+            continue
+        if isinstance(name_arg, ast.Constant):
+            # a constant str name is the contract; a constant NON-str
+            # first arg means this is some other API sharing the method
+            # name (re.Match.span(1)) — not an obs callsite at all
+            continue
+        what = (
+            f.attr if isinstance(f, ast.Attribute) else f.id
+        )
+        out.append(Finding(
+            rule="G012", path=m.path, line=node.lineno,
+            col=node.col_offset,
+            msg=(
+                f"non-constant name passed to `{what}(...)` in a "
+                f"hot-path scope ({chain}) — span/metric names are "
+                "registered constants (f-strings allocate per round "
+                "and explode series cardinality); put dynamic context "
+                "in the args/tag payload"
+            ),
+        ))
+    return out
+
+
+def g012_obs_hygiene(index: PackageIndex) -> list[Finding]:
+    """Observability discipline on the serving hot path: every
+    ``obs/trace.py`` span and ``obs/metrics.py`` series created in a
+    hot-path scope must use a registered CONSTANT name (dynamic context
+    goes in args / pre-registered cause tags), and the tracer lifecycle
+    (arm / disarm / write) must never run there — the disarmed tracer
+    is a shared no-op and arming mid-drain would void that contract.
+    Unlike G002 the walk DESCENDS into declared fences: naming
+    discipline applies behind sync boundaries too."""
+    roots = [
+        fi for m in index.modules for fi in m.functions.values()
+        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
+    ]
+    out: list[Finding] = []
+    seen: set[int] = set()
+    queue: list[tuple[FuncInfo, str]] = [
+        (r, f"reached from {r.qualname}") for r in roots
+    ]
+    while queue:
+        fi, chain = queue.pop()
+        if id(fi) in seen:
+            continue
+        seen.add(id(fi))
+        out.extend(_obs_findings(fi, chain))
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                for callee in index.resolve_call(node, fi):
+                    if id(callee) not in seen:
+                        queue.append(
+                            (callee, f"{chain} -> {callee.qualname}")
+                        )
+    return out
+
+
 RULES = {
     "G001": g001_tracer_leak,
     "G002": g002_host_sync,
@@ -758,4 +880,5 @@ RULES = {
     "G009": g009_pallas_grid,
     "G010": g010_block_lane,
     "G011": g011_fence_cost,  # artifact-driven; see run_lint
+    "G012": g012_obs_hygiene,
 }

@@ -67,6 +67,21 @@ _tls = threading.local()
 _entries: dict[str, int] = {}
 _syncs: dict[str, int] = {}
 _hooks_installed = False
+#: Fence-entry observers (obs/trace.py plants one when span tracing is
+#: armed, so every boundary crossing lands on the timeline).  Empty in
+#: normal runs: the per-crossing cost stays one truthiness test.
+_fence_observers: list = []
+
+
+def add_fence_observer(cb) -> None:
+    """Register ``cb(qualname)`` to run at every fence entry."""
+    if cb not in _fence_observers:
+        _fence_observers.append(cb)
+
+
+def remove_fence_observer(cb) -> None:
+    if cb in _fence_observers:
+        _fence_observers.remove(cb)
 
 
 def sanitizing() -> bool:
@@ -189,6 +204,9 @@ def fence(name: str):
     """One declared-boundary crossing: count the entry, allow (and
     attribute) host syncs within."""
     _entries[name] = _entries.get(name, 0) + 1
+    if _fence_observers:
+        for cb in _fence_observers:
+            cb(name)
     stack = _fence_stack()
     stack.append(name)
     try:

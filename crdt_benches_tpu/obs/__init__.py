@@ -1,0 +1,27 @@
+"""Unified observability for the serve/bench stack.
+
+Three cooperating pieces, all zero-cost when disarmed:
+
+- :mod:`crdt_benches_tpu.obs.trace` — a phase-span tracer for the
+  macro-round lifecycle.  ``with span("serve.plan"):`` compiles to a
+  shared no-op context manager unless armed (``--serve-trace`` /
+  ``CRDT_BENCH_TRACE=1``); armed, it records Chrome trace-event JSON
+  loadable in Perfetto, with every ``@fenced`` boundary crossing from
+  ``lint/sanitizer.py`` emitted as an instant event inside its owning
+  span — the G011 fence model and the timeline are one picture.
+- :mod:`crdt_benches_tpu.obs.metrics` — a typed metric registry
+  (Counter / Gauge / fixed-bucket mergeable Histogram) that backs
+  ``ServeStats``: per-round latency/occupancy/queue-depth live in
+  O(buckets) histograms instead of unbounded Python lists, and the
+  serve artifact carries the whole registry as a versioned ``metrics``
+  block.
+- :mod:`crdt_benches_tpu.obs.profiler` — ``--serve-profile N`` captures
+  a ``jax.profiler`` device trace of N steady (non-compile,
+  non-barrier) macro-rounds and writes a top-ops summary into the
+  artifact.
+
+``tools/bench_compare.py`` closes the loop: it diffs a fresh serve
+artifact against the committed baseline (throughput, steady p99,
+journal overhead, boundary syncs) with noise thresholds, so the
+BENCH_r* trajectory is an enforced contract.
+"""

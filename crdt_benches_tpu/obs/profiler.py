@@ -1,0 +1,147 @@
+"""Device-profiler capture of steady serve rounds (``--serve-profile``).
+
+``tools/profile.py trace`` exists for ad-hoc kernel digs; this module
+makes the same capability a *bench artifact feature*: ask the serve
+bench for ``--serve-profile N`` and it records a ``jax.profiler``
+device trace spanning N **steady** macro-rounds — compile rounds and
+snapshot-barrier rounds are excluded by the same round classification
+that feeds the latency histograms (``ServeStats.note_round``), so the
+trace shows serving work, not XLA compilation or barrier I/O — then
+parses the trace and embeds a top-ops summary table in the artifact's
+``profile`` block.
+
+The profiler is a tiny state machine driven by two scheduler hooks:
+
+- ``round_begin()`` — called at the top of every macro-round; starts
+  the capture once at least one steady round has been observed (so the
+  hot shapes are compiled before the window opens);
+- ``round_end(steady)`` — counts steady rounds inside the window and
+  closes it after N.
+
+``finalize(fence)`` stops a still-open capture (``fence`` drains the
+device first so the trace holds completed work) and returns the
+summary dict, or None when nothing was captured.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+from collections import defaultdict
+
+
+class DeviceProfiler:
+    """Capture N steady macro-rounds with ``jax.profiler``."""
+
+    def __init__(self, n_rounds: int, logdir: str | None = None):
+        self.n_rounds = max(1, int(n_rounds))
+        self._owns_dir = logdir is None
+        self.logdir = logdir or tempfile.mkdtemp(prefix="crdt_profile_")
+        self.state = "wait"  # wait -> ready -> on -> done
+        self.captured = 0
+        self.dirty_rounds = 0  # non-steady rounds inside the window
+        self.summary: dict | None = None
+
+    # ---- scheduler hooks ----
+
+    def round_begin(self) -> None:
+        if self.state != "ready":
+            return
+        import jax
+
+        jax.profiler.start_trace(self.logdir)
+        self.state = "on"
+
+    def round_end(self, steady: bool) -> None:
+        if self.state == "wait":
+            if steady:
+                self.state = "ready"  # hot shapes compiled: open next round
+            return
+        if self.state == "on":
+            if steady:
+                self.captured += 1
+                if self.captured >= self.n_rounds:
+                    self._stop()
+            else:
+                # a late compile / snapshot barrier landed inside the
+                # window — surfaced in the summary, not hidden
+                self.dirty_rounds += 1
+
+    # ---- capture lifecycle ----
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self.state = "done"
+
+    def finalize(self, fence=None) -> dict | None:
+        """Close an open capture (fencing the device first so in-flight
+        dispatches land in the trace), parse it, and return the
+        ``profile`` artifact block.  Idempotent, and safe on a crashed
+        drain: a failing fence must not leave the capture open (a
+        dangling ``start_trace`` poisons every later profile in the
+        process)."""
+        if self.state == "on":
+            try:
+                if fence is not None:
+                    fence()
+            finally:
+                self._stop()
+        if self.state != "done":
+            self._cleanup()
+            return None
+        if self.summary is None:
+            self.summary = {
+                "rounds": self.captured,
+                "requested": self.n_rounds,
+                "dirty_rounds": self.dirty_rounds,
+                "top_ops": top_ops(self.logdir),
+            }
+            if not self._owns_dir:
+                self.summary["logdir"] = self.logdir
+            self._cleanup()
+        return self.summary
+
+    def _cleanup(self) -> None:
+        if self._owns_dir:
+            shutil.rmtree(self.logdir, ignore_errors=True)
+
+
+def top_ops(logdir: str, limit: int = 15) -> list[dict]:
+    """Aggregate the complete ("X") events of every trace file under
+    ``logdir`` into a top-ops table: total self-reported duration and
+    call count per op name, heaviest first (the same digest
+    ``tools/profile.py trace`` prints, in artifact form)."""
+    agg: dict[str, float] = defaultdict(float)
+    cnt: dict[str, int] = defaultdict(int)
+    for path in glob.glob(
+        os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True
+    ):
+        try:
+            with gzip.open(path, "rt", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            dur_ms = ev.get("dur", 0) / 1e3
+            if not name or dur_ms <= 0:
+                continue
+            # drop the profiler's host-side Python-frame events
+            # ("$scheduler.py:1231 run_round") — the table is about
+            # device/XLA op cost, not the Python call stack
+            if ".py:" in name or name.startswith("$"):
+                continue
+            agg[name] += dur_ms
+            cnt[name] += 1
+    return [
+        {"name": name[:160], "total_ms": round(ms, 3), "calls": cnt[name]}
+        for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:limit]
+    ]

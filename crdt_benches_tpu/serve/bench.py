@@ -45,10 +45,11 @@ import numpy as np
 from ..bench.harness import (
     BenchResult,
     save_results,
-    steady_quantiles,
     summarize,
 )
 from ..lint import sanitizer
+from ..obs import trace as obs_trace
+from ..obs.profiler import DeviceProfiler
 from ..oracle.text_oracle import replay_trace
 from .faults import FaultInjector, FaultPlan
 from .journal import OpJournal
@@ -124,6 +125,8 @@ def run_serve_bench(
     delivery: str | None = None,
     results_dir: str | None = None,
     save_name: str | None = None,
+    trace_path: str | None = None,
+    profile_rounds: int = 0,
     log=print,
 ) -> tuple[BenchResult, dict]:
     """Build the fleet, drain it once, verify a per-class doc sample
@@ -141,7 +144,14 @@ def run_serve_bench(
     ``serve/faults.py`` spec string or FaultPlan; ``queue_cap`` bounds
     each doc's pending ops with ``overflow_policy`` deciding
     defer-vs-shed at the cap (chaos with ``queue_overflow`` events
-    auto-defaults the cap to ``8 * batch`` when unset)."""
+    auto-defaults the cap to ``8 * batch`` when unset).
+
+    Observability knobs: ``trace_path`` arms the ``obs/trace.py`` span
+    tracer for the drain and writes Perfetto-loadable Chrome trace JSON
+    there (``CRDT_BENCH_TRACE=1`` arms it too, defaulting the path next
+    to the artifact); ``profile_rounds`` > 0 captures a ``jax.profiler``
+    device trace of that many steady rounds and embeds a top-ops table
+    in the artifact's ``profile`` block."""
     classes = _parse_int_tuple(classes)
     slots = _parse_int_tuple(slots)
     mix_name = mix if isinstance(mix, str) else "custom"
@@ -195,12 +205,15 @@ def run_serve_bench(
             f"mesh={mesh_devices if mesh else 'off'}"
         )
 
+        profiler = DeviceProfiler(profile_rounds) \
+            if profile_rounds > 0 else None
         sched = FleetScheduler(
             pool, streams, batch=batch, macro_k=macro_k,
             batch_chars=batch_chars,
             queue_cap=queue_cap, overflow_policy=overflow_policy,
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
+            profiler=profiler,
         )
         # per-fence boundary-sync counters cover drain + verify; with
         # CRDT_BENCH_SANITIZE_SYNCS=1 any sync outside a declared fence
@@ -209,16 +222,52 @@ def run_serve_bench(
         sanitized = sanitizer.sanitizing()
         if sanitized:
             log("serve: sync sanitizer ARMED (CRDT_BENCH_SANITIZE_SYNCS)")
-        stats = sched.run()
+        # span tracing: an explicit trace_path arms it; CRDT_BENCH_TRACE=1
+        # arms it too, defaulting the file next to the artifact
+        if trace_path is None and obs_trace.env_armed():
+            trace_path = os.path.join(
+                results_dir or "bench_results",
+                f"{save_name or f'serve_{mix_name}_{n_docs}'}_trace.json",
+            )
+        tracer = None
+        armed_here = False
+        if trace_path:
+            obs_trace.arm()
+            armed_here = True
+            log(f"serve: span tracer ARMED -> {trace_path}")
+        profile_block = None
+        try:
+            stats = sched.run()
+        finally:
+            # only release what THIS run acquired: a failed drain must
+            # not hijack a caller-armed tracer, and an open profiler
+            # capture must be closed or the next start_trace errors
+            if armed_here:
+                tracer = obs_trace.disarm()
+            if profiler is not None:
+                profile_block = profiler.finalize(fence=pool.block)
+        if tracer is not None:
+            tracer.write(trace_path)
+            log(f"serve: wrote {len(tracer.events)} trace events to "
+                f"{trace_path} (load in Perfetto / chrome://tracing)")
+        if profiler is not None:
+            if profile_block is None:
+                log("serve: profiler captured no steady rounds "
+                    "(drain too short?)")
+            else:
+                top = profile_block["top_ops"][:3]
+                log(f"serve: profiled {profile_block['rounds']} steady "
+                    "rounds; top ops: "
+                    + ", ".join(
+                        f"{o['name']} {o['total_ms']:.1f}ms" for o in top
+                    ))
         assert sched.done, "scheduler stopped with pending work"
         # steady-state latency excludes BOTH compile rounds and snapshot
-        # barrier rounds (forced syncs, reported separately)
-        skip = [c or b for c, b in zip(stats.compile_flags,
-                                       stats.barrier_flags)]
-        lat, _, _ = steady_quantiles(stats.round_latencies, skip)
-        _, compile_time, compile_rounds = steady_quantiles(
-            stats.round_latencies, stats.compile_flags
-        )
+        # barrier rounds — ServeStats.note_round is the single
+        # classification point; the histogram carries the quantiles
+        lat = stats.latency_quantiles()
+        compile_time = stats.compile_time
+        compile_rounds = stats.compile_rounds
         throughput = stats.patches / stats.wall_time
         log(
             f"serve: drained in {stats.wall_time:.2f}s over {stats.rounds} "
@@ -318,8 +367,7 @@ def run_serve_bench(
                f"transfers" if sanitized else "")
         )
 
-        occ = float(np.mean(stats.occupancy)) if stats.occupancy else 0.0
-        qd = stats.queue_depth or [0]
+        occ = stats.occupancy.mean
         r = BenchResult(
             group="serve",
             trace=mix_name,
@@ -346,9 +394,12 @@ def run_serve_bench(
                 "batch_latency": lat,
                 "compile_time": compile_time,
                 "compile_rounds": compile_rounds,
+                "barrier_time": stats.barrier_time,
+                "barrier_rounds": stats.barrier_rounds,
+                "steady_rounds": stats.steady_rounds,
                 "occupancy_mean": occ,
-                "queue_depth_mean": float(np.mean(qd)),
-                "queue_depth_max": int(np.max(qd)),
+                "queue_depth_mean": stats.queue_depth.mean,
+                "queue_depth_max": int(stats.queue_depth.vmax or 0),
                 "evictions": stats.evictions,
                 "restores": stats.restores,
                 "promotions": stats.promotions,
@@ -380,6 +431,22 @@ def run_serve_bench(
                 },
                 "faults": fault_summary,
                 "boundary_syncs": boundary_syncs,
+                # versioned typed-metric registry: every counter /
+                # gauge / histogram the drain emitted (obs/metrics.py)
+                "metrics": stats.metrics.to_dict(),
+                # per-doc admission-to-drain latency by cause tag
+                "doc_drain_latency": {
+                    tag: {
+                        "count": h.count,
+                        "quantiles": (
+                            h.quantiles((0.5, 0.99, 0.999))
+                            if h.count else None
+                        ),
+                    }
+                    for tag, h in sorted(stats.doc_latency.items())
+                },
+                "profile": profile_block,
+                "trace": trace_path if tracer is not None else None,
                 "docs_per_class": {
                     str(c): len(v) for c, v in sorted(by_class.items())
                 },

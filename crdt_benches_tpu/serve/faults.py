@@ -48,6 +48,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs.trace import instant
+
 KINDS = (
     "spool_corrupt",
     "spool_truncate",
@@ -69,11 +71,21 @@ class FaultEvent:
     fired_round: int = -1
     recovered: bool = False
     detail: dict = field(default_factory=dict)
+    # per-kind fired counters, shared across a plan's events (set by
+    # FaultInjector.bind_metrics; None outside an instrumented drain)
+    counters: dict | None = field(
+        default=None, repr=False, compare=False
+    )
 
     def fire(self, rnd: int, **detail) -> None:
         self.fired = True
         self.fired_round = rnd
         self.detail.update(detail)
+        if self.counters is not None:
+            self.counters[self.kind].inc()
+        # timeline marker (no-op unless span tracing is armed); the
+        # constant event name keeps G012 happy — kind rides in args
+        instant("serve.fault", kind=self.kind, round=rnd)
 
     def to_dict(self) -> dict:
         return {
@@ -159,6 +171,16 @@ class FaultInjector:
     def __init__(self, plan: FaultPlan):
         self.plan = plan
         self.rng = np.random.default_rng(plan.seed ^ 0x9E3779B9)
+
+    def bind_metrics(self, registry) -> None:
+        """Pre-register one fired-counter per fault kind (constant
+        names, built OFF the hot path) and hand the table to every
+        event so ``FaultEvent.fire`` emits through the registry."""
+        counters = {
+            k: registry.counter("serve.faults.fired." + k) for k in KINDS
+        }
+        for e in self.plan.events:
+            e.counters = counters
 
     def _pending(self, rnd: int, *kinds: str) -> FaultEvent | None:
         for e in self.plan.events:

@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs.metrics import Counter
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
     CorruptCheckpointError,
@@ -88,8 +89,21 @@ class OpJournal:
                 with open(self.path, "r+b") as f:
                     f.truncate(good)
         self._f = open(self.path, "a", encoding="utf-8")
-        self.records = 0
-        self.bytes_written = 0
+        self._m_records = Counter("serve.journal.records")
+        self._m_bytes = Counter("serve.journal.bytes")
+
+    def bind_metrics(self, registry) -> None:
+        """Attach the journal's counters to a drain's MetricsRegistry."""
+        registry.attach(self._m_records)
+        registry.attach(self._m_bytes)
+
+    @property
+    def records(self) -> int:
+        return self._m_records.value
+
+    @property
+    def bytes_written(self) -> int:
+        return self._m_bytes.value
 
     def append(self, obj: dict) -> None:
         payload = json.dumps(obj, separators=(",", ":"))
@@ -98,8 +112,8 @@ class OpJournal:
         self._f.flush()
         if self.fsync:
             os.fsync(self._f.fileno())
-        self.records += 1
-        self.bytes_written += len(line)
+        self._m_records.inc()
+        self._m_bytes.inc(len(line))
 
     def round_record(
         self, rnd: int, lanes: dict[int, list[tuple[int, int, int]]]

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..lint.boundary import boundary
 from ..lint.sanitizer import fenced
+from ..obs.metrics import Counter
 from ..ops.apply2 import LANE, PackedState, apply_batch3
 from ..ops.apply_range import apply_range_batch
 from ..ops.resolve import resolve_batch
@@ -260,11 +261,54 @@ class DocPool:
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="crdt_serve_")
         os.makedirs(self.spool_dir, exist_ok=True)
         self._macro_fns: dict[tuple, object] = {}
-        # counters (reported by the scheduler / bench)
-        self.evictions = 0
-        self.restores = 0
-        self.promotions = 0
-        self.fresh_admits = 0
+        # counters (reported by the scheduler / bench): typed
+        # obs/metrics.py Counters so a serve drain's registry carries
+        # them in the artifact's metrics block (bind_metrics); the
+        # int-valued properties below keep the historical accessors.
+        self._counters = {
+            name: Counter("serve.pool." + name)
+            for name in ("evictions", "restores", "promotions",
+                         "fresh_admits")
+        }
+
+    def bind_metrics(self, registry) -> None:
+        """Attach this pool's counters to a drain's MetricsRegistry
+        (identity-preserving: the pool keeps incrementing the same
+        objects the registry now serializes)."""
+        for c in self._counters.values():
+            registry.attach(c)
+
+    @property
+    def evictions(self) -> int:
+        return self._counters["evictions"].value
+
+    @evictions.setter
+    def evictions(self, v: int) -> None:
+        self._counters["evictions"].value = int(v)
+
+    @property
+    def restores(self) -> int:
+        return self._counters["restores"].value
+
+    @restores.setter
+    def restores(self, v: int) -> None:
+        self._counters["restores"].value = int(v)
+
+    @property
+    def promotions(self) -> int:
+        return self._counters["promotions"].value
+
+    @promotions.setter
+    def promotions(self, v: int) -> None:
+        self._counters["promotions"].value = int(v)
+
+    @property
+    def fresh_admits(self) -> int:
+        return self._counters["fresh_admits"].value
+
+    @fresh_admits.setter
+    def fresh_admits(self, v: int) -> None:
+        self._counters["fresh_admits"].value = int(v)
 
     # ---- registration / class arithmetic ----
 

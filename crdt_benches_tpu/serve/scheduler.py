@@ -78,6 +78,14 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..lint.sanitizer import fenced, hot_path
+from ..obs.metrics import (
+    DEPTH_BUCKETS,
+    LATENCY_BUCKETS_S,
+    OCCUPANCY_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+)
+from ..obs.trace import span
 from ..traces.tensorize import (
     INSERT,
     PAD,
@@ -206,16 +214,38 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
     return streams
 
 
+#: Cause tags for the per-doc admission-to-drain latency series: how the
+#: doc's stream ENDED.  Fixed set, pre-registered — G012 forbids
+#: interpolating tag names on the hot path.
+DOC_CAUSE_TAGS = ("ok", "deferred", "shed", "quarantined")
+
+
 @dataclass
 class ServeStats:
-    """One drain's telemetry (the serve family's report surface)."""
+    """One drain's telemetry (the serve family's report surface).
 
-    round_latencies: list[float] = field(default_factory=list)
-    compile_flags: list[bool] = field(default_factory=list)  # per round
-    barrier_flags: list[bool] = field(default_factory=list)  # snapshot rounds
-    occupancy: list[float] = field(default_factory=list)  # per round
-    queue_depth: list[int] = field(default_factory=list)  # per round
+    Per-round series live in fixed-bucket ``obs/metrics.py`` histograms
+    registered in :attr:`metrics` — a million-round drain holds
+    O(buckets) telemetry, not three million-float Python lists (the
+    pre-obs ``occupancy`` / ``queue_depth`` / ``round_latencies``
+    growth bug).  :meth:`note_round` is THE compile/barrier
+    classification point: histograms, spans, the profiler's
+    steady-round window, and the artifact's compile/barrier accounting
+    all key off its flags — one source of truth.
+    """
+
+    metrics: MetricsRegistry = field(default_factory=MetricsRegistry)
+    # test-only: retain raw per-round lists so parity tests can compare
+    # histogram quantiles against the exact-list quantiles they replaced
+    keep_raw: bool = False
+    raw_round_latencies: list[float] = field(default_factory=list)
+    raw_compile_flags: list[bool] = field(default_factory=list)
+    raw_barrier_flags: list[bool] = field(default_factory=list)
     rounds: int = 0  # macro-rounds dispatched
+    compile_time: float = 0.0  # wall time of compile-flagged rounds
+    compile_rounds: int = 0
+    barrier_time: float = 0.0  # wall time of snapshot-barrier rounds
+    barrier_rounds: int = 0
     slices: int = 0  # inner device rounds (sum of K_eff per class)
     ops: int = 0  # coalesced range ops applied
     unit_ops: int = 0  # unit-op equivalent (sum of run lengths)
@@ -244,6 +274,68 @@ class ServeStats:
     snapshots: int = 0
     snapshot_time: float = 0.0
 
+    def __post_init__(self):
+        m = self.metrics
+        self.lat_steady = m.histogram(
+            "serve.round.latency.steady", LATENCY_BUCKETS_S
+        )
+        self.lat_skipped = m.histogram(
+            "serve.round.latency.skipped", LATENCY_BUCKETS_S
+        )
+        self.occupancy = m.histogram(
+            "serve.round.occupancy", OCCUPANCY_BUCKETS
+        )
+        self.queue_depth = m.histogram(
+            "serve.round.queue_depth", DEPTH_BUCKETS
+        )
+        self.doc_latency = {
+            tag: m.histogram(
+                "serve.doc.drain_latency." + tag, LATENCY_BUCKETS_S
+            )
+            for tag in DOC_CAUSE_TAGS
+        }
+
+    def note_round(self, latency: float, compiled: bool,
+                   barrier: bool) -> None:
+        """Record one macro-round.  THE round-classification rule:
+        compile-flagged rounds (cold-start skew) and snapshot-barrier
+        rounds (forced syncs) are excluded from the steady latency
+        histogram and accounted separately — every consumer (artifact
+        quantiles, trace spans, the device profiler's capture window)
+        keys off these same two flags."""
+        self.rounds += 1
+        if compiled:
+            self.compile_time += latency
+            self.compile_rounds += 1
+            self.lat_skipped.observe(latency)
+        elif barrier:
+            self.barrier_time += latency
+            self.barrier_rounds += 1
+            self.lat_skipped.observe(latency)
+        else:
+            self.lat_steady.observe(latency)
+        if self.keep_raw:
+            self.raw_round_latencies.append(latency)
+            self.raw_compile_flags.append(compiled)
+            self.raw_barrier_flags.append(barrier)
+
+    @property
+    def steady_rounds(self) -> int:
+        return self.lat_steady.count
+
+    def latency_quantiles(self, ps=(0.5, 0.95, 0.99)) -> dict[str, float]:
+        """Steady-round latency quantiles; falls back to ALL rounds
+        when every round was compile/barrier-flagged (tiny drains) —
+        the same fallback ``bench/harness.py steady_quantiles`` applies
+        to raw lists."""
+        if self.lat_steady.count:
+            return self.lat_steady.quantiles(ps)
+        if self.lat_skipped.count:
+            return Histogram.merged(
+                self.lat_steady, self.lat_skipped
+            ).quantiles(ps)
+        return {f"p{100 * p:g}": 0.0 for p in ps}
+
     @property
     def coalesce_ratio(self) -> float:
         """Unit ops represented per staged range op (>= 1; the RLE win)."""
@@ -257,9 +349,10 @@ class ServeStats:
             return 0.0
         return 1.0 - self.ops / self.staged_cells
 
-    # NOTE: compile-time / steady-latency derivation lives in ONE place,
-    # bench/harness.py steady_quantiles (compile_flags feed it;
-    # barrier_flags mark snapshot rounds, excluded the same way).
+    def note_doc_drained(self, tag: str, seconds: float) -> None:
+        """One document finished (or was explicitly ended): record its
+        admission-to-drain latency under its cause tag."""
+        self.doc_latency[tag].observe(seconds)
 
 
 @dataclass
@@ -300,7 +393,7 @@ class FleetScheduler:
                  snapshot_every: int = 0, snapshot_keep: int = 2,
                  degrade_after: int = 3, degrade_window: int = 8,
                  degrade_rounds: int = 4,
-                 start_round: int = 0):
+                 start_round: int = 0, profiler=None):
         if overflow_policy not in ("defer", "shed"):
             raise ValueError(f"unknown overflow policy {overflow_policy!r}")
         self.pool = pool
@@ -340,6 +433,18 @@ class FleetScheduler:
         self.stats = ServeStats(
             patches=sum(s.n_patches for s in streams.values())
         )
+        self.profiler = profiler  # obs/profiler.py DeviceProfiler (or None)
+        self._pending_round: tuple[float, bool, bool] | None = None
+        self._admit_t: dict[int, float] = {}  # doc -> first-admission time
+        # one registry per drain: pool / journal / fault counters attach
+        # to it so the artifact's metrics block carries the whole run
+        reg = self.stats.metrics
+        pool.bind_metrics(reg)
+        if journal is not None:
+            journal.bind_metrics(reg)
+        if faults is not None:
+            faults.bind_metrics(reg)
+        self._m_faults_seen = reg.counter("serve.faults.seen")
 
     # ---- degradation (automatic macro-K -> K=1 fallback) ----
 
@@ -353,6 +458,7 @@ class FleetScheduler:
         (or extend) the K=1 synchronous fallback for ``degrade_rounds``
         dispatched rounds, starting with the next planned round."""
         self.stats.faults_seen += 1
+        self._m_faults_seen.inc()
         self._fault_rounds.append(self.round)
         while (self._fault_rounds
                and self._fault_rounds[0] < self.round - self.degrade_window):
@@ -418,6 +524,24 @@ class FleetScheduler:
             c = e
         return takes, c
 
+    def _note_doc_drained(self, st: DocStream, tag: str | None = None
+                          ) -> None:
+        """One doc's stream is finished (drained, shed empty, or
+        quarantined): record admission-to-drain latency under its cause
+        tag.  Pops the admission timestamp, so the first observation
+        wins and a doc is never double-counted."""
+        t0 = self._admit_t.pop(st.doc_id, None)
+        if t0 is None:
+            return  # never admitted (or already recorded)
+        if tag is None:
+            if st.lossy:
+                tag = "shed"
+            elif st.deferred_high > 0:
+                tag = "deferred"
+            else:
+                tag = "ok"
+        self.stats.note_doc_drained(tag, time.perf_counter() - t0)
+
     def _select(self, plan: _Plan) -> None:
         """Pick this macro-round's lanes: {class: [_Lane]}, bounded by
         each bucket's row count, in round-robin order."""
@@ -428,6 +552,7 @@ class FleetScheduler:
             st = self.streams[doc_id]
             self._deliver(st)
             if st.remaining == 0:
+                self._note_doc_drained(st)
                 continue  # drained/shed: drop from the rotation for good
             if st.arrival > self.round:
                 deferred.append(doc_id)
@@ -460,6 +585,8 @@ class FleetScheduler:
                 deferred.append(doc_id)
                 continue
             lanes.append(_Lane(stream=st, takes=takes, end=end))
+            if doc_id not in self._admit_t:
+                self._admit_t[doc_id] = time.perf_counter()
             scheduled.append(doc_id)
         # rotation: scheduled docs go to the back; deferred keep order.
         self._rr.extend(deferred)
@@ -720,6 +847,8 @@ class FleetScheduler:
                     self.journal.event(
                         "shed", r=self.round, doc=doc, at=keep, ops=shed
                     )
+                if st.remaining == 0:
+                    self._note_doc_drained(st)  # shed ended the stream
         else:
             # defer: the bounded queue refuses the burst; the producer
             # holds the excess and redelivers under backpressure
@@ -787,6 +916,7 @@ class FleetScheduler:
             rec.cls = rec.row = None
         rec.spool = None
         self._dead_lanes.add(doc_id)
+        self._note_doc_drained(st, tag="quarantined")
         self.stats.quarantines.append({
             "doc": doc_id, "round": self.round, "reason": reason,
             "shed_ops": shed,
@@ -818,12 +948,13 @@ class FleetScheduler:
         try:
             if self.faults is not None and self.faults.poisoned(doc_id):
                 raise RuntimeError("rebuild poisoned by fault plan")
-            base = self._bases.base(doc_id)
-            row_v, L, nv, disp = rebuild_doc(
-                st, cls, base, st.cursor, n_init=rec.n_init,
-                batch=self.batch, batch_chars=self.batch_chars,
-                nbits=self.nbits, macro_k=self.effective_k,
-            )
+            with span("serve.recover.spool", doc=doc_id):
+                base = self._bases.base(doc_id)
+                row_v, L, nv, disp = rebuild_doc(
+                    st, cls, base, st.cursor, n_init=rec.n_init,
+                    batch=self.batch, batch_chars=self.batch_chars,
+                    nbits=self.nbits, macro_k=self.effective_k,
+                )
             start = min(base[3], st.cursor) if base is not None else 0
             self.stats.recoveries += 1
             self.stats.ops_replayed += st.cursor - start
@@ -1023,7 +1154,8 @@ class FleetScheduler:
             if self.faults is not None:
                 ev = self.faults.device_loss_event(self.round, cls)
                 if ev is not None:
-                    self._recover_class(cls, plan, ev)
+                    with span("serve.recover.class", cls=cls):
+                        self._recover_class(cls, plan, ev)
         return compiled
 
     def _advance(self, plan: _Plan) -> None:
@@ -1047,10 +1179,12 @@ class FleetScheduler:
                 rec.length = rec.n_init + st.ins_before(lane.end)
                 rec.last_sched = plan.base_round
                 lanes_used += 1
+                if st.remaining == 0:
+                    self._note_doc_drained(st)
         self._dead_lanes.clear()
         total_lanes = sum(b.R for b in self.pool.buckets.values())
-        self.stats.occupancy.append(lanes_used / total_lanes)
-        self.stats.queue_depth.append(plan.waiting)
+        self.stats.occupancy.observe(lanes_used / total_lanes)
+        self.stats.queue_depth.observe(plan.waiting)
         if self._planned_degraded:
             self.stats.degraded_rounds += 1
             self._degrade_left -= 1
@@ -1072,7 +1206,8 @@ class FleetScheduler:
             return
         if self._n_rounds % self.snapshot_every:
             return
-        self._snapshot_barrier()
+        with span("serve.snapshot"):
+            self._snapshot_barrier()
         self._snapped = True
 
     @fenced
@@ -1105,35 +1240,61 @@ class FleetScheduler:
         its exact callsite — the dynamic proof of the static G002
         model.  Unarmed, the scope is a no-op."""
         with hot_path():
+            if self.profiler is not None:
+                self.profiler.round_begin()
             t0 = time.perf_counter()
-            if self.faults is not None:
-                self._fire_overflow()
-            plan = self._plan()
-            if plan is None:
-                return False
-            if self.journal is not None:
-                # write-ahead: the lane set is durable BEFORE dispatch
-                self.journal.round_record(plan.base_round, {
-                    cls: [[l.stream.doc_id, int(l.stream.cursor),
-                           int(l.end)]
-                          for l in lanes]
-                    for cls, lanes in plan.lanes.items()
-                })
-            tensors = self._stage(plan)
-            if self.faults is not None:
-                self._maybe_stall(plan.base_round)
-            self._execute_moves(plan)
-            if self.faults is not None:
-                self._fire_spool_fault(plan)
-            compiled = self._dispatch(plan, tensors)
-            self._advance(plan)
-            if self._planned_degraded:
-                self.pool.block()  # degraded mode is SYNCHRONOUS K=1
-            self._maybe_snapshot()
-            self.stats.round_latencies.append(time.perf_counter() - t0)
-            self.stats.compile_flags.append(compiled)
-            self.stats.barrier_flags.append(self._snapped)
+            with span("serve.round", round=self.round):
+                if self.faults is not None:
+                    with span("serve.faults.inject"):
+                        self._fire_overflow()
+                with span("serve.plan"):
+                    plan = self._plan()
+                if plan is None:
+                    return False
+                if self.journal is not None:
+                    # write-ahead: the lane set is durable BEFORE dispatch
+                    with span("serve.journal.wal"):
+                        self.journal.round_record(plan.base_round, {
+                            cls: [[l.stream.doc_id, int(l.stream.cursor),
+                                   int(l.end)]
+                                  for l in lanes]
+                            for cls, lanes in plan.lanes.items()
+                        })
+                with span("serve.stage"):
+                    tensors = self._stage(plan)
+                if self.faults is not None:
+                    self._maybe_stall(plan.base_round)
+                with span("serve.moves"):
+                    self._execute_moves(plan)
+                if self.faults is not None:
+                    with span("serve.faults.inject"):
+                        self._fire_spool_fault(plan)
+                with span("serve.dispatch"):
+                    compiled = self._dispatch(plan, tensors)
+                self._advance(plan)
+                if self._planned_degraded:
+                    with span("serve.degraded_fence"):
+                        self.pool.block()  # degraded mode: SYNCHRONOUS K=1
+                self._maybe_snapshot()
+            # record the PREVIOUS round now and hold this one pending,
+            # so run() can fold the final drain fence into the last
+            # round's latency before it reaches the histogram
+            self._flush_round()
+            self._pending_round = (
+                time.perf_counter() - t0, compiled, self._snapped
+            )
+            if self.profiler is not None:
+                self.profiler.round_end(
+                    steady=not compiled and not self._snapped
+                )
             return True
+
+    def _flush_round(self) -> None:
+        """Commit the held round's latency through the single
+        classification point (``ServeStats.note_round``)."""
+        if self._pending_round is not None:
+            self.stats.note_round(*self._pending_round)
+            self._pending_round = None
 
     def run(self, max_rounds: int | None = None) -> ServeStats:
         """Drain every queue (or stop after ``max_rounds`` macro-rounds).
@@ -1147,13 +1308,18 @@ class FleetScheduler:
             if max_rounds is not None and n >= max_rounds:
                 break
         tail0 = time.perf_counter()
-        self.pool.block()  # final fence: the last macro-round's drain
-        if self.stats.round_latencies:
-            self.stats.round_latencies[-1] += time.perf_counter() - tail0
+        with span("serve.drain_fence"):
+            self.pool.block()  # final fence: the last macro-round's drain
+        if self._pending_round is not None:
+            dt, c, b = self._pending_round
+            self._pending_round = (
+                dt + time.perf_counter() - tail0, c, b
+            )
+        self._flush_round()
         if self.faults is not None and max_rounds is None:
-            self.finalize_faults()
+            with span("serve.finalize_faults"):
+                self.finalize_faults()
         self.stats.wall_time += time.perf_counter() - t0
-        self.stats.rounds = len(self.stats.round_latencies)
         self.stats.evictions = self.pool.evictions
         self.stats.restores = self.pool.restores
         self.stats.promotions = self.pool.promotions

@@ -1,0 +1,38 @@
+"""G012 seed: observability hygiene in hot-path scopes.
+
+``macro_dispatch`` is the declared hot root; ``_plan_phase`` is
+reached from it.  Constant span/metric names there are clean; an
+f-string span name, a variable histogram name, and arming the tracer
+mid-drain are the three violations.  ``off_hot_path`` shows the same
+dynamic naming is LEGAL outside the hot call graph.
+"""
+
+from crdt_benches_tpu.obs.metrics import MetricsRegistry
+from crdt_benches_tpu.obs.trace import arm, span
+
+REG = MetricsRegistry()
+
+
+def macro_dispatch(depth):  # graftlint: hot-path
+    with span("fixture.round"):  # constant name: clean
+        _plan_phase(depth)
+    REG.counter("fixture.rounds").inc()  # constant name: clean
+
+
+def _plan_phase(depth):
+    with span(f"fixture.plan.{depth}"):  # expect: G012
+        pass
+    name = "fixture.depth." + str(depth)
+    REG.histogram(name)  # expect: G012
+    arm()  # expect: G012
+
+
+def off_hot_path(depth):
+    # unreachable from any hot root: dynamic names carry no risk here
+    REG.counter(f"tool.{depth}").inc()
+
+
+def hot_regex_user(match):  # graftlint: hot-path
+    # an unrelated API sharing a method name (re.Match.span) takes a
+    # constant NON-str first arg: not an obs callsite, stays clean
+    return match.span(1)

@@ -156,7 +156,7 @@ def test_every_rule_has_a_detection_case():
         covered |= {r for r, _ in expected_markers(p)}
     assert {
         "G001", "G002", "G003", "G004", "G005", "G006", "G007",
-        "G008", "G009", "G010", "G011",
+        "G008", "G009", "G010", "G011", "G012",
     } <= covered
 
 

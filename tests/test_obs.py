@@ -1,0 +1,429 @@
+"""obs/: span tracer, typed metric registry, device profiler, and the
+bench_compare regression gate.
+
+Contracts under test:
+
+- disarmed tracing is the IDENTITY path (one shared no-op object, like
+  the unset-@boundary decorator);
+- armed tracing emits schema-valid Chrome trace JSON: spans nest, fence
+  crossings land as instants inside their owning span;
+- histograms round-trip through the artifact, merge associatively, and
+  reproduce exact-list quantiles within bucket resolution (the parity
+  guarantee that let ServeStats drop its unbounded lists);
+- per-doc admission-to-drain latency is attributed to the right cause
+  tag under injected shed / quarantine faults;
+- ``tools/bench_compare.py`` fails a synthetic regression and passes an
+  identical artifact.
+"""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from crdt_benches_tpu.bench.harness import steady_quantiles
+from crdt_benches_tpu.obs import trace as obs_trace
+from crdt_benches_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from crdt_benches_tpu.obs.trace import (
+    NOOP_SPAN,
+    arm,
+    disarm,
+    instant,
+    span,
+    validate_trace,
+    validate_trace_file,
+)
+from crdt_benches_tpu.serve.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import (
+    DOC_CAUSE_TAGS,
+    FleetScheduler,
+    prepare_streams,
+)
+from crdt_benches_tpu.serve.workload import build_fleet
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_BANDS = {"synth-small": ("synth", (40, 120))}
+TINY_MIX = {"synth-small": 1.0}
+
+
+def _fleet(tmp_path, n=6, seed=11, classes=(128,), slots=(2,), **kw):
+    sessions = build_fleet(
+        n, mix=TINY_MIX, seed=seed, arrival_span=2, bands=TINY_BANDS
+    )
+    pool = DocPool(classes=classes, slots=slots,
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32, **kw)
+    return sessions, pool, streams, sched
+
+
+# ---------------------------------------------------------------------------
+# tracer: disarmed identity, armed schema
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_span_is_the_shared_noop():
+    """The zero-overhead contract: with no tracer armed, every span()
+    call returns THE SAME no-op object — no allocation, no clock read
+    (the @boundary identity-path analog)."""
+    assert not obs_trace.armed()
+    s1 = span("serve.plan")
+    s2 = span("serve.dispatch", round=7)
+    assert s1 is NOOP_SPAN and s2 is NOOP_SPAN
+    with s1:
+        pass  # enter/exit are empty
+    instant("serve.fault", kind="stall")  # no-op, no error
+
+
+def test_armed_tracer_records_nested_spans_and_validates():
+    tracer = arm()
+    try:
+        with span("outer", round=1):
+            with span("inner"):
+                instant("tick", n=3)
+    finally:
+        assert disarm() is tracer
+    doc = tracer.to_dict()
+    assert validate_trace(doc) == []
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names == ["tick", "inner", "outer"]  # spans close inner-first
+    inner = next(e for e in doc["traceEvents"] if e["name"] == "inner")
+    outer = next(e for e in doc["traceEvents"] if e["name"] == "outer")
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    tick = next(e for e in doc["traceEvents"] if e["name"] == "tick")
+    assert tick["args"]["span"] == "inner"
+    # disarmed again: back to the identity path
+    assert span("outer") is NOOP_SPAN
+
+
+def test_validator_rejects_malformed_traces():
+    assert validate_trace([]) != []  # not a dict
+    assert validate_trace({"traceEvents": [{"ph": "X"}]})  # missing keys
+    # partially overlapping spans on one thread = corrupted stack
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "a", "ts": 0, "dur": 10, "pid": 1, "tid": 1},
+        {"ph": "X", "name": "b", "ts": 5, "dur": 10, "pid": 1, "tid": 1},
+    ]}
+    assert any("overlap" in e for e in validate_trace(bad))
+    # a fence instant outside every span is a finding
+    orphan = {"traceEvents": [
+        {"ph": "X", "name": "a", "ts": 0, "dur": 5, "pid": 1, "tid": 1},
+        {"ph": "i", "s": "t", "name": "f", "cat": "fence", "ts": 50,
+         "pid": 1, "tid": 1},
+    ]}
+    assert any("inside no span" in e for e in validate_trace(orphan))
+
+
+def test_traced_drain_emits_valid_trace_with_fence_instants(tmp_path):
+    """A real (tiny) drain under the armed tracer: schema-valid, the
+    macro-round phases all present, and every declared-fence crossing
+    recorded as an instant inside its owning span."""
+    sessions, pool, streams, sched = _fleet(tmp_path)
+    tracer = arm()
+    try:
+        sched.run()
+    finally:
+        disarm()
+    assert sched.done
+    doc = tracer.to_dict()
+    assert validate_trace(doc) == []
+    span_names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    for phase in ("serve.round", "serve.plan", "serve.stage",
+                  "serve.moves", "serve.dispatch", "serve.drain_fence"):
+        assert phase in span_names, f"missing phase span {phase}"
+    fences = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "i" and e.get("cat") == obs_trace.FENCE_CAT
+    ]
+    assert fences, "no fence crossings on the timeline"
+    names = {e["name"] for e in fences}
+    # the oversubscribed fleet must move rows -> boundary pulls fence
+    assert "DocPool.pull_bucket" in names
+    assert "DocPool.block" in names
+    assert all((e.get("args") or {}).get("span") for e in fences)
+    # file round-trip + CLI validator contract
+    path = tracer.write(str(tmp_path / "trace.json"))
+    assert validate_trace_file(path) == []
+
+
+# ---------------------------------------------------------------------------
+# metrics: round-trip, merge, quantile parity
+# ---------------------------------------------------------------------------
+
+
+def test_registry_serialization_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("a.count").inc(7)
+    reg.gauge("a.gauge").set(1.5)
+    reg.gauge("a.gauge").set(-2.0)
+    h = reg.histogram("a.lat", LATENCY_BUCKETS_S)
+    for v in (0.001, 0.01, 0.01, 0.5, 3.0):
+        h.observe(v)
+    blob = json.dumps(reg.to_dict())  # artifact form: JSON-serializable
+    back = MetricsRegistry.from_dict(json.loads(blob))
+    assert back.to_dict() == reg.to_dict()
+    assert back.counters["a.count"].value == 7
+    assert back.gauges["a.gauge"].value == -2.0
+    assert back.gauges["a.gauge"].vmax == 1.5
+    h2 = back.histograms["a.lat"]
+    assert h2.count == 5 and h2.vmin == 0.001 and h2.vmax == 3.0
+    assert h2.quantile(0.5) == pytest.approx(h.quantile(0.5))
+    # version drift is an error, not a silent misread
+    stale = json.loads(blob)
+    stale["version"] = 999
+    with pytest.raises(ValueError):
+        MetricsRegistry.from_dict(stale)
+
+
+def test_histogram_merge_is_associative_and_exactish():
+    import random
+
+    rng = random.Random(7)
+    hs = []
+    for i in range(3):
+        h = Histogram(f"h{i}", LATENCY_BUCKETS_S)
+        for _ in range(200):
+            h.observe(rng.lognormvariate(-4, 1.5))
+        hs.append(h)
+    a, b, c = hs
+    left = Histogram.merged(Histogram.merged(a, b), c)
+    right = Histogram.merged(a, Histogram.merged(b, c))
+    # bucket state is exactly associative; the float `sum` is only
+    # associative up to rounding
+    assert left.counts == right.counts
+    assert (left.count, left.vmin, left.vmax) == (
+        right.count, right.vmin, right.vmax
+    )
+    assert left.total == pytest.approx(right.total)
+    assert left.count == 600
+    assert left.total == pytest.approx(a.total + b.total + c.total)
+    # merged quantiles stay within one bucket of each input's range
+    assert left.vmin == min(h.vmin for h in hs)
+    assert left.vmax == max(h.vmax for h in hs)
+
+
+def test_histogram_quantiles_match_exact_within_bucket_resolution():
+    import random
+
+    rng = random.Random(3)
+    xs = [rng.lognormvariate(-5, 1.0) for _ in range(5000)]
+    h = Histogram("lat", LATENCY_BUCKETS_S)
+    for x in xs:
+        h.observe(x)
+    xs.sort()
+    factor = 2.0 ** (1.0 / 4.0)  # one LATENCY bucket's width
+    for p in (0.5, 0.95, 0.99, 0.999):
+        exact = xs[int(p * (len(xs) - 1))]
+        got = h.quantile(p)
+        assert exact / factor <= got <= exact * factor, (p, exact, got)
+
+
+def test_drain_quantile_parity_and_bounded_stats(tmp_path):
+    """THE satellite contract: the histogram-backed ServeStats
+    reproduces the quantiles the raw lists used to give, keyed off the
+    same compile/barrier flags, while holding O(buckets) state."""
+    sessions, pool, streams, sched = _fleet(tmp_path, n=8)
+    sched.stats.keep_raw = True  # test-only raw mirror
+    stats = sched.run()
+    assert sched.done
+    raw = stats.raw_round_latencies
+    assert len(raw) == stats.rounds > 0
+    # classification parity: one source of truth for both paths
+    skip = [c or b for c, b in zip(stats.raw_compile_flags,
+                                   stats.raw_barrier_flags)]
+    exact, _, skipped_n = steady_quantiles(raw, skip)
+    assert skipped_n == stats.compile_rounds + stats.barrier_rounds
+    assert stats.lat_steady.count == stats.rounds - skipped_n
+    got = stats.latency_quantiles()
+    # parity within bucket resolution: the histogram quantile must lie
+    # between the two order statistics the exact quantile interpolates
+    # (the list value itself can sit anywhere in that gap), widened by
+    # one bucket's ratio
+    import math
+
+    kept = sorted(
+        lat for lat, s in zip(raw, skip) if not s
+    ) or sorted(raw)
+    factor = 2.0 ** (1.0 / 4.0)
+    for key, p in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        rank = p * (len(kept) - 1)
+        lo = kept[math.floor(rank)] / factor
+        hi = kept[math.ceil(rank)] * factor
+        assert lo <= got[key] <= hi, (key, exact[key], got[key], lo, hi)
+        # and the exact interpolated value obeys the same bracket
+        assert lo <= exact[key] <= hi
+    # compile time parity with the raw flags
+    assert stats.compile_time == pytest.approx(sum(
+        lat for lat, c in zip(raw, stats.raw_compile_flags) if c
+    ))
+    # the memory contract: histograms, not per-round lists
+    assert len(stats.lat_steady.counts) == len(LATENCY_BUCKETS_S) + 1
+    assert stats.occupancy.count == stats.rounds
+    assert stats.queue_depth.count == stats.rounds
+    # registry carries pool counters (identity-preserved via attach)
+    m = stats.metrics.to_dict()
+    assert m["version"] == 1
+    assert m["counters"]["serve.pool.evictions"] == stats.evictions > 0
+    # a clean unbounded drain ends every doc with cause tag `ok`
+    assert stats.doc_latency["ok"].count == len(sessions)
+    assert all(
+        stats.doc_latency[t].count == 0
+        for t in DOC_CAUSE_TAGS if t != "ok"
+    )
+
+
+def test_doc_drain_latency_cause_tags(tmp_path):
+    """Cause-tag attribution: a clean doc lands in `ok`, an
+    overflow-shed doc in `shed`, a poisoned-rebuild doc in
+    `quarantined` — each doc counted exactly once."""
+    plan = FaultPlan(
+        [
+            FaultEvent(kind="queue_overflow", round=3),
+            FaultEvent(kind="spool_corrupt", round=2),
+            FaultEvent(kind="poison_rebuild", round=0),
+        ],
+        seed=3,
+    )
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, n=6, faults=FaultInjector(plan),
+        queue_cap=16, overflow_policy="shed",
+    )
+    stats = sched.run()
+    assert sched.done
+    assert stats.quarantines, "poisoned rebuild should quarantine"
+    assert stats.shed_ops > 0
+    by_tag = {tag: h.count for tag, h in stats.doc_latency.items()}
+    assert set(by_tag) == set(DOC_CAUSE_TAGS)
+    assert by_tag["quarantined"] == len(stats.quarantines)
+    assert by_tag["shed"] >= 1
+    # the bounded queue backpressures every long stream, so surviving
+    # docs attribute to `deferred`/`ok` — both are non-lossy outcomes
+    assert by_tag["deferred"] + by_tag["ok"] >= 1
+    # exactly-once: every doc that was ever admitted has one sample
+    assert sum(by_tag.values()) == len(sessions)
+    # artifact form: the tagged histograms ride in the registry
+    m = stats.metrics.to_dict()
+    assert m["histograms"]["serve.doc.drain_latency.quarantined"][
+        "count"
+    ] == by_tag["quarantined"]
+
+
+# ---------------------------------------------------------------------------
+# profiler: top-ops parsing
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_top_ops_filters_python_frames(tmp_path):
+    import gzip
+
+    from crdt_benches_tpu.obs.profiler import top_ops
+
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    events = {"traceEvents": [
+        {"ph": "X", "name": "fusion.123", "ts": 0, "dur": 5000},
+        {"ph": "X", "name": "fusion.123", "ts": 9000, "dur": 3000},
+        {"ph": "X", "name": "convert.7", "ts": 5000, "dur": 2000},
+        # host python frames must not pollute the op table
+        {"ph": "X", "name": "$scheduler.py:1231 run_round", "ts": 0,
+         "dur": 9e9},
+        {"ph": "M", "name": "process_name"},
+    ]}
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump(events, f)
+    ops = top_ops(str(tmp_path))
+    assert [o["name"] for o in ops] == ["fusion.123", "convert.7"]
+    assert ops[0]["calls"] == 2
+    assert ops[0]["total_ms"] == pytest.approx(8.0)
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the regression gate
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", REPO / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare"] = mod  # dataclasses need a real home
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(tmp_path, name, *, pps=100_000.0, p99=0.005,
+              jbytes=50_000, syncs=40, rounds=20):
+    data = [{
+        "group": "serve", "trace": "mixed", "backend": "512",
+        "extra": {
+            "family": "serve",
+            "patches_per_sec": pps,
+            "batch_latency": {"p50": p99 / 3, "p95": p99 / 1.2,
+                              "p99": p99},
+            "rounds": rounds,
+            "range_ops": 10_000,
+            "journal": {"bytes": jbytes, "records": rounds},
+            "boundary_syncs": {"entries": {"DocPool.block": syncs}},
+        },
+    }]
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_passes_identical_and_fails_regressions(tmp_path, capsys):
+    bc = _bench_compare()
+    base = _artifact(tmp_path, "base.json")
+    same = _artifact(tmp_path, "same.json")
+    assert bc.main([same, base]) == 0
+
+    # the synthetic regression fixture: throughput -20%, p99 2x,
+    # journal bytes +60%, sync rate 3x — every check trips
+    bad = _artifact(tmp_path, "bad.json", pps=80_000.0, p99=0.010,
+                    jbytes=80_000, syncs=120)
+    assert bc.main([bad, base]) == 1
+    out = capsys.readouterr().out
+    assert out.count("FAIL") == 4
+
+    # an IMPROVEMENT never fails the gate
+    good = _artifact(tmp_path, "good.json", pps=150_000.0, p99=0.003)
+    assert bc.main([good, base]) == 0
+
+    # thresholds are honored (a 5% drop passes the default 10% gate,
+    # fails a 2% one — the smoke's tracing-overhead leg)
+    slight = _artifact(tmp_path, "slight.json", pps=95_000.0)
+    assert bc.main([slight, base]) == 0
+    assert bc.main([slight, base, "--max-throughput-regress", "2"]) == 1
+
+
+def test_bench_compare_skips_missing_blocks(tmp_path):
+    bc = _bench_compare()
+    base = _artifact(tmp_path, "base.json")
+    nojournal = json.loads(Path(base).read_text())
+    nojournal[0]["extra"]["journal"] = None
+    del nojournal[0]["extra"]["boundary_syncs"]
+    p = tmp_path / "nojournal.json"
+    p.write_text(json.dumps(nojournal))
+    # skipped checks are reported, not failed
+    assert bc.main([str(p), base]) == 0
+    # a non-serve artifact is a usage error (exit 2)
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text(json.dumps([{"group": "upstream", "extra": {}}]))
+    assert bc.main([str(bogus), base]) == 2

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Serve-bench regression gate: diff a fresh artifact against a baseline.
+
+The BENCH_r* trajectory used to be a log; this makes it an enforced
+contract.  Given two serve artifacts (``bench/harness.py save_results``
+files), the gate compares:
+
+- **throughput** (``patches_per_sec``) — the headline number;
+- **steady p99** (``batch_latency.p99``) — serving jitter, already
+  compile/barrier-excluded by ``ServeStats.note_round``;
+- **journal overhead** (journal bytes per range op) — the WAL's cost,
+  only when both runs journaled;
+- **boundary syncs** (fence entries per macro-round from the
+  ``boundary_syncs`` block) — the "syncs only at boundaries" invariant
+  as a *rate*: a new sync on the hot path shows up here before it shows
+  up in latency.
+
+Every check carries a noise threshold (benchmarks jitter; the defaults
+are deliberately looser than run-to-run variance on this box) and the
+exit code carries the verdict: 0 = no regression, 1 = at least one
+check failed, 2 = usage/artifact error.
+
+Usage::
+
+    python tools/bench_compare.py NEW.json BASELINE.json \
+        [--max-throughput-regress 10] [--max-p99-regress 40] \
+        [--max-journal-regress 25] [--max-syncs-regress 60] [--json]
+
+The committed baseline for ``serve/mixed/4096`` lives at
+``bench_results/serve_baseline.json``; CI smokes also reuse this gate
+to bound armed-tracing overhead (traced leg vs plain leg, 5%).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass
+
+
+@dataclass
+class Check:
+    name: str
+    status: str  # "pass" | "fail" | "skip"
+    new: float | None = None
+    base: float | None = None
+    change_pct: float | None = None
+    threshold_pct: float | None = None
+    note: str = ""
+
+    def line(self) -> str:
+        tag = self.status.upper()
+        if self.status == "skip":
+            return f"{tag:4s} {self.name}: {self.note}"
+        return (
+            f"{tag:4s} {self.name}: {self.new:.6g} vs baseline "
+            f"{self.base:.6g} ({self.change_pct:+.1f}%, "
+            f"threshold {self.threshold_pct:.0f}%)"
+        )
+
+
+def load_serve_extra(path: str) -> dict:
+    """The ``extra`` block of the first serve-family result in a
+    ``save_results`` artifact."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: not a bench result list")
+    for entry in data:
+        extra = entry.get("extra") if isinstance(entry, dict) else None
+        if isinstance(extra, dict) and extra.get("family") == "serve":
+            return extra
+    raise ValueError(f"{path}: no serve-family result found")
+
+
+def _regress(name: str, new: float | None, base: float | None,
+             threshold: float, higher_is_better: bool,
+             skip_note: str = "") -> Check:
+    """One thresholded comparison; ``change_pct`` is signed so the
+    report reads naturally (negative = the metric went down)."""
+    if new is None or base is None:
+        return Check(name, "skip",
+                     note=skip_note or "metric missing in one artifact")
+    if base <= 0:
+        return Check(name, "skip", note=f"baseline value {base!r} unusable")
+    change = (new - base) / base * 100.0
+    regress = -change if higher_is_better else change
+    status = "fail" if regress > threshold else "pass"
+    return Check(name, status, new=new, base=base, change_pct=change,
+                 threshold_pct=threshold)
+
+
+def _journal_bytes_per_op(extra: dict) -> float | None:
+    j = extra.get("journal")
+    ops = extra.get("range_ops")
+    if not j or not ops or not j.get("bytes"):
+        return None
+    return j["bytes"] / ops
+
+
+def _syncs_per_round(extra: dict) -> float | None:
+    b = extra.get("boundary_syncs")
+    rounds = extra.get("rounds")
+    if not b or not rounds or not isinstance(b.get("entries"), dict):
+        return None
+    return sum(b["entries"].values()) / rounds
+
+
+def compare(new: dict, base: dict, *, max_throughput_regress: float,
+            max_p99_regress: float, max_journal_regress: float,
+            max_syncs_regress: float) -> list[Check]:
+    checks = [
+        _regress(
+            "throughput (patches/s)",
+            new.get("patches_per_sec"), base.get("patches_per_sec"),
+            max_throughput_regress, higher_is_better=True,
+        ),
+        _regress(
+            "steady p99 latency (s)",
+            (new.get("batch_latency") or {}).get("p99"),
+            (base.get("batch_latency") or {}).get("p99"),
+            max_p99_regress, higher_is_better=False,
+        ),
+        _regress(
+            "journal bytes per range op",
+            _journal_bytes_per_op(new), _journal_bytes_per_op(base),
+            max_journal_regress, higher_is_better=False,
+            skip_note="journal disabled in at least one run",
+        ),
+        _regress(
+            "boundary syncs per round",
+            _syncs_per_round(new), _syncs_per_round(base),
+            max_syncs_regress, higher_is_better=False,
+            skip_note="boundary_syncs block missing",
+        ),
+    ]
+    return checks
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serve-bench regression gate (new vs baseline)"
+    )
+    ap.add_argument("new", help="fresh serve artifact JSON")
+    ap.add_argument("baseline", help="baseline serve artifact JSON")
+    ap.add_argument("--max-throughput-regress", type=float, default=10.0,
+                    metavar="PCT",
+                    help="max tolerated patches/s drop (default 10%%)")
+    ap.add_argument("--max-p99-regress", type=float, default=40.0,
+                    metavar="PCT",
+                    help="max tolerated steady-p99 increase "
+                         "(default 40%%: p99 of a ~dozen-round drain "
+                         "is the noisiest number here)")
+    ap.add_argument("--max-journal-regress", type=float, default=25.0,
+                    metavar="PCT",
+                    help="max tolerated journal bytes/op increase")
+    ap.add_argument("--max-syncs-regress", type=float, default=60.0,
+                    metavar="PCT",
+                    help="max tolerated fence-entries-per-round "
+                         "increase (a new hot-path sync shows up here)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        new = load_serve_extra(args.new)
+        base = load_serve_extra(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+
+    checks = compare(
+        new, base,
+        max_throughput_regress=args.max_throughput_regress,
+        max_p99_regress=args.max_p99_regress,
+        max_journal_regress=args.max_journal_regress,
+        max_syncs_regress=args.max_syncs_regress,
+    )
+    failed = [c for c in checks if c.status == "fail"]
+    if args.json:
+        print(json.dumps({
+            "new": args.new,
+            "baseline": args.baseline,
+            "checks": [c.__dict__ for c in checks],
+            "ok": not failed,
+        }, indent=2))
+    else:
+        print(f"bench_compare: {args.new} vs {args.baseline}")
+        for c in checks:
+            print("  " + c.line())
+        print(
+            "bench_compare: "
+            + ("OK" if not failed else f"{len(failed)} REGRESSION(S)")
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,8 +59,29 @@ case "$family" in
     # declared fence must have crossed in that run's boundary_syncs
     # counters (dead fences fail), every runtime counter must map back
     # to a declared fence (unattributed boundaries fail).
-    exec python -m crdt_benches_tpu.lint crdt_benches_tpu --select G011 \
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G011 \
       --sync-artifact bench_results/serve_smoke_sanitized.json
+    # Traced leg: the same drain with the obs/trace.py span tracer
+    # armed.  Two gates: the emitted Chrome trace must validate against
+    # the schema (spans nested, fence instants inside their owning
+    # span), and armed-tracing THROUGHPUT overhead vs the plain leg
+    # must stay within 5% (bench_compare with a tightened threshold;
+    # the p99 of a tiny smoke drain is too noisy to gate that hard —
+    # the 2% headline overhead claim is measured on the full
+    # serve/mixed/4096 fleet where run noise is smaller).
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-trace bench_results/serve_smoke_trace.json \
+        --serve-save-name serve_smoke_traced
+    python -m crdt_benches_tpu.obs.trace bench_results/serve_smoke_trace.json
+    exec python tools/bench_compare.py \
+      bench_results/serve_smoke_traced.json bench_results/serve_smoke.json \
+      --max-throughput-regress 5
     ;;
   serve-faults)
     exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
