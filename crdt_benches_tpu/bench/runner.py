@@ -652,16 +652,19 @@ def run_serve(args) -> int:
     Exits nonzero on a verification mismatch — and, in chaos mode
     (--serve-faults), when any injected fault goes unfired or
     unrecovered."""
-    from ..serve.bench import ensure_virtual_devices, run_serve_bench
+    from ..serve.bench import (
+        ensure_virtual_devices,
+        run_serve_bench,
+        run_serve_soak,
+    )
 
     mesh_devices = ensure_virtual_devices(args.serve_mesh)
-    r, info = run_serve_bench(
+    common = dict(
         mix=args.serve_mix,
         n_docs=args.serve_docs,
         batch=args.serve_batch,
         classes=args.serve_classes,
         slots=args.serve_slots,
-        seed=args.serve_seed,
         arrival_span=args.serve_arrival_span,
         mesh_devices=mesh_devices,
         verify_sample=args.serve_verify_sample,
@@ -677,6 +680,27 @@ def run_serve(args) -> int:
         profile_rounds=args.serve_profile,
         log=lambda m: print(m, file=sys.stderr),
     )
+    if args.serve_soak is not None:
+        # soak mode: repeated drains under one continuous telemetry
+        # bundle with the anomaly detectors armed; an anomaly still
+        # active at soak end fails the run (exit nonzero below)
+        r, info = run_serve_soak(
+            soak_seconds=args.serve_soak,
+            seed=args.serve_seed,
+            status_port=args.serve_status,
+            timeseries_path=args.serve_timeseries,
+            timeseries_window=args.serve_timeseries_window,
+            watchdog_s=args.serve_watchdog,
+            **common,
+        )
+    else:
+        r, info = run_serve_bench(
+            seed=args.serve_seed,
+            status_port=args.serve_status,
+            timeseries_path=args.serve_timeseries,
+            timeseries_window=args.serve_timeseries_window,
+            **common,
+        )
     print(
         f"{r.bench_id}: {r.elements_per_sec:,.0f} patches/s "
         f"(K={r.extra['macro_k']}, steady batch latency "
@@ -698,7 +722,19 @@ def run_serve(args) -> int:
             f"quarantines {len(r.extra['quarantines'])}, "
             f"degraded rounds {r.extra['degraded_rounds']}"
         )
-    return 0 if info["verify_ok"] and info["faults_ok"] else 1
+    if r.extra.get("anomalies") is not None:
+        a = r.extra["anomalies"]
+        print(
+            f"  soak: {info.get('iterations', 1)} drain(s), "
+            f"anomalies {a['fired']} fired / {a['uncleared']} uncleared"
+            + (f" (active: {', '.join(a['active'])})" if a["active"]
+               else "")
+        )
+    ok = (
+        info["verify_ok"] and info["faults_ok"]
+        and info.get("anomalies_ok", True)
+    )
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -763,6 +799,34 @@ def main(argv=None) -> int:
                          "steady (non-compile, non-barrier) macro-"
                          "rounds; a top-ops table lands in the "
                          "artifact's profile block")
+    ap.add_argument("--serve-status", type=int, default=None,
+                    metavar="PORT",
+                    help="start the obs/status.py live status server "
+                         "on PORT (0 = ephemeral, bound port logged): "
+                         "/healthz, /status.json, and /metrics in "
+                         "Prometheus text exposition")
+    ap.add_argument("--serve-timeseries", default=None, metavar="PATH",
+                    help="stream closed obs/timeseries.py windows as "
+                         "JSONL to PATH (also arms the windowed "
+                         "recorder: the artifact gains a versioned "
+                         "'timeseries' block + per-shard series)")
+    ap.add_argument("--serve-timeseries-window", type=int, default=8,
+                    metavar="N",
+                    help="macro-rounds folded per time-series window")
+    ap.add_argument("--serve-soak", type=float, default=None,
+                    metavar="SECONDS",
+                    help="soak mode: drain re-seeded fleets back-to-"
+                         "back for SECONDS (0 = one drain) under one "
+                         "continuous telemetry bundle with the obs/"
+                         "anomaly.py detectors armed (throughput "
+                         "degradation, RSS/journal leak, stuck-round "
+                         "watchdog); exits nonzero when an anomaly is "
+                         "still active at soak end")
+    ap.add_argument("--serve-watchdog", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="stuck-round watchdog threshold for soak "
+                         "mode (0 = auto: 25x the rolling median "
+                         "steady-round latency, floored at 1s)")
     ap.add_argument("--serve-seed", type=int, default=0)
     ap.add_argument("--serve-arrival-span", type=int, default=8)
     ap.add_argument("--serve-verify-sample", type=int, default=8,

@@ -1,4 +1,4 @@
-"""graftlint rules G001-G012.
+"""graftlint rules G001-G013.
 
 Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
 registered in :data:`RULES`.  Every rule is motivated by a real hazard
@@ -868,6 +868,135 @@ def g012_obs_hygiene(index: PackageIndex) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# G013 — status/telemetry isolation in hot-path scopes
+
+#: Server/socket constructor names (with their import-source checks
+#: below): binding a port or accepting connections belongs to the bench
+#: driver, never the serving hot path.
+_G013_SERVER_CTORS = {
+    "HTTPServer", "ThreadingHTTPServer", "TCPServer", "UDPServer",
+    "StatusServer",
+}
+_G013_SERVER_SOURCES = ("http.server", "socketserver", "obs.status")
+
+#: ``socket``-module entry points that create/bind network endpoints.
+_G013_SOCKET_FUNCS = {"socket", "create_server", "create_connection"}
+
+#: Registry-shape mutators: get-or-create and adoption.  The hot path
+#: holds pre-registered references; creating series mid-drain races the
+#: status server's snapshot reads and allocates per round.
+_G013_REG_MUTATORS = {"counter", "gauge", "histogram", "attach"}
+
+
+def _g013_call_finding(fi: FuncInfo, node: ast.Call, chain: str
+                       ) -> Finding | None:
+    m = fi.module
+    f = node.func
+    d = dotted(f)
+    # (a) HTTP/TCP server construction (http.server / socketserver /
+    # obs.status classes, by import source)
+    tail = d.split(".")[-1] if d else None
+    if tail in _G013_SERVER_CTORS:
+        root = d.split(".")[0]
+        src = m.imports.get(root, "")
+        if tail == "StatusServer" or any(
+            s in src for s in _G013_SERVER_SOURCES
+        ):
+            return Finding(
+                rule="G013", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"`{tail}(...)` constructed in a hot-path scope "
+                    f"({chain}) — the status server is thread-confined "
+                    "and driver-owned; the drain only swaps snapshot "
+                    "references in"
+                ),
+            )
+    # (b) raw socket creation
+    if d is not None and len(d.split(".")) == 2:
+        root, attr = d.split(".")
+        if attr in _G013_SOCKET_FUNCS and m.imports.get(root) == "socket":
+            return Finding(
+                rule="G013", path=m.path, line=node.lineno,
+                col=node.col_offset,
+                msg=(
+                    f"`{d}(...)` in a hot-path scope ({chain}) — no "
+                    "network endpoints on the serving hot path"
+                ),
+            )
+    # (c) serving a socket from the hot path
+    if isinstance(f, ast.Attribute) and f.attr == "serve_forever":
+        return Finding(
+            rule="G013", path=m.path, line=node.lineno,
+            col=node.col_offset,
+            msg=(
+                f"`.serve_forever()` in a hot-path scope ({chain}) — "
+                "the status server loops on its own daemon thread"
+            ),
+        )
+    # (d) registry mutation (get-or-create / attach), even with a
+    # constant name — G012 polices naming, this polices WHEN: series
+    # are pre-registered at bind time, the hot path holds references
+    is_mutator = False
+    if isinstance(f, ast.Attribute) and f.attr in _G013_REG_MUTATORS:
+        is_mutator = True
+    elif isinstance(f, ast.Name) and f.id in _G013_REG_MUTATORS:
+        is_mutator = "obs.metrics" in m.imports.get(f.id, "")
+    if is_mutator:
+        what = f.attr if isinstance(f, ast.Attribute) else f.id
+        return Finding(
+            rule="G013", path=m.path, line=node.lineno,
+            col=node.col_offset,
+            msg=(
+                f"registry mutation `{what}(...)` in a hot-path scope "
+                f"({chain}) — get-or-create/attach races the status "
+                "server's snapshot reads and allocates per round; "
+                "pre-register at bind time and hold the reference "
+                "(.inc()/.set()/.observe() stay legal)"
+            ),
+        )
+    return None
+
+
+def g013_status_isolation(index: PackageIndex) -> list[Finding]:
+    """The live-telemetry isolation contract: the serving hot path
+    never constructs sockets or HTTP servers, never serves them, and
+    never mutates the metric registry's shape — the status endpoint is
+    read-only over published snapshots on its own thread, and every
+    series the hot path touches was pre-registered at bind time.  Like
+    G012 (and unlike G002) the walk DESCENDS into declared fences:
+    being behind a sync boundary does not make a mid-drain socket or a
+    per-round series registration acceptable."""
+    roots = [
+        fi for m in index.modules for fi in m.functions.values()
+        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
+    ]
+    out: list[Finding] = []
+    seen: set[int] = set()
+    queue: list[tuple[FuncInfo, str]] = [
+        (r, f"reached from {r.qualname}") for r in roots
+    ]
+    while queue:
+        fi, chain = queue.pop()
+        if id(fi) in seen:
+            continue
+        seen.add(id(fi))
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            finding = _g013_call_finding(fi, node, chain)
+            if finding is not None:
+                out.append(finding)
+                continue
+            for callee in index.resolve_call(node, fi):
+                if id(callee) not in seen:
+                    queue.append(
+                        (callee, f"{chain} -> {callee.qualname}")
+                    )
+    return out
+
+
 RULES = {
     "G001": g001_tracer_leak,
     "G002": g002_host_sync,
@@ -881,4 +1010,5 @@ RULES = {
     "G010": g010_block_lane,
     "G011": g011_fence_cost,  # artifact-driven; see run_lint
     "G012": g012_obs_hygiene,
+    "G013": g013_status_isolation,
 }

@@ -117,6 +117,13 @@ def counters() -> dict[str, dict[str, int]]:
     }
 
 
+def entries_total() -> int:
+    """Sum of every fence-entry counter — the per-round accessor the
+    time-series recorder samples (``counters()`` builds fresh sorted
+    dicts; this is one pass over a handful of ints)."""
+    return sum(_entries.values())
+
+
 def _note_sync(label: str) -> None:
     stack = _fence_stack()
     if stack:

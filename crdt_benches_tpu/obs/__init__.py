@@ -20,8 +20,29 @@ Three cooperating pieces, all zero-cost when disarmed:
   non-barrier) macro-rounds and writes a top-ops summary into the
   artifact.
 
+obs/ v2 adds the *continuous* layer (all disarmed by default, armed by
+``--serve-status`` / ``--serve-timeseries`` / ``--serve-soak``):
+
+- :mod:`crdt_benches_tpu.obs.timeseries` — a ring-buffered windowed
+  recorder folding per-round samples into delta-encoded windows (the
+  versioned ``timeseries`` artifact block + an optional live JSONL
+  stream) and the ``ServeTelemetry`` facade the scheduler threads
+  through the drain;
+- :mod:`crdt_benches_tpu.obs.shard` — mesh-aware per-shard series
+  (ops/lanes/occupancy/relocations, an imbalance gauge, device
+  allocator stats) whose per-shard sums equal the fleet totals;
+- :mod:`crdt_benches_tpu.obs.status` — a thread-confined stdlib HTTP
+  status server (``/healthz``, ``/status.json``, ``/metrics`` in
+  Prometheus text exposition) read-only over published snapshots,
+  plus a ``--watch`` polling CLI;
+- :mod:`crdt_benches_tpu.obs.anomaly` — online soak detectors
+  (throughput degradation, RSS/journal leak growth, a stuck-round
+  watchdog) landing in the ``anomalies`` artifact block and the run's
+  exit code.
+
 ``tools/bench_compare.py`` closes the loop: it diffs a fresh serve
 artifact against the committed baseline (throughput, steady p99,
-journal overhead, boundary syncs) with noise thresholds, so the
-BENCH_r* trajectory is an enforced contract.
+journal overhead, boundary syncs, and — when both sides carry
+time-series — the worst full window's throughput floor) with noise
+thresholds, so the BENCH_r* trajectory is an enforced contract.
 """

@@ -46,6 +46,27 @@ def replica_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs), (AXIS,))
 
 
+def device_memory_stats(n_devices: int | None = None) -> list[dict | None]:
+    """Per-device allocator stats for the first ``n_devices`` devices
+    (the serve fleet's shard order — ``replica_mesh`` takes the same
+    prefix).  Real TPU/GPU backends answer ``Device.memory_stats()``
+    with ``bytes_in_use`` et al.; backends without allocator telemetry
+    (the virtual host-CPU mesh) yield None entries — callers gauge what
+    exists and skip the rest.  A local allocator query, not a sync:
+    nothing blocks on in-flight dispatches."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    out: list[dict | None] = []
+    for d in devs:
+        try:
+            ms = d.memory_stats()
+        except Exception:  # backend without allocator stats
+            ms = None
+        out.append(ms if isinstance(ms, dict) else None)
+    return out
+
+
 def fleet_sharding(mesh: Mesh) -> NamedSharding:
     """Docs-over-mesh layout for the serve/ document fleet: the leading
     axis of every DocPool bucket array (one lane per *independent

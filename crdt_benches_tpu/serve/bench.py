@@ -49,13 +49,55 @@ from ..bench.harness import (
 )
 from ..lint import sanitizer
 from ..obs import trace as obs_trace
+from ..obs.anomaly import AnomalyDetector
 from ..obs.profiler import DeviceProfiler
+from ..obs.status import StatusServer
+from ..obs.timeseries import ServeTelemetry, TimeseriesRecorder
 from ..oracle.text_oracle import replay_trace
 from .faults import FaultInjector, FaultPlan
 from .journal import OpJournal
 from .pool import DocPool
 from .scheduler import FleetScheduler, prepare_streams
 from .workload import build_fleet
+
+
+def build_telemetry(
+    *,
+    status_port: int | None = None,
+    timeseries_path: str | None = None,
+    timeseries_window: int = 8,
+    anomaly: bool = False,
+    watchdog_s: float = 0.0,
+    stale_after: float | None = None,
+    log=print,
+) -> ServeTelemetry | None:
+    """Assemble the continuous-telemetry bundle a serve run threads
+    through its scheduler(s): the windowed time-series recorder (armed
+    by a stream path, a status port, or soak mode — the artifact block
+    and the detectors both need it), the live status endpoint
+    (``stale_after`` seconds without a publish turns ``/healthz`` 503 —
+    the external-probe view of a wedged publisher), and the soak
+    anomaly detectors.  Returns None when nothing is armed."""
+    if status_port is None and not timeseries_path and not anomaly:
+        return None
+    telemetry = ServeTelemetry(
+        recorder=TimeseriesRecorder(
+            window_rounds=timeseries_window, stream_path=timeseries_path
+        ),
+        anomaly=AnomalyDetector(watchdog_s=watchdog_s) if anomaly
+        else None,
+        status=StatusServer(port=status_port, stale_after=stale_after)
+        if status_port is not None else None,
+    )
+    if telemetry.status is not None:
+        port = telemetry.status.start()
+        log(
+            f"serve: status server on http://127.0.0.1:{port} "
+            "(/healthz /status.json /metrics)"
+        )
+    if timeseries_path:
+        log(f"serve: time-series stream -> {timeseries_path}")
+    return telemetry
 
 
 def ensure_virtual_devices(n: int) -> int:
@@ -127,6 +169,10 @@ def run_serve_bench(
     save_name: str | None = None,
     trace_path: str | None = None,
     profile_rounds: int = 0,
+    status_port: int | None = None,
+    timeseries_path: str | None = None,
+    timeseries_window: int = 8,
+    telemetry: ServeTelemetry | None = None,
     log=print,
 ) -> tuple[BenchResult, dict]:
     """Build the fleet, drain it once, verify a per-class doc sample
@@ -151,7 +197,16 @@ def run_serve_bench(
     there (``CRDT_BENCH_TRACE=1`` arms it too, defaulting the path next
     to the artifact); ``profile_rounds`` > 0 captures a ``jax.profiler``
     device trace of that many steady rounds and embeds a top-ops table
-    in the artifact's ``profile`` block."""
+    in the artifact's ``profile`` block.
+
+    Continuous telemetry: ``status_port`` starts the live
+    ``obs/status.py`` endpoint (0 = ephemeral; the bound port is
+    logged), ``timeseries_path`` streams closed ``obs/timeseries.py``
+    windows as JSONL; either arms the windowed recorder and the
+    artifact gains versioned ``timeseries`` (and, under a soak's
+    detectors, ``anomalies``) blocks plus per-shard labeled series in
+    the metrics registry.  A caller-provided ``telemetry`` bundle (the
+    soak wrapper's) is reused as-is and NOT closed here."""
     classes = _parse_int_tuple(classes)
     slots = _parse_int_tuple(slots)
     mix_name = mix if isinstance(mix, str) else "custom"
@@ -173,6 +228,13 @@ def run_serve_bench(
     journal = OpJournal(journal_dir, fsync=journal_fsync) \
         if journal_dir else None
 
+    owns_telemetry = telemetry is None
+    if owns_telemetry:
+        telemetry = build_telemetry(
+            status_port=status_port, timeseries_path=timeseries_path,
+            timeseries_window=timeseries_window, log=log,
+        )  # None when nothing is armed
+
     mesh = None
     if mesh_devices > 1:
         from ..parallel.mesh import replica_mesh
@@ -184,6 +246,8 @@ def run_serve_bench(
     # close the journal, drop an owned journal dir, and release the
     # pool's spool directory (CI chaos runs must not leak temp dirs)
     try:
+        if telemetry is not None:
+            telemetry.note_phase("building")  # staleness-clock heartbeat
         log(f"serve: building fleet n_docs={n_docs} mix={mix_name} seed={seed}")
         sessions = build_fleet(
             n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands,
@@ -213,7 +277,7 @@ def run_serve_bench(
             queue_cap=queue_cap, overflow_policy=overflow_policy,
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
-            profiler=profiler,
+            profiler=profiler, telemetry=telemetry,
         )
         # per-fence boundary-sync counters cover drain + verify; with
         # CRDT_BENCH_SANITIZE_SYNCS=1 any sync outside a declared fence
@@ -262,6 +326,18 @@ def run_serve_bench(
                         f"{o['name']} {o['total_ms']:.1f}ms" for o in top
                     ))
         assert sched.done, "scheduler stopped with pending work"
+        if telemetry is not None:
+            telemetry.drain_end(status={
+                **sched.status_fields(), "phase": "done", "done": True,
+            })
+            if telemetry.anomaly is not None:
+                a = telemetry.anomaly
+                log(
+                    f"serve: anomalies — {a.fired} fired, "
+                    f"{a.uncleared} uncleared"
+                    + (f" (active: {', '.join(a.active_kinds())})"
+                       if a.uncleared else "")
+                )
         # steady-state latency excludes BOTH compile rounds and snapshot
         # barrier rounds — ServeStats.note_round is the single
         # classification point; the histogram carries the quantiles
@@ -446,6 +522,23 @@ def run_serve_bench(
                     for tag, h in sorted(stats.doc_latency.items())
                 },
                 "profile": profile_block,
+                # continuous telemetry (obs/ v2): windowed per-round
+                # time-series + soak anomaly verdicts, both versioned
+                "timeseries": (
+                    telemetry.recorder.block()
+                    if telemetry is not None and telemetry.recorder
+                    is not None else None
+                ),
+                "anomalies": (
+                    telemetry.anomaly.block()
+                    if telemetry is not None and telemetry.anomaly
+                    is not None else None
+                ),
+                "status_port": (
+                    telemetry.status.port
+                    if telemetry is not None and telemetry.status
+                    is not None else None
+                ),
                 "trace": trace_path if tracer is not None else None,
                 "docs_per_class": {
                     str(c): len(v) for c, v in sorted(by_class.items())
@@ -460,6 +553,10 @@ def run_serve_bench(
         return r, {
             "verify_ok": verify_ok,
             "faults_ok": faults_ok,
+            "anomalies_ok": (
+                telemetry is None or telemetry.anomaly is None
+                or telemetry.anomaly.uncleared == 0
+            ),
             "path": path,
             "stats": stats,
         }
@@ -468,6 +565,78 @@ def run_serve_bench(
             journal.close()
         if owns_journal:
             shutil.rmtree(journal_dir, ignore_errors=True)
+        if owns_telemetry and telemetry is not None:
+            telemetry.close()  # stop the status server, close the stream
         if pool is not None:
             pool.close()  # drop an owned spool directory
+
+
+def run_serve_soak(
+    soak_seconds: float = 0.0,
+    *,
+    seed: int = 0,
+    status_port: int | None = None,
+    timeseries_path: str | None = None,
+    timeseries_window: int = 8,
+    watchdog_s: float = 0.0,
+    log=print,
+    **kw,
+) -> tuple[BenchResult, dict]:
+    """Soak harness: drain fleets back-to-back until ``soak_seconds``
+    of wall time have elapsed (0 = exactly one drain), under ONE shared
+    telemetry bundle — the time-series windows, anomaly detectors and
+    status endpoint run continuously across every drain, so a slow leak
+    or creeping degradation that no single drain would show still trips
+    a detector.  Every iteration re-seeds the workload (``seed + i``)
+    and byte-verifies against the oracle like a normal run; the LAST
+    iteration's artifact carries the whole soak's ``timeseries`` /
+    ``anomalies`` blocks (the recorder's ring is shared).
+
+    Exit contract (surfaced via ``info``): ``verify_ok`` / ``faults_ok``
+    are the AND over all iterations; ``anomalies_ok`` is False when any
+    anomaly is still active at soak end — an anomaly that fired and
+    CLEARED (a stall the engine absorbed) does not fail the soak.
+
+    ``/healthz`` staleness is armed for the soak (120s without a
+    publish -> 503; generous because fleet builds between drains do
+    not publish — each drain opens with a "building" heartbeat)."""
+    telemetry = build_telemetry(
+        status_port=status_port, timeseries_path=timeseries_path,
+        timeseries_window=timeseries_window,
+        anomaly=True, watchdog_s=watchdog_s, stale_after=120.0, log=log,
+    )
+    import time as _time
+
+    t0 = _time.perf_counter()
+    i = 0
+    verify_ok = faults_ok = True
+    try:
+        while True:
+            r, info = run_serve_bench(
+                seed=seed + i, telemetry=telemetry, log=log, **kw
+            )
+            verify_ok &= info["verify_ok"]
+            faults_ok &= info["faults_ok"]
+            i += 1
+            elapsed = _time.perf_counter() - t0
+            if elapsed >= soak_seconds:
+                break
+            log(
+                f"serve: soak {elapsed:.1f}/{soak_seconds:.0f}s — "
+                f"iteration {i} done, re-draining"
+            )
+        a = telemetry.anomaly
+        log(
+            f"serve: soak done — {i} drain(s) in "
+            f"{_time.perf_counter() - t0:.1f}s; anomalies {a.fired} "
+            f"fired / {a.uncleared} uncleared"
+        )
+        info = dict(info)
+        info["verify_ok"] = verify_ok
+        info["faults_ok"] = faults_ok
+        info["anomalies_ok"] = a.uncleared == 0
+        info["iterations"] = i
+        return r, info
+    finally:
+        telemetry.close()
 

@@ -71,9 +71,13 @@ class FaultEvent:
     fired_round: int = -1
     recovered: bool = False
     detail: dict = field(default_factory=dict)
-    # per-kind fired counters, shared across a plan's events (set by
-    # FaultInjector.bind_metrics; None outside an instrumented drain)
+    # per-kind fired/recovered counters, shared across a plan's events
+    # (set by FaultInjector.bind_metrics; None outside an instrumented
+    # drain)
     counters: dict | None = field(
+        default=None, repr=False, compare=False
+    )
+    rec_counters: dict | None = field(
         default=None, repr=False, compare=False
     )
 
@@ -86,6 +90,18 @@ class FaultEvent:
         # timeline marker (no-op unless span tracing is armed); the
         # constant event name keeps G012 happy — kind rides in args
         instant("serve.fault", kind=self.kind, round=rnd)
+
+    def recover(self, **detail) -> None:
+        """Mark the event recovered (idempotent) so per-kind recovery
+        counters reach the registry — the status endpoint's fault/
+        degraded view needs recoveries as a live series, not just the
+        end-of-run summary."""
+        if detail:
+            self.detail.update(detail)
+        if not self.recovered:
+            self.recovered = True
+            if self.rec_counters is not None:
+                self.rec_counters[self.kind].inc()
 
     def to_dict(self) -> dict:
         return {
@@ -173,14 +189,20 @@ class FaultInjector:
         self.rng = np.random.default_rng(plan.seed ^ 0x9E3779B9)
 
     def bind_metrics(self, registry) -> None:
-        """Pre-register one fired-counter per fault kind (constant
-        names, built OFF the hot path) and hand the table to every
-        event so ``FaultEvent.fire`` emits through the registry."""
+        """Pre-register fired/recovered counters per fault kind
+        (constant names, built OFF the hot path) and hand the tables to
+        every event so ``FaultEvent.fire``/``recover`` emit through the
+        registry."""
         counters = {
             k: registry.counter("serve.faults.fired." + k) for k in KINDS
         }
+        rec_counters = {
+            k: registry.counter("serve.faults.recovered." + k)
+            for k in KINDS
+        }
         for e in self.plan.events:
             e.counters = counters
+            e.rec_counters = rec_counters
 
     def _pending(self, rnd: int, *kinds: str) -> FaultEvent | None:
         for e in self.plan.events:

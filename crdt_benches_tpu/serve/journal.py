@@ -91,11 +91,13 @@ class OpJournal:
         self._f = open(self.path, "a", encoding="utf-8")
         self._m_records = Counter("serve.journal.records")
         self._m_bytes = Counter("serve.journal.bytes")
+        self._m_snap_bytes = Counter("serve.journal.snapshot_bytes")
 
     def bind_metrics(self, registry) -> None:
         """Attach the journal's counters to a drain's MetricsRegistry."""
         registry.attach(self._m_records)
         registry.attach(self._m_bytes)
+        registry.attach(self._m_snap_bytes)
 
     @property
     def records(self) -> int:
@@ -104,6 +106,28 @@ class OpJournal:
     @property
     def bytes_written(self) -> int:
         return self._m_bytes.value
+
+    @property
+    def bytes_total(self) -> int:
+        """WAL bytes plus committed snapshot bytes — the journal's full
+        on-disk footprint rate, which is what the soak leak detector
+        watches (WAL bytes alone would hide snapshot bloat)."""
+        return self._m_bytes.value + self._m_snap_bytes.value
+
+    def note_snapshot(self, snap_dir: str) -> int:
+        """Account a committed snapshot barrier's on-disk bytes (walked
+        once per barrier — cold path).  Hard-linked spool members count
+        at full size: the number tracks what a recovery would read, not
+        unique blocks."""
+        total = 0
+        for root, _dirs, files in os.walk(snap_dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass  # pruned concurrently by keep= rotation
+        self._m_snap_bytes.inc(total)
+        return total
 
     def append(self, obj: dict) -> None:
         payload = json.dumps(obj, separators=(",", ":"))

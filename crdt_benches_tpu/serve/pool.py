@@ -630,6 +630,17 @@ class DocPool:
             c: 1.0 - b.n_free / b.R for c, b in self.buckets.items()
         }
 
+    def shard_occupancy(self) -> list[int]:
+        """Occupied rows per mesh shard, summed across every capacity
+        class (host bookkeeping only — the free sets are the truth).
+        Partition invariant: ``sum(shard_occupancy())`` equals the
+        fleet's total resident-doc count."""
+        out = [0] * self.n_sh
+        for b in self.buckets.values():
+            for s in range(b.n_sh):
+                out[s] += b.Rg - len(b.free_locals(s))
+        return out
+
     def close(self) -> None:
         """Delete the spool directory if this pool created it (a caller
         who passed spool_dir owns its lifecycle).  Spooled docs become

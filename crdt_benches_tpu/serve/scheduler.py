@@ -77,7 +77,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..lint.sanitizer import fenced, hot_path
+from ..lint.sanitizer import entries_total, fenced, hot_path
 from ..obs.metrics import (
     DEPTH_BUCKETS,
     LATENCY_BUCKETS_S,
@@ -393,7 +393,7 @@ class FleetScheduler:
                  snapshot_every: int = 0, snapshot_keep: int = 2,
                  degrade_after: int = 3, degrade_window: int = 8,
                  degrade_rounds: int = 4,
-                 start_round: int = 0, profiler=None):
+                 start_round: int = 0, profiler=None, telemetry=None):
         if overflow_policy not in ("defer", "shed"):
             raise ValueError(f"unknown overflow policy {overflow_policy!r}")
         self.pool = pool
@@ -445,6 +445,20 @@ class FleetScheduler:
         if faults is not None:
             faults.bind_metrics(reg)
         self._m_faults_seen = reg.counter("serve.faults.seen")
+        # continuous telemetry (obs/timeseries.py ServeTelemetry, or
+        # None): per-round time-series windows, per-shard series, the
+        # status endpoint and the soak anomaly detectors all hang off
+        # this one bundle — bound here so every series lives in THIS
+        # drain's registry.
+        self.telemetry = telemetry
+        self._last_occ = 0.0
+        self._last_queue = 0
+        n_sh = pool.n_sh
+        self._sh_lanes = [0] * n_sh
+        self._sh_ops = [0] * n_sh
+        self._sh_units = [0] * n_sh
+        if telemetry is not None:
+            telemetry.bind(pool, reg)
 
     # ---- degradation (automatic macro-K -> K=1 fallback) ----
 
@@ -573,7 +587,7 @@ class FleetScheduler:
                     self.stats.faults_injected += 1
                     dup.fire(self.round, doc=doc_id, depth=depth,
                              dropped=dropped)
-                    dup.recovered = True  # clamped, nothing re-applied
+                    dup.recover()  # clamped, nothing re-applied
                     self._note_fault()
             takes, end = self._sim_takes(st)
             rec = self.pool.docs[doc_id]
@@ -732,6 +746,15 @@ class FleetScheduler:
                 rec.cls, rec.row = cls, row
                 lane.row = row
                 inst.append((rec.doc_id, row, source))
+                if self.telemetry is not None and source[0] == "pull":
+                    # a promotion that lands on a different mesh shard
+                    # than its source row is a cross-shard relocation
+                    _, src_cls, src_row = source
+                    src_sh = src_row // pool.buckets[src_cls].Rg
+                    if src_sh != row // b.Rg:
+                        self.telemetry.shards.note_relocation(
+                            row // b.Rg
+                        )
             for lane, dst in relocs:
                 rec = pool.docs[lane.stream.doc_id]
                 src = rec.row
@@ -804,7 +827,7 @@ class FleetScheduler:
         ev, secs = hit
         time.sleep(secs)
         ev.fire(rnd, ms=secs * 1e3)
-        ev.recovered = True  # a stall is absorbed, not repaired
+        ev.recover()  # a stall is absorbed, not repaired
         self.stats.stall_rounds += 1
         self.stats.faults_injected += 1
         self._note_fault()
@@ -856,7 +879,7 @@ class FleetScheduler:
             ev.detail["deferred"] = self._push_delivery(st, want)
         ev.fire(self.round, doc=doc, burst=burst,
                 policy=self.overflow_policy, shed=shed)
-        ev.recovered = True  # the decision IS the recovery
+        ev.recover()  # the decision IS the recovery
 
     def _all_residents(self) -> list[tuple[int, int]]:
         return [
@@ -961,7 +984,7 @@ class FleetScheduler:
             self.stats.replay_dispatches += disp
             self.stats.mttr_rounds.append(max(1, disp))
             if ev is not None:
-                ev.recovered = True
+                ev.recover()
             if self.journal:
                 self.journal.event(
                     "heal", r=self.round, doc=doc_id,
@@ -1029,7 +1052,7 @@ class FleetScheduler:
         self.stats.faults_injected += 1
         ev.fire(self.round, cls=cls, docs=len(affected),
                 replayed_ops=replayed)
-        ev.recovered = True
+        ev.recover()
         if self.journal:
             self.journal.event(
                 "device_loss", r=self.round, cls=cls, docs=len(affected),
@@ -1052,11 +1075,11 @@ class FleetScheduler:
             if rec is None or st is None:
                 continue
             if rec.spool is None or not os.path.exists(rec.spool):
-                e.recovered = True  # superseded: doc resident again
+                e.recover()  # superseded: doc resident again
                 continue
             try:
                 load_state(rec.spool)
-                e.recovered = True  # damage missed the live bytes
+                e.recover()  # damage missed the live bytes
                 continue
             except CorruptCheckpointError as err:
                 healed = self._heal_spool(
@@ -1067,7 +1090,7 @@ class FleetScheduler:
                 continue  # quarantined (reported separately)
             row_v, L, nv = healed
             rec.spool = self.pool.spool_save(doc_id, row_v, L, nv)
-            e.recovered = True
+            e.recover()
 
     # ---- boundary execution (the only device syncs) ----
 
@@ -1165,16 +1188,29 @@ class FleetScheduler:
         state (popped from the plan) and quarantined docs do NOT
         advance — their ops are simply rescheduled or shed."""
         lanes_used = 0
+        n_sh = self.pool.n_sh
+        sh_lanes = [0] * n_sh
+        sh_ops = [0] * n_sh
+        sh_units = [0] * n_sh
         for cls, lanes in plan.lanes.items():
+            Rg = self.pool.buckets[cls].Rg
             for lane in lanes:
                 st = lane.stream
                 if st.doc_id in self._dead_lanes:
                     continue
                 rec = self.pool.docs[st.doc_id]
-                self.stats.ops += lane.end - st.cursor
-                self.stats.unit_ops += (
+                ops_d = lane.end - st.cursor
+                units_d = (
                     st.units_before(lane.end) - st.units_before(st.cursor)
                 )
+                self.stats.ops += ops_d
+                self.stats.unit_ops += units_d
+                # shard attribution is host arithmetic: the lane's mesh
+                # shard is its row's shard group (rows never straddle)
+                s = lane.row // Rg
+                sh_lanes[s] += 1
+                sh_ops[s] += ops_d
+                sh_units[s] += units_d
                 st.cursor = lane.end
                 rec.length = rec.n_init + st.ins_before(lane.end)
                 rec.last_sched = plan.base_round
@@ -1183,8 +1219,14 @@ class FleetScheduler:
                     self._note_doc_drained(st)
         self._dead_lanes.clear()
         total_lanes = sum(b.R for b in self.pool.buckets.values())
-        self.stats.occupancy.observe(lanes_used / total_lanes)
+        occ = lanes_used / total_lanes
+        self.stats.occupancy.observe(occ)
         self.stats.queue_depth.observe(plan.waiting)
+        self._last_occ = occ
+        self._last_queue = plan.waiting
+        self._sh_lanes, self._sh_ops, self._sh_units = (
+            sh_lanes, sh_ops, sh_units
+        )
         if self._planned_degraded:
             self.stats.degraded_rounds += 1
             self._degrade_left -= 1
@@ -1223,8 +1265,58 @@ class FleetScheduler:
         )
         self.stats.snapshots += 1
         self.stats.snapshot_time += time.perf_counter() - t0
+        self.journal.note_snapshot(d)
         self.journal.event("snap", r=self.round, dir=os.path.basename(d))
         self._bases.release()  # the new barrier may have pruned old dirs
+
+    # ---- continuous telemetry taps (host-only; see obs/timeseries) ----
+
+    def _cum_counters(self) -> dict:
+        """Cumulative counters the time-series recorder delta-encodes
+        into windows.  Keys are the fixed ``obs/timeseries.py
+        CUM_KEYS`` set."""
+        s = self.stats
+        return {
+            "ops": s.ops,
+            "unit_ops": s.unit_ops,
+            "shed": s.shed_ops,
+            "deferred": s.deferred_ops,
+            "quarantines": len(s.quarantines),
+            "dup_dropped": s.dup_ops_dropped,
+            "evictions": self.pool.evictions,
+            "restores": self.pool.restores,
+            "promotions": self.pool.promotions,
+            "recoveries": s.recoveries,
+            "journal_bytes": (
+                self.journal.bytes_total if self.journal else 0
+            ),
+            "fence_entries": entries_total(),
+        }
+
+    def status_fields(self) -> dict:
+        """The ``/status.json`` snapshot: where the drain is right now,
+        including its fault/degraded state.  Plain scalars only — the
+        status server serializes whatever is published verbatim."""
+        s = self.stats
+        return {
+            "phase": "serving",
+            "round": self.round,
+            "rounds": self._n_rounds,
+            "occupancy": self._last_occ,
+            "queue_depth": self._last_queue,
+            "ops": s.ops,
+            "unit_ops": s.unit_ops,
+            "patches": s.patches,
+            "shed_ops": s.shed_ops,
+            "deferred_ops": s.deferred_ops,
+            "quarantines": len(s.quarantines),
+            "degraded": self._degrade_left > 0,
+            "faults_seen": s.faults_seen,
+            "faults_injected": s.faults_injected,
+            "recoveries": s.recoveries,
+            "snapshots": s.snapshots,
+            "done": False,
+        }
 
     # ---- driver ----
 
@@ -1276,6 +1368,23 @@ class FleetScheduler:
                     with span("serve.degraded_fence"):
                         self.pool.block()  # degraded mode: SYNCHRONOUS K=1
                 self._maybe_snapshot()
+            if self.telemetry is not None:
+                # continuous telemetry: this round's sample (latency
+                # here is pre-fence-fold — the time-series wants the
+                # live rate; the artifact quantiles keep the folded
+                # number via note_round).  Everything inside is pure
+                # host arithmetic on pre-registered series (G013).
+                self.telemetry.note_round(
+                    round_no=self.round,
+                    seconds=time.perf_counter() - t0,
+                    compiled=compiled, barrier=self._snapped,
+                    occupancy=self._last_occ,
+                    queue_depth=self._last_queue,
+                    cum=self._cum_counters(),
+                    shard_lanes=self._sh_lanes, shard_ops=self._sh_ops,
+                    shard_units=self._sh_units,
+                    status=self.status_fields(),
+                )
             # record the PREVIOUS round now and hold this one pending,
             # so run() can fold the final drain fence into the last
             # round's latency before it reaches the histogram

@@ -1,0 +1,45 @@
+"""G013 seed: status/telemetry isolation in hot-path scopes.
+
+``hot_round`` is the declared hot root; ``_publish`` and
+``_lazy_series`` are reached from it.  Constructing or serving an HTTP
+server / raw socket there, and mutating the registry's shape
+(get-or-create, attach), are the violations; swapping a snapshot in
+through a pre-registered reference is the sanctioned pattern.
+``driver_setup`` shows the same calls are LEGAL off the hot call graph
+— server lifecycle and series registration belong to the bench driver.
+"""
+
+import socket
+from http.server import ThreadingHTTPServer
+
+from crdt_benches_tpu.obs.metrics import MetricsRegistry
+from crdt_benches_tpu.obs.status import StatusServer
+
+REG = MetricsRegistry()
+ROUNDS = REG.counter("fixture.rounds")  # pre-registered at bind: clean
+
+
+def hot_round(snapshot):  # graftlint: hot-path
+    ROUNDS.inc()  # held reference: clean
+    _publish(snapshot)
+    _lazy_series()
+
+
+def _publish(snapshot):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), None)  # expect: G013
+    srv.serve_forever()  # expect: G013
+    sock = socket.socket()  # expect: G013
+    sock.close()
+    StatusServer(port=0)  # expect: G013
+
+
+def _lazy_series():
+    REG.counter("fixture.lazy").inc()  # expect: G013
+    REG.attach(ROUNDS)  # expect: G013
+
+
+def driver_setup(reg):
+    # off the hot call graph: registration and server lifecycle are the
+    # driver's job — exactly where these calls belong
+    reg.histogram("tool.lat")
+    return StatusServer(port=0)

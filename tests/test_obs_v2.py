@@ -1,0 +1,584 @@
+"""obs/ v2: per-round time-series, per-shard metrics, the live status
+endpoint, and the soak anomaly detectors.
+
+Contracts under test:
+
+- time-series windows partition the drain exactly: window sums equal
+  the end-of-run ServeStats totals (rounds, ops, unit ops), the ring
+  bounds memory with counted drops, and the JSONL stream mirrors the
+  ring;
+- shard-sum parity on the 8-device virtual mesh: per-shard ops / lane
+  series sum to the fleet totals for EVERY window, and the imbalance
+  gauge reads exactly 1.0 on a uniform fleet;
+- ``/metrics`` conforms to Prometheus text exposition (``# HELP`` /
+  ``# TYPE``, ``_total`` counters, cumulative ``_bucket``/``_sum``/
+  ``_count``, label parsing + escaping);
+- ``/status.json`` advances monotonically while a drain is live
+  (scraped from the test thread, mid-run);
+- the anomaly detectors fire and CLEAR: the stuck-round watchdog on an
+  injected chaos ``stall``, throughput degradation and leak growth on
+  synthetic series;
+- ``tools/bench_compare.py`` gates the per-window throughput floor and
+  tolerates obs/ v2 blocks missing from older baselines.
+"""
+
+import importlib.util
+import json
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from crdt_benches_tpu.obs.anomaly import AnomalyDetector
+from crdt_benches_tpu.obs.status import (
+    StatusServer,
+    escape_label_value,
+    render_prometheus,
+    split_labeled_name,
+)
+from crdt_benches_tpu.obs.status import main as status_main
+from crdt_benches_tpu.obs.timeseries import (
+    ServeTelemetry,
+    TimeseriesRecorder,
+)
+from crdt_benches_tpu.serve.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import (
+    FleetScheduler,
+    prepare_streams,
+)
+from crdt_benches_tpu.serve.workload import Session, build_fleet
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_BANDS = {"synth-small": ("synth", (40, 120))}
+TINY_MIX = {"synth-small": 1.0}
+
+
+def _fleet(tmp_path, n=8, seed=11, classes=(128,), slots=(2,),
+           bands=TINY_BANDS, mix=TINY_MIX, arrival_span=2, batch=8,
+           batch_chars=32, macro_k=4, mesh=None, **kw):
+    sessions = build_fleet(
+        n, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands
+    )
+    pool = DocPool(classes=classes, slots=slots, mesh=mesh,
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(
+        sessions, pool, batch=batch, batch_chars=batch_chars
+    )
+    sched = FleetScheduler(pool, streams, batch=batch, macro_k=macro_k,
+                           batch_chars=batch_chars, **kw)
+    return sessions, pool, streams, sched
+
+
+# ---------------------------------------------------------------------------
+# time-series recorder: exact partition, bounded ring, JSONL stream
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_windows_partition_the_drain(tmp_path):
+    stream = tmp_path / "ts.jsonl"
+    tel = ServeTelemetry(recorder=TimeseriesRecorder(
+        window_rounds=2, stream_path=str(stream)
+    ))
+    _sessions, _pool, _streams, sched = _fleet(
+        tmp_path, telemetry=tel
+    )
+    stats = sched.run()
+    assert sched.done
+    tel.drain_end()
+    blk = tel.recorder.block()
+    assert blk["version"] == 1
+    ws = blk["windows"]
+    assert ws and blk["rounds_seen"] == stats.rounds
+    # exact partition: no round, op, or unit op is lost or counted twice
+    assert sum(w["rounds"] for w in ws) == stats.rounds
+    assert sum(w["ops"] for w in ws) == stats.ops
+    assert sum(w["unit_ops"] for w in ws) == stats.unit_ops
+    assert sum(w["compile_rounds"] for w in ws) == stats.compile_rounds
+    assert sum(w["evictions"] for w in ws) == stats.evictions
+    for w in ws:
+        assert 0.0 <= w["occupancy"] <= 1.0
+        assert w["seconds"] > 0
+        assert w["full"] == (w["rounds"] >= 2)
+        # shard series partition the fleet numbers (n_sh == 1 here)
+        assert sum(w["shard_ops"]) == w["ops"]
+        assert sum(w["shard_lanes"]) == w["lanes"]
+    # only the final window may be partial
+    assert all(w["full"] for w in ws[:-1])
+    # the JSONL stream mirrors the ring exactly
+    lines = [json.loads(ln) for ln in
+             stream.read_text().splitlines()]
+    assert lines == ws
+
+
+def test_timeseries_ring_is_bounded_with_counted_drops():
+    rec = TimeseriesRecorder(window_rounds=1, capacity=2)
+    rec.rebase(n_shards=1)
+    cum = dict.fromkeys(
+        ("ops", "unit_ops", "shed", "deferred", "quarantines",
+         "dup_dropped", "evictions", "restores", "promotions",
+         "recoveries", "journal_bytes", "fence_entries"), 0)
+    for i in range(5):
+        cum["ops"] = (i + 1) * 10
+        w = rec.note_round(
+            round_no=i, seconds=0.01, compiled=False, barrier=False,
+            occupancy=0.5, queue_depth=0, cum=cum,
+        )
+        assert w is not None  # window_rounds=1: every round closes one
+    blk = rec.block()
+    assert len(blk["windows"]) == 2 and blk["dropped_windows"] == 3
+    # delta encoding survived the drops: the retained windows carry
+    # their OWN deltas, not cumulative values
+    assert [w["ops"] for w in blk["windows"]] == [10, 10]
+
+
+# ---------------------------------------------------------------------------
+# shard-sum parity + imbalance on the 8-device virtual mesh
+# ---------------------------------------------------------------------------
+
+
+def test_shard_sum_parity_and_uniform_imbalance(tmp_path):
+    """Per-shard series are a PARTITION of the fleet totals for every
+    window, and a perfectly uniform fleet (16 identical docs over 8
+    shards) gauges imbalance at exactly 1.0 all drain long."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    from crdt_benches_tpu.parallel.mesh import replica_mesh
+    from crdt_benches_tpu.serve.workload import trace_prefix
+
+    tr = trace_prefix("automerge-paper", 240)
+    sessions = [
+        Session(doc_id=i, band="t", source="automerge-paper", trace=tr)
+        for i in range(16)
+    ]
+    pool = DocPool(classes=(256,), slots=(16,), mesh=replica_mesh(8),
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=64)
+    tel = ServeTelemetry(recorder=TimeseriesRecorder(window_rounds=2))
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=64, telemetry=tel)
+    stats = sched.run()
+    assert sched.done
+    tel.drain_end()
+    ws = tel.recorder.block()["windows"]
+    assert ws and tel.recorder.n_shards == 8
+    for w in ws:
+        assert len(w["shard_ops"]) == 8
+        assert sum(w["shard_ops"]) == w["ops"]
+        assert sum(w["shard_unit_ops"]) == w["unit_ops"]
+        assert sum(w["shard_lanes"]) == w["lanes"]
+        # uniform fleet: every shard carries exactly its share
+        assert len(set(w["shard_ops"])) == 1
+        assert len(set(w["shard_lanes"])) == 1
+    # window sums equal the fleet totals the artifact already reports
+    assert sum(w["ops"] for w in ws) == stats.ops
+    assert sum(w["unit_ops"] for w in ws) == stats.unit_ops
+    m = stats.metrics.to_dict()
+    shard_ops = [
+        m["counters"][f'serve.shard.ops{{shard="{s}"}}'] for s in range(8)
+    ]
+    assert sum(shard_ops) == stats.ops
+    assert len(set(shard_ops)) == 1
+    imb = m["gauges"]["serve.shard.imbalance"]
+    assert imb["min"] == imb["max"] == 1.0
+
+
+def test_imbalance_gauge_reads_skew(tmp_path):
+    """A deliberately skewed round (all lanes on shard 0) must gauge
+    max/mean = n_shards, not 1.0 — the signal the mesh push needs."""
+    from crdt_benches_tpu.obs.shard import ShardMetrics
+    from crdt_benches_tpu.obs.metrics import MetricsRegistry
+
+    class _B:
+        Rg = 4
+        n_sh = 4
+
+        def free_locals(self, s):
+            return set()
+
+    class _P:
+        n_sh = 4
+        buckets = {0: _B()}
+
+        def shard_occupancy(self):
+            return [4, 4, 4, 4]
+
+    reg = MetricsRegistry()
+    sm = ShardMetrics(_P(), reg)
+    sm.note_round([4, 0, 0, 0], [32, 0, 0, 0], [64, 0, 0, 0])
+    assert sm.imbalance.value == 4.0
+    sm.note_round([1, 1, 1, 1], [8, 8, 8, 8], [8, 8, 8, 8])
+    assert sm.imbalance.value == 1.0
+    sm.note_round([0, 0, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0])
+    assert sm.imbalance.value == 1.0  # idle round is balanced
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_exposition_conformance():
+    from crdt_benches_tpu.obs.metrics import (
+        LATENCY_BUCKETS_S,
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("serve.pool.evictions").inc(7)
+    for s in range(3):
+        reg.counter(f'serve.shard.ops{{shard="{s}"}}').inc(10 * (s + 1))
+    reg.gauge("serve.shard.imbalance").set(1.25)
+    h = reg.histogram("serve.round.latency.steady", LATENCY_BUCKETS_S)
+    for v in (0.001, 0.01, 0.01, 0.5, 999.0):  # incl. overflow bucket
+        h.observe(v)
+    text = render_prometheus(reg.to_dict())
+    lines = text.splitlines()
+    # counters: HELP + TYPE + _total suffix, dots sanitized
+    assert "# HELP serve_pool_evictions_total registry counter serve.pool.evictions" in lines
+    assert "# TYPE serve_pool_evictions_total counter" in lines
+    assert "serve_pool_evictions_total 7" in lines
+    # labeled series share ONE header per base name
+    assert lines.count("# TYPE serve_shard_ops_total counter") == 1
+    assert 'serve_shard_ops_total{shard="1"} 20' in lines
+    # gauges
+    assert "# TYPE serve_shard_imbalance gauge" in lines
+    assert "serve_shard_imbalance 1.25" in lines
+    # histogram conformance: cumulative buckets, +Inf == count, sum
+    assert "# TYPE serve_round_latency_steady histogram" in lines
+    buckets = [ln for ln in lines
+               if ln.startswith("serve_round_latency_steady_bucket")]
+    assert len(buckets) == len(h.bounds) + 1
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert buckets[-1].startswith(
+        'serve_round_latency_steady_bucket{le="+Inf"}'
+    )
+    assert counts[-1] == 5
+    assert any(ln.startswith("serve_round_latency_steady_sum ")
+               for ln in lines)
+    assert "serve_round_latency_steady_count 5" in lines
+    # every metric name is exposition-legal (no dots or braces outside
+    # the label block)
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        name = ln.split("{")[0].split(" ")[0]
+        assert "." not in name and name.replace("_", "a").isalnum(), ln
+
+
+def test_prometheus_label_parsing_and_escaping():
+    assert split_labeled_name('a.b{shard="3"}') == ("a.b", {"shard": "3"})
+    assert split_labeled_name("a.b") == ("a.b", {})
+    assert escape_label_value('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+    from crdt_benches_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter('serve.x{host="a\\b"}').inc()
+    text = render_prometheus(reg.to_dict())
+    assert 'serve_x_total{host="a\\\\b"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# live status endpoint: mid-run scrape, monotonic advance
+# ---------------------------------------------------------------------------
+
+
+def test_status_json_advances_monotonically_during_live_drain(tmp_path):
+    """The drain runs on a worker thread; THIS thread scrapes
+    /status.json mid-run and must see the round counters advance
+    monotonically, then /healthz 200 and a final done=True snapshot."""
+    status = StatusServer(port=0)
+    port = status.start()
+    tel = ServeTelemetry(
+        recorder=TimeseriesRecorder(window_rounds=1), status=status
+    )
+    bands = {"synth-big": ("synth", (700, 900))}
+    _s, _p, _st, sched = _fleet(
+        tmp_path, n=8, bands=bands, mix={"synth-big": 1.0},
+        classes=(1024,), slots=(2,), batch=4, batch_chars=32,
+        macro_k=2, telemetry=tel,
+    )
+    errors = []
+
+    def drain():
+        try:
+            sched.run()
+            tel.drain_end(status={
+                **sched.status_fields(), "phase": "done", "done": True,
+            })
+        except Exception as e:  # surfaces in the main thread's assert
+            errors.append(e)
+
+    t = threading.Thread(target=drain)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        samples = []
+        for _ in range(2000):
+            s = json.load(urllib.request.urlopen(
+                base + "/status.json", timeout=5
+            ))
+            if "ops" in s:  # the pre-round "starting" snapshot has none
+                samples.append((s["rounds"], s["ops"]))
+            if len(samples) >= 3 and samples[-1][0] > samples[0][0]:
+                break
+            if not t.is_alive():
+                break
+            time.sleep(0.02)
+        h = urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert h.status == 200
+    finally:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert sched.done
+    # fields advanced monotonically while the drain was live
+    assert len(samples) >= 2, "never caught the drain mid-run"
+    assert samples == sorted(samples)
+    assert samples[-1] > samples[0], samples
+    final = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/status.json", timeout=5
+    ))
+    assert final["done"] is True and final["phase"] == "done"
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ).read().decode()
+    assert "serve_pool_evictions_total" in text
+    # the watch CLI renders one line per poll against the same server
+    status_main(["--watch", "--url", f"http://127.0.0.1:{port}",
+                 "--count", "1", "--interval", "0.01"])
+    status.stop()
+
+
+def test_healthz_degrades_on_staleness_and_anomaly():
+    srv = StatusServer(port=0, stale_after=0.05)
+    port = srv.start()
+    try:
+        srv.publish_status({"rounds": 1})
+        h = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        )
+        assert h.status == 200
+        srv.set_health(False, "stuck_round")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            )
+        assert ei.value.code == 503
+        assert b"stuck_round" in ei.value.read()
+        srv.set_health(True)
+        time.sleep(0.1)  # publisher goes silent past stale_after
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            )
+        assert ei.value.code == 503
+        assert b"stale" in ei.value.read()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# anomaly detectors
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_and_clears_synthetic():
+    det = AnomalyDetector(watchdog_s=0.05)
+    for i in range(5):
+        det.note_round(0.01, skip=False, round_no=i)
+    det.note_round(10.0, skip=True, round_no=5)  # compile round: exempt
+    assert det.fired == 0
+    det.note_round(0.2, skip=False, round_no=6)
+    assert det.active_kinds() == ["stuck_round"]
+    det.note_round(0.01, skip=False, round_no=7)
+    assert det.uncleared == 0 and det.fired == 1
+    ev = det.events[0]
+    assert ev["kind"] == "stuck_round" and ev["cleared"]
+    assert ev["round"] == 6 and ev["cleared_round"] == 7
+    # a stalled round never drags the rolling baseline up
+    assert max(det._lat) == pytest.approx(0.01)
+
+
+def _window(i, *, tput=100.0, occ=0.5, rss=None, jbytes=0, ops=1000):
+    return {
+        "end_round": i, "full": True, "throughput": tput,
+        "occupancy": occ, "rss_bytes": rss, "journal_bytes": jbytes,
+        "ops": ops,
+    }
+
+
+def test_throughput_degradation_fires_and_skips_drain_down():
+    det = AnomalyDetector(min_windows=4)
+    for i in range(6):
+        det.note_window(_window(i, tput=100.0 + i % 3))
+    det.note_window(_window(6, tput=30.0))  # collapse at held occupancy
+    assert det.active_kinds() == ["throughput_degradation"]
+    det.note_window(_window(7, tput=100.0))
+    assert det.uncleared == 0 and det.fired == 1
+    # the same collapse WITH collapsed occupancy is a legit drain-down
+    det2 = AnomalyDetector(min_windows=4)
+    for i in range(6):
+        det2.note_window(_window(i))
+    det2.note_window(_window(6, tput=30.0, occ=0.05))
+    assert det2.fired == 0
+    # partial windows never feed the rate detector
+    det3 = AnomalyDetector(min_windows=4)
+    for i in range(6):
+        det3.note_window(_window(i))
+    det3.note_window(dict(_window(6, tput=1.0), full=False))
+    assert det3.fired == 0
+
+
+def test_leak_detectors_fire_on_monotonic_growth_and_clear():
+    det = AnomalyDetector(leak_windows=4, leak_frac=0.2)
+    rss = 100_000_000
+    for i in range(4):
+        rss = int(rss * 1.08)  # strictly rising, +36% over 4 windows
+        det.note_window(_window(i, rss=rss))
+    assert "rss_leak" in det.active_kinds()
+    det.note_window(_window(4, rss=rss))  # plateau clears
+    assert det.uncleared == 0
+    # journal bytes-per-op growth trips the same machinery
+    det2 = AnomalyDetector(leak_windows=4, leak_frac=0.2)
+    for i in range(4):
+        det2.note_window(_window(i, jbytes=1000 * int(1.1 ** i * 100)))
+    assert "journal_growth" in det2.active_kinds()
+
+
+def test_stall_fault_trips_watchdog_and_recovery_clears_it(tmp_path):
+    """THE chaos contract: an injected host ``stall`` must show up as a
+    ``stuck_round`` anomaly, and the next healthy round must clear it —
+    exit-green, because a cleared anomaly is a demonstration."""
+    plan = FaultPlan(
+        [FaultEvent(kind="stall", round=6, param=250)], seed=3
+    )
+    tel = ServeTelemetry(
+        recorder=TimeseriesRecorder(window_rounds=2),
+        anomaly=AnomalyDetector(watchdog_s=0.1),
+    )
+    bands = {"synth-big": ("synth", (500, 700))}
+    _s, _p, _st, sched = _fleet(
+        tmp_path, n=6, bands=bands, mix={"synth-big": 1.0},
+        classes=(1024,), slots=(2,), batch=4, batch_chars=32,
+        macro_k=4, arrival_span=1,
+        faults=FaultInjector(plan), telemetry=tel,
+    )
+    stats = sched.run()
+    assert sched.done
+    tel.drain_end()
+    assert stats.stall_rounds == 1
+    blk = tel.anomaly.block()
+    stuck = [e for e in blk["events"] if e["kind"] == "stuck_round"]
+    assert stuck, f"stall never tripped the watchdog: {blk}"
+    assert all(e["cleared"] for e in stuck)
+    assert blk["uncleared"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: window floor + schema tolerance
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_v2", REPO / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare_v2"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(tmp_path, name, *, pps=100_000.0, floors=(90_000.0,),
+              timeseries=True, anomalies=True):
+    extra = {
+        "family": "serve",
+        "patches_per_sec": pps,
+        "batch_latency": {"p99": 0.005},
+        "rounds": 20,
+        "range_ops": 10_000,
+        "journal": None,
+    }
+    if timeseries:
+        extra["timeseries"] = {
+            "version": 1,
+            "windows": [
+                {"full": True, "throughput": f} for f in floors
+            ] + [{"full": False, "throughput": 1.0}],  # partial: ignored
+        }
+    if anomalies:
+        extra["anomalies"] = {"version": 1, "fired": 0, "uncleared": 0}
+    path = tmp_path / name
+    path.write_text(json.dumps([{"group": "serve", "extra": extra}]))
+    return str(path)
+
+
+def test_bench_compare_window_floor_gates(tmp_path, capsys):
+    bc = _bench_compare()
+    base = _artifact(tmp_path, "base.json", floors=(90_000.0, 95_000.0))
+    same = _artifact(tmp_path, "same.json", floors=(91_000.0,))
+    assert bc.main([same, base]) == 0
+    # one collapsed window fails the floor even at identical mean
+    dip = _artifact(tmp_path, "dip.json", floors=(95_000.0, 40_000.0))
+    assert bc.main([dip, base]) == 1
+    out = capsys.readouterr().out
+    assert "window throughput floor" in out and "FAIL" in out
+    # a TOTAL stall (throughput 0.0) is the worst floor, not a skipped
+    # sample — the truthiness trap this check exists to avoid
+    stall = _artifact(tmp_path, "stall.json", floors=(95_000.0, 0.0))
+    assert bc.main([stall, base]) == 1
+
+
+def test_bench_compare_tolerates_missing_v2_blocks(tmp_path, capsys):
+    """An old baseline without timeseries/anomalies blocks diffs
+    cleanly against a new artifact: skip with a note, exit 0 — never
+    the exit-2 artifact-error path."""
+    bc = _bench_compare()
+    old = _artifact(tmp_path, "old.json", timeseries=False,
+                    anomalies=False)
+    new = _artifact(tmp_path, "new.json")
+    assert bc.main([new, old]) == 0
+    out = capsys.readouterr().out
+    assert "timeseries block" in out and "anomalies block" in out
+    assert "present only in the newer artifact" in out
+    assert out.count("FAIL") == 0
+
+
+# ---------------------------------------------------------------------------
+# the soak wrapper
+# ---------------------------------------------------------------------------
+
+
+def test_run_serve_soak_single_drain_artifact(tmp_path):
+    from crdt_benches_tpu.serve.bench import run_serve_soak
+
+    r, info = run_serve_soak(
+        0.0, seed=3, status_port=0, timeseries_window=2,
+        mix=TINY_MIX, bands=TINY_BANDS, n_docs=6, batch=8,
+        classes=(128,), slots=(4,), arrival_span=2, macro_k=2,
+        batch_chars=32, verify_sample=4,
+        results_dir=str(tmp_path), save_name="soak_test",
+        log=lambda m: None,
+    )
+    assert info["verify_ok"] and info["anomalies_ok"]
+    assert info["iterations"] == 1
+    data = json.load(open(info["path"]))
+    extra = data[0]["extra"]
+    assert extra["timeseries"]["windows"]
+    assert extra["timeseries"]["drains"] == 1
+    assert extra["anomalies"]["fired"] == 0
+    assert extra["status_port"] > 0
+    assert sum(
+        w["ops"] for w in extra["timeseries"]["windows"]
+    ) == extra["range_ops"]

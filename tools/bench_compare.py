@@ -13,7 +13,15 @@ files), the gate compares:
 - **boundary syncs** (fence entries per macro-round from the
   ``boundary_syncs`` block) — the "syncs only at boundaries" invariant
   as a *rate*: a new sync on the hot path shows up here before it shows
-  up in latency.
+  up in latency;
+- **window throughput floor** — when BOTH artifacts carry the obs/ v2
+  ``timeseries`` block, the worst full window's throughput is compared
+  too: a mid-run stall the end-of-run mean averages away fails here.
+
+Artifacts of different schema vintages diff cleanly: an obs/ v2 block
+(``timeseries`` / ``anomalies``) present on only one side is reported
+as a skip with a note, never an error — a new baseline is not required
+to start recording time-series.
 
 Every check carries a noise threshold (benchmarks jitter; the defaults
 are deliberately looser than run-to-run variance on this box) and the
@@ -107,9 +115,48 @@ def _syncs_per_round(extra: dict) -> float | None:
     return sum(b["entries"].values()) / rounds
 
 
+#: Artifact blocks newer runs may carry that older baselines will not
+#: (obs/ v2).  One-sided presence is a schema difference, not a
+#: regression: it becomes a "skip" line with a note, never an error.
+_OPTIONAL_BLOCKS = ("timeseries", "anomalies")
+
+
+def _window_floor(extra: dict) -> float | None:
+    """The WORST full time-series window's throughput — a mid-run dip
+    the end-of-run average hides.  None when the artifact predates the
+    ``timeseries`` block (or carries no full window)."""
+    ts = extra.get("timeseries")
+    if not isinstance(ts, dict):
+        return None
+    tputs = [
+        w.get("throughput") for w in ts.get("windows", ())
+        if isinstance(w, dict) and w.get("full")
+        and w.get("throughput") is not None
+    ]
+    return min(tputs) if tputs else None
+
+
+def _block_presence_checks(new: dict, base: dict) -> list[Check]:
+    out = []
+    for blk in _OPTIONAL_BLOCKS:
+        has_new = isinstance(new.get(blk), dict)
+        has_base = isinstance(base.get(blk), dict)
+        if has_new != has_base:
+            where = "newer" if has_new else "baseline"
+            out.append(Check(
+                f"{blk} block", "skip",
+                note=(
+                    f"present only in the {where} artifact "
+                    "(obs/ v2 schema difference); not compared"
+                ),
+            ))
+    return out
+
+
 def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_p99_regress: float, max_journal_regress: float,
-            max_syncs_regress: float) -> list[Check]:
+            max_syncs_regress: float,
+            max_window_floor_regress: float = 30.0) -> list[Check]:
     checks = [
         _regress(
             "throughput (patches/s)",
@@ -134,7 +181,18 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_syncs_regress, higher_is_better=False,
             skip_note="boundary_syncs block missing",
         ),
+        # per-window floor: only when BOTH artifacts carry full
+        # time-series windows (the looser threshold reflects that a
+        # single worst window is noisier than the run mean)
+        _regress(
+            "window throughput floor (patches-equivalent/s)",
+            _window_floor(new), _window_floor(base),
+            max_window_floor_regress, higher_is_better=True,
+            skip_note="timeseries block missing in at least one "
+                      "artifact",
+        ),
     ]
+    checks.extend(_block_presence_checks(new, base))
     return checks
 
 
@@ -159,6 +217,12 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="PCT",
                     help="max tolerated fence-entries-per-round "
                          "increase (a new hot-path sync shows up here)")
+    ap.add_argument("--max-window-floor-regress", type=float,
+                    default=30.0, metavar="PCT",
+                    help="max tolerated drop of the worst full "
+                         "time-series window's throughput (checked "
+                         "only when both artifacts carry a "
+                         "timeseries block)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -176,6 +240,7 @@ def main(argv: list[str] | None = None) -> int:
         max_p99_regress=args.max_p99_regress,
         max_journal_regress=args.max_journal_regress,
         max_syncs_regress=args.max_syncs_regress,
+        max_window_floor_regress=args.max_window_floor_regress,
     )
     failed = [c for c in checks if c.status == "fail"]
     if args.json:
