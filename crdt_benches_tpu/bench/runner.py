@@ -670,6 +670,7 @@ def run_serve(args) -> int:
         verify_sample=args.serve_verify_sample,
         macro_k=args.serve_macro,
         batch_chars=args.serve_batch_chars,
+        serve_kernel=args.serve_kernel,
         journal_dir=args.serve_journal,
         snapshot_every=args.serve_snapshot_every,
         faults=args.serve_faults,
@@ -703,7 +704,8 @@ def run_serve(args) -> int:
         )
     print(
         f"{r.bench_id}: {r.elements_per_sec:,.0f} patches/s "
-        f"(K={r.extra['macro_k']}, steady batch latency "
+        f"(K={r.extra['macro_k']}, kernel={r.extra['kernel']}, "
+        "steady batch latency "
         f"p50 {r.extra['batch_latency']['p50'] * 1e3:.1f}ms "
         f"/ p99 {r.extra['batch_latency']['p99'] * 1e3:.1f}ms, "
         f"compile {r.extra['compile_time']:.2f}s, "
@@ -757,6 +759,16 @@ def main(argv=None) -> int:
                     help="inserted chars per doc per device round (bounds "
                          "the expansion nbits; insert runs are pre-split "
                          "to fit)")
+    ap.add_argument("--serve-kernel", default="fused",
+                    choices=("fused", "scan"),
+                    help="serve-step kernel: 'fused' = the "
+                         "ops/serve_fused.py path (shared resolve "
+                         "executables + packed narrow op lanes; one "
+                         "VMEM-resident pallas_call per macro dispatch "
+                         "on TPU), 'scan' = the legacy per-shape "
+                         "resolve+apply lax.scan body (the differential "
+                         "baseline).  Recorded in the artifact as "
+                         "extra['kernel']")
     ap.add_argument("--serve-save-name", default=None,
                     help="artifact basename (default serve_<mix>_<docs>)")
     ap.add_argument("--serve-journal", default=None, metavar="DIR",

@@ -1,5 +1,10 @@
-"""Fully-fused RANGE batch application: one Pallas kernel per batch for
-every capacity-wide pass.
+"""Fused RANGE batch application for the REPLAY engines: one Pallas
+kernel per batch runs the capacity-wide passes of
+``engine/replay_range.py``-style shared-stream replay (the serve
+fleet's macro dispatch has its own kernel in ``ops/serve_fused.py``,
+which imports this module's in-kernel building blocks —
+``_tile_cumsum`` / ``_tile_scan_excl`` / ``_flat_cumsum_f32`` — rather
+than this kernel; keep that in mind when changing their semantics).
 
 Profiling the XLA range apply (tools/profile.py range, R=1024, C=182k)
 put it at ~131 ms/batch against a ~3 ms HBM floor: every stage — the

@@ -21,7 +21,11 @@ run-internal char offset — and the same per-delete rank intervals
 ``(dlo, dhi, dcount)``.  The token list is the full 2B+2 worst case
 (token_cap staging is a VMEM concern; XLA just streams it), so overflow
 is impossible by construction and ``nused`` is returned for interface
-parity only.
+parity only.  The scan body lives in :func:`res_step` with the token
+capacity ``T`` as a parameter: the serve fused path
+(``ops/serve_fused.py``) scans the same step over a GROWING token list
+(T = 2i + 2 suffices after i ops), which is where most of its resolve
+speedup comes from.
 """
 
 from __future__ import annotations
@@ -37,6 +41,178 @@ from .resolve import FREE, RUN, TINS
 _BIG = np.int32(1 << 30)
 
 
+def res_step(carry, op, T: int):
+    """ONE resolve step over a token list of capacity ``T`` — the scan
+    body of :func:`resolve_ranges_scan`, factored out with ``T`` as a
+    parameter so the serve path (``ops/serve_fused.py``) can run the
+    same arithmetic over a GROWING token list (the list holds at most
+    ``2 * i + 2`` live tokens after ``i`` ops, so early ops need not
+    pay the full worst-case width).  Semantics are pinned by the
+    differential tests against the Pallas kernel; any change here
+    changes both resolvers."""
+    didx = jnp.arange(T, dtype=jnp.int32)
+    tta, tch, cum, total, nused = carry
+    k, p0, L0, s0 = op
+
+    is_ins = (k == INSERT) & (L0 > 0)
+    p = jnp.clip(p0, 0, total)
+    D = jnp.where(k == DELETE, jnp.clip(L0, 0, total - p), 0)
+    is_del = (k == DELETE) & (D > 0)
+    L = jnp.where(is_ins, L0, 0)
+
+    pre_all = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
+    ttok = jnp.bitwise_and(tta, 3)
+    is_run_tok = ttok == RUN
+
+    # ---- delete rank-interval outputs (pre-clamp coordinates) ----
+    pD = p + D
+    ov_lo = jnp.maximum(pre_all, p)
+    ov_hi = jnp.minimum(cum, pD)
+    has_ov = is_del & is_run_tok & (ov_hi > ov_lo)
+    ta_all = jnp.right_shift(tta, 2)
+    r_lo = ta_all + (ov_lo - pre_all)
+    r_hi = ta_all + (ov_hi - pre_all) - 1
+    dlo = jnp.min(jnp.where(has_ov, r_lo, _BIG))
+    dhi = jnp.max(jnp.where(has_ov, r_hi, -1))
+    dn = jnp.sum(jnp.where(has_ov, ov_hi - ov_lo, 0))
+    dlo = jnp.where(is_del & (dlo < _BIG), dlo, -1)
+    dhi = jnp.where(is_del, dhi, -1)
+    dn = jnp.where(is_del, dn, 0)
+
+    # ---- vector clamp: the delete's effect on every token ----
+    consumed = jnp.maximum(
+        0, jnp.minimum(cum, pD) - jnp.maximum(pre_all, p)
+    )
+    adv = jnp.where(is_del & (cum > pD), consumed, 0)
+    cum_c = jnp.where(
+        is_del, jnp.minimum(cum, p) + jnp.maximum(0, cum - pD), cum
+    )
+    tta_c = tta + jnp.where(is_run_tok, adv * 4, 0)
+    tch_c = tch + jnp.where(ttok == TINS, adv, 0)
+
+    # ---- locate the token containing p (pre-clamp coordinates) ----
+    t = jnp.sum((cum <= p).astype(jnp.int32))
+    t = jnp.minimum(t, nused)
+    c_t = cum[t]
+    pre = pre_all[t]
+    tta_t = tta[t]
+    ch = tch[t]
+    tt = jnp.bitwise_and(tta_t, 3)
+    off = p - pre
+    is_run_t = tt == RUN
+
+    split_ins = is_ins & (off > 0)
+    split_del = is_del & (off > 0) & (pD < c_t)
+    m = jnp.where(
+        is_ins,
+        jnp.where(split_ins, 3, 2),
+        jnp.where(split_del, 2, 1),
+    )
+
+    # Replacement pieces (same arithmetic as the kernel: m == 1
+    # writes the token's CLAMPED values back — identity for
+    # inserts/PAD, the boundary adjustment for spanning deletes).
+    c_t_clamped = jnp.where(
+        is_del, jnp.minimum(c_t, p) + jnp.maximum(0, c_t - pD), c_t
+    )
+    adv_t = jnp.where(
+        is_del & (c_t > pD),
+        jnp.maximum(0, jnp.minimum(c_t, pD) - jnp.maximum(pre, p)),
+        0,
+    )
+    tta_cl = tta_t + jnp.where(is_run_t, adv_t * 4, 0)
+    ch_cl = ch + jnp.where(tt == TINS, adv_t, 0)
+    tta_right_del = tta_t + jnp.where(is_run_t, (pD - pre) * 4, 0)
+    ch_right_del = jnp.where(is_run_t, ch, ch + (pD - pre))
+    tta_right_ins = tta_t + jnp.where(is_run_t, off * 4, 0)
+    ch_right_ins = jnp.where(is_run_t, ch, ch + off)
+    jj_tins = s0 * 4 + TINS  # TINS carries the op's first slot id
+
+    n0ta = jnp.where(
+        is_ins & ~split_ins, jj_tins,
+        jnp.where(split_del, tta_t, tta_cl),
+    )
+    n0c_ = jnp.where(
+        is_ins & ~split_ins, 0, jnp.where(split_del, ch, ch_cl)
+    )
+    n0cum = jnp.where(
+        is_ins,
+        jnp.where(split_ins, p, pre + L),
+        jnp.where(split_del, p, c_t_clamped),
+    )
+    n1ta = jnp.where(
+        is_ins, jnp.where(split_ins, jj_tins, tta_t), tta_right_del
+    )
+    n1c_ = jnp.where(
+        is_ins, jnp.where(split_ins, 0, ch), ch_right_del
+    )
+    n1cum = jnp.where(
+        is_ins, jnp.where(split_ins, p + L, c_t + L), c_t - D
+    )
+    n2ta, n2c_, n2cum = tta_right_ins, ch_right_ins, c_t + L
+
+    src = jnp.clip(didx - (m - 1), 0, T - 1)
+
+    def place(x, x0, x1, x2, dlt):
+        out = jnp.where(didx < t, x, x[src] + dlt)
+        out = jnp.where(didx == t, x0, out)
+        out = jnp.where((m >= 2) & (didx == t + 1), x1, out)
+        out = jnp.where((m == 3) & (didx == t + 2), x2, out)
+        return out
+
+    tta_n = place(tta_c, n0ta, n1ta, n2ta, 0)
+    tch_n = place(tch_c, n0c_, n1c_, n2c_, 0)
+    # tail cum shifts by L past the placed pieces (deletes: 0 — their
+    # tail effect is already in the vector clamp)
+    cum_n = place(cum_c, n0cum, n1cum, n2cum, L)
+
+    return (
+        (tta_n, tch_n, cum_n, total + L - D, nused + (m - 1)),
+        (dlo, dhi, dn),
+    )
+
+
+def res_carry_init(T: int, v0):
+    """The resolve scan's initial carry for a token list of capacity
+    ``T``: token 0 = RUN(0, v0), flat ``cum`` tail (every unused token
+    carries the running total)."""
+    didx = jnp.arange(T, dtype=jnp.int32)
+    v0 = jnp.asarray(v0, jnp.int32)
+    tta0 = jnp.where(didx == 0, RUN, FREE).astype(jnp.int32)
+    tch0 = jnp.zeros(T, jnp.int32)
+    cum0 = jnp.zeros(T, jnp.int32) + v0
+    return (tta0, tch0, cum0, v0, jnp.int32(1))
+
+
+def res_carry_grow(carry, T: int):
+    """Widen a resolve carry to token capacity ``T`` (the growing-list
+    serve path): new tail tokens are FREE with ``cum`` = the running
+    total — exactly the flat tail :func:`res_carry_init` builds, so a
+    widened carry is indistinguishable from a full-width scan's."""
+    tta, tch, cum, total, nused = carry
+    pad = T - tta.shape[0]
+    if pad <= 0:
+        return carry
+    return (
+        jnp.concatenate([tta, jnp.full((pad,), FREE, jnp.int32)]),
+        jnp.concatenate([tch, jnp.zeros((pad,), jnp.int32)]),
+        jnp.concatenate([cum, jnp.zeros((pad,), jnp.int32) + total]),
+        total,
+        nused,
+    )
+
+
+def res_finalize(carry):
+    """Unpack a final resolve carry into the ``(ttype, ta, tch, tlen)``
+    token arrays ``apply_range_batch`` consumes (plus ``nused``)."""
+    tta, tch, cum, _total, nused = carry
+    pre_all = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
+    ttype = jnp.bitwise_and(tta, 3)
+    ta = jnp.right_shift(tta, 2)
+    tlen = cum - pre_all
+    return (ttype, ta, tch, tlen), nused
+
+
 def resolve_ranges_scan(kind, pos, rlen, slot0, v0):
     """Resolve one batch of range ops against a document with ``v0``
     visible chars.  ``kind``/``pos``/``rlen``/``slot0``: int32[B]; ``v0``
@@ -46,135 +222,6 @@ def resolve_ranges_scan(kind, pos, rlen, slot0, v0):
     axis supplied by vmap)."""
     B = kind.shape[0]
     T = 2 * B + 2
-    didx = jnp.arange(T, dtype=jnp.int32)
-    v0 = jnp.asarray(v0, jnp.int32)
-
-    # ttype (2 bits) and ta travel packed as tta = ta * 4 + ttype, the
-    # kernel's packing: one place() pass instead of two.
-    tta0 = jnp.where(didx == 0, RUN, FREE).astype(jnp.int32)
-    tch0 = jnp.zeros(T, jnp.int32)
-    cum0 = jnp.zeros(T, jnp.int32) + v0  # token 0 = RUN(0, v0); flat tail
-
-    def step(carry, op):
-        tta, tch, cum, total, nused = carry
-        k, p0, L0, s0 = op
-
-        is_ins = (k == INSERT) & (L0 > 0)
-        p = jnp.clip(p0, 0, total)
-        D = jnp.where(k == DELETE, jnp.clip(L0, 0, total - p), 0)
-        is_del = (k == DELETE) & (D > 0)
-        L = jnp.where(is_ins, L0, 0)
-
-        pre_all = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
-        ttok = jnp.bitwise_and(tta, 3)
-        is_run_tok = ttok == RUN
-
-        # ---- delete rank-interval outputs (pre-clamp coordinates) ----
-        pD = p + D
-        ov_lo = jnp.maximum(pre_all, p)
-        ov_hi = jnp.minimum(cum, pD)
-        has_ov = is_del & is_run_tok & (ov_hi > ov_lo)
-        ta_all = jnp.right_shift(tta, 2)
-        r_lo = ta_all + (ov_lo - pre_all)
-        r_hi = ta_all + (ov_hi - pre_all) - 1
-        dlo = jnp.min(jnp.where(has_ov, r_lo, _BIG))
-        dhi = jnp.max(jnp.where(has_ov, r_hi, -1))
-        dn = jnp.sum(jnp.where(has_ov, ov_hi - ov_lo, 0))
-        dlo = jnp.where(is_del & (dlo < _BIG), dlo, -1)
-        dhi = jnp.where(is_del, dhi, -1)
-        dn = jnp.where(is_del, dn, 0)
-
-        # ---- vector clamp: the delete's effect on every token ----
-        consumed = jnp.maximum(
-            0, jnp.minimum(cum, pD) - jnp.maximum(pre_all, p)
-        )
-        adv = jnp.where(is_del & (cum > pD), consumed, 0)
-        cum_c = jnp.where(
-            is_del, jnp.minimum(cum, p) + jnp.maximum(0, cum - pD), cum
-        )
-        tta_c = tta + jnp.where(is_run_tok, adv * 4, 0)
-        tch_c = tch + jnp.where(ttok == TINS, adv, 0)
-
-        # ---- locate the token containing p (pre-clamp coordinates) ----
-        t = jnp.sum((cum <= p).astype(jnp.int32))
-        t = jnp.minimum(t, nused)
-        c_t = cum[t]
-        pre = pre_all[t]
-        tta_t = tta[t]
-        ch = tch[t]
-        tt = jnp.bitwise_and(tta_t, 3)
-        off = p - pre
-        is_run_t = tt == RUN
-
-        split_ins = is_ins & (off > 0)
-        split_del = is_del & (off > 0) & (pD < c_t)
-        m = jnp.where(
-            is_ins,
-            jnp.where(split_ins, 3, 2),
-            jnp.where(split_del, 2, 1),
-        )
-
-        # Replacement pieces (same arithmetic as the kernel: m == 1
-        # writes the token's CLAMPED values back — identity for
-        # inserts/PAD, the boundary adjustment for spanning deletes).
-        c_t_clamped = jnp.where(
-            is_del, jnp.minimum(c_t, p) + jnp.maximum(0, c_t - pD), c_t
-        )
-        adv_t = jnp.where(
-            is_del & (c_t > pD),
-            jnp.maximum(0, jnp.minimum(c_t, pD) - jnp.maximum(pre, p)),
-            0,
-        )
-        tta_cl = tta_t + jnp.where(is_run_t, adv_t * 4, 0)
-        ch_cl = ch + jnp.where(tt == TINS, adv_t, 0)
-        tta_right_del = tta_t + jnp.where(is_run_t, (pD - pre) * 4, 0)
-        ch_right_del = jnp.where(is_run_t, ch, ch + (pD - pre))
-        tta_right_ins = tta_t + jnp.where(is_run_t, off * 4, 0)
-        ch_right_ins = jnp.where(is_run_t, ch, ch + off)
-        jj_tins = s0 * 4 + TINS  # TINS carries the op's first slot id
-
-        n0ta = jnp.where(
-            is_ins & ~split_ins, jj_tins,
-            jnp.where(split_del, tta_t, tta_cl),
-        )
-        n0c_ = jnp.where(
-            is_ins & ~split_ins, 0, jnp.where(split_del, ch, ch_cl)
-        )
-        n0cum = jnp.where(
-            is_ins,
-            jnp.where(split_ins, p, pre + L),
-            jnp.where(split_del, p, c_t_clamped),
-        )
-        n1ta = jnp.where(
-            is_ins, jnp.where(split_ins, jj_tins, tta_t), tta_right_del
-        )
-        n1c_ = jnp.where(
-            is_ins, jnp.where(split_ins, 0, ch), ch_right_del
-        )
-        n1cum = jnp.where(
-            is_ins, jnp.where(split_ins, p + L, c_t + L), c_t - D
-        )
-        n2ta, n2c_, n2cum = tta_right_ins, ch_right_ins, c_t + L
-
-        src = jnp.clip(didx - (m - 1), 0, T - 1)
-
-        def place(x, x0, x1, x2, dlt):
-            out = jnp.where(didx < t, x, x[src] + dlt)
-            out = jnp.where(didx == t, x0, out)
-            out = jnp.where((m >= 2) & (didx == t + 1), x1, out)
-            out = jnp.where((m == 3) & (didx == t + 2), x2, out)
-            return out
-
-        tta_n = place(tta_c, n0ta, n1ta, n2ta, 0)
-        tch_n = place(tch_c, n0c_, n1c_, n2c_, 0)
-        # tail cum shifts by L past the placed pieces (deletes: 0 — their
-        # tail effect is already in the vector clamp)
-        cum_n = place(cum_c, n0cum, n1cum, n2cum, L)
-
-        return (
-            (tta_n, tch_n, cum_n, total + L - D, nused + (m - 1)),
-            (dlo, dhi, dn),
-        )
 
     ops = (
         jnp.asarray(kind, jnp.int32),
@@ -182,15 +229,11 @@ def resolve_ranges_scan(kind, pos, rlen, slot0, v0):
         jnp.asarray(rlen, jnp.int32),
         jnp.asarray(slot0, jnp.int32),
     )
-    init = (tta0, tch0, cum0, v0, jnp.int32(1))
-    (tta, tch, cum, _total, nused), (dlo, dhi, dn) = jax.lax.scan(
-        step, init, ops
+    carry, (dlo, dhi, dn) = jax.lax.scan(
+        lambda c, o: res_step(c, o, T), res_carry_init(T, v0), ops
     )
-    pre_all = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum[:-1]])
-    ttype = jnp.bitwise_and(tta, 3)
-    ta = jnp.right_shift(tta, 2)
-    tlen = cum - pre_all
-    return (ttype, ta, tch, tlen), (dlo, dhi, dn), nused
+    tokens, nused = res_finalize(carry)
+    return tokens, (dlo, dhi, dn), nused
 
 
 @boundary(

@@ -1,0 +1,765 @@
+"""Fused serve step: resolve + apply for a whole macro round in one place.
+
+The serve engine's macro dispatch used to be one jitted ``lax.scan``
+whose body called ``resolve_ranges_rows`` then ``apply_range_batch`` —
+two separate ops per round, recompiled for every (class, K, row-tier)
+shape, with every round's capacity-wide intermediates round-tripping
+through HBM.  This module restructures that hot loop around one fact:
+**range resolution does not depend on the document contents**, only on
+the running visible-char count, and that count evolves by a scalar
+recurrence (``total' = total + L_ins - D_del``) that needs no token
+machinery at all.  So:
+
+- :func:`round_starts` computes every round's starting visible count
+  with one cheap scalar scan over all K*B ops — after which the K
+  rounds' resolves are *independent* of the applies;
+- :func:`resolve_round_rows_grow` resolves one round over a **growing
+  token list**: after ``i`` ops the list holds at most ``2i + 2`` live
+  tokens, so the scan widens through chunk-sized capacities instead of
+  paying the full ``2B + 2`` width from op 0 (~35% fewer token-element
+  ops, byte-identical results — ``res_step`` is the single shared scan
+  body);
+- :func:`serve_apply_round_xla` is the off-TPU apply tuned for hosts:
+  native scatter-add spreads and a **gather-based expansion**
+  (``y[d] = x[d - cnt[d]]`` as one ``take_along_axis`` instead of
+  ``nbits`` roll passes — host gathers are cheap; the roll cascade
+  exists for the TPU runtime where gathers serialize);
+- :func:`serve_macro_fused` is the TPU path: ONE ``pallas_call`` with
+  grid ``(row_blocks, K)`` applying all K rounds of a macro dispatch
+  with the document block **resident in VMEM across rounds** (the
+  output block is revisited along the K axis, so state never touches
+  HBM between rounds) while the Pallas pipeline prefetches round
+  ``m + 1``'s op tensors during round ``m`` — the double-buffered VMEM
+  staging the ROADMAP item asks for.  Rank queries (slot lookup against
+  the visibility prefix structure), the boundary spreads, delete-depth
+  /hole-count cumsums (triangular-matmul form), the log-shift
+  expansion, and the fill all run in-kernel; XLA touches only B/T-sized
+  token arrays.
+
+The host orchestration (which shapes share which compiled executables)
+lives in ``serve/pool.py``; everything here is pure shape-in/shape-out.
+Differential byte-parity against the scan path is pinned by
+``tests/test_serve_fused.py`` and the fleet-level tests in
+``tests/test_serve_macro.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..traces.tensorize import DELETE, INSERT
+from .apply2 import LANE, PackedState, count_le_tiled, spread_add_rows
+from .apply_range import (
+    _prev_value,
+    apply_range_batch,
+    ddelta_levels,
+    extract_range_tokens,
+)
+from .apply_range_fused import (
+    _flat_cumsum_f32,
+    _tile_cumsum,
+    _tile_scan_excl,
+)
+from .expand_pallas import _flat_roll
+from .pallas_compat import pltpu  # CompilerParams shim for jax 0.4
+from .resolve import TINS
+from .resolve_range_scan import (
+    res_carry_grow,
+    res_carry_init,
+    res_finalize,
+    res_step,
+)
+
+#: Row-chunk width the pool resolves at: ONE compiled resolve
+#: executable per (chunk, B, lane-dtypes) serves every capacity class
+#: and row tier (the resolve is row-local and capacity-independent).
+#: 128 measured best on host CPU: 64 pays ~8% more dispatch overhead,
+#: 256 wastes up to 2s of padded compute on the small-tier classes.
+RESOLVE_CHUNK_ROWS = 128
+
+#: Growing-token-list chunk: ops [16i, 16(i+1)) scan at capacity
+#: 32(i+1) + 2.
+RESOLVE_OP_CHUNK = 16
+
+#: Op width of the narrow resolve executable: chunks whose every lane
+#: carries at most this many ops (they are front-packed at staging)
+#: resolve a [R, 16] slice and pad (resolve_round_rows_padded) — ~6%
+#: of the full-width cost, and small-doc classes are mostly such
+#: chunks.
+NARROW_RESOLVE_OPS = 16
+
+#: Compiler options for the fused path's host executables: the serve
+#: bodies are huge scan loops whose LLVM "expensive" optimization
+#: passes buy nothing measurable at runtime (probed: run time flat to
+#: slightly better) while costing ~25% of each compile — and compile
+#: spread is the serve fleet's dominant cold-start cost.
+FUSED_COMPILER_OPTIONS = {"xla_llvm_disable_expensive_passes": True}
+
+
+class AotJit:
+    """``jax.jit`` that AOT-lowers on first call so
+    :data:`FUSED_COMPILER_OPTIONS` can be applied (``jax.jit`` itself
+    grew no compiler_options pass-through until well after the pinned
+    jax).  Falls back to the plain jit if lower/compile rejects the
+    options (older/newer runtimes), so behavior never depends on them.
+    """
+
+    def __init__(self, fn, donate_argnums=(), options=None):
+        self._jit = jax.jit(fn, donate_argnums=donate_argnums)
+        self._opts = dict(
+            FUSED_COMPILER_OPTIONS if options is None else options
+        )
+        self._compiled = None
+
+    def __call__(self, *args):
+        if self._compiled is None:
+            try:
+                self._compiled = self._jit.lower(*args).compile(
+                    compiler_options=self._opts
+                )
+            except Exception:  # pragma: no cover - runtime-dependent
+                self._compiled = self._jit
+        if self._compiled is self._jit:
+            return self._jit(*args)
+        try:
+            return self._compiled(*args)
+        except ValueError:
+            # input sharding/layout drifted from the AOT signature
+            # (mesh pools slice staged tensors across devices, so chunk
+            # placements vary call to call): the plain jit reshards and
+            # recompiles as jax normally would.  The AOT form is a
+            # compile-latency optimization, never a semantics one —
+            # demote permanently and keep serving.
+            self._compiled = self._jit
+            return self._jit(*args)
+
+
+def trivial_round_tokens(v0, B: int):
+    """The resolve output of an ALL-PAD op chunk, built directly: one
+    RUN(0, v0) token, FREE tail, no delete intervals.  Byte-identical
+    to scanning the PAD ops (each PAD step writes its token back
+    unchanged) — the fused dispatcher substitutes this for resolve
+    calls on chunks the host can see carry no ops, which trailing
+    macro slices of drained lanes often are."""
+    from .resolve import FREE, RUN
+
+    R = v0.shape[0]
+    T = 2 * B + 2
+    didx = jnp.arange(T, dtype=jnp.int32)
+    first = (didx == 0)[None, :]
+    ttype = jnp.broadcast_to(
+        jnp.where(first, RUN, FREE).astype(jnp.int32), (R, T)
+    )
+    zeros = jnp.zeros((R, T), jnp.int32)
+    tlen = jnp.where(first, jnp.asarray(v0, jnp.int32)[:, None], 0)
+    neg = jnp.full((R, B), -1, jnp.int32)
+    return (
+        (ttype, zeros, zeros, tlen),
+        (neg, neg, jnp.zeros((R, B), jnp.int32)),
+    )
+
+
+# ---------------------------------------------------------------------
+# round starts: the scalar totals recurrence
+# ---------------------------------------------------------------------
+
+
+def round_starts(kind, pos, rlen, v0):
+    """Starting visible-char count of every round in a macro dispatch:
+    kind/pos/rlen int32[K, R, B], v0 int32[R] -> int32[K, R].
+
+    The recurrence mirrors ``res_step``'s clamping exactly (positions
+    clip to [0, total], deletes clip to the remaining suffix), so the
+    result equals the nvis each round's resolve would have observed
+    inside the old interleaved scan — which is what makes the K
+    resolves independent of the K applies."""
+    K, R, B = kind.shape
+    # (K*B, R) op-major: round k's ops occupy rows [k*B, (k+1)*B)
+    flat = lambda x: jnp.swapaxes(
+        jnp.asarray(x, jnp.int32), 0, 1
+    ).reshape(R, K * B).T
+    k2, p2, l2 = flat(kind), flat(pos), flat(rlen)
+
+    def step(tot, op):
+        k, p0, L0 = op
+        is_ins = (k == INSERT) & (L0 > 0)
+        p = jnp.clip(p0, 0, tot)
+        D = jnp.where(k == DELETE, jnp.clip(L0, 0, tot - p), 0)
+        L = jnp.where(is_ins, L0, 0)
+        return tot + L - D, tot
+
+    _, pre = jax.lax.scan(step, jnp.asarray(v0, jnp.int32), (k2, p2, l2))
+    return pre[::B]  # (K, R): the total BEFORE each round's first op
+
+
+def round_total_delta(kind, pos, rlen, v0):
+    """Advance the visible-count recurrence across ONE round: kind/pos/
+    rlen int32[R, B], v0 int32[R] -> the next round's v0.  The pool
+    chains this per round instead of jitting :func:`round_starts` per
+    macro depth — K never keys an executable anywhere on the fused
+    path."""
+    def step(tot, op):
+        k, p0, L0 = op
+        is_ins = (k == INSERT) & (L0 > 0)
+        p = jnp.clip(p0, 0, tot)
+        D = jnp.where(k == DELETE, jnp.clip(L0, 0, tot - p), 0)
+        L = jnp.where(is_ins, L0, 0)
+        return tot + L - D, None
+
+    out, _ = jax.lax.scan(
+        step,
+        jnp.asarray(v0, jnp.int32),
+        tuple(
+            jnp.asarray(a, jnp.int32).T for a in (kind, pos, rlen)
+        ),
+    )
+    return out
+
+
+# ---------------------------------------------------------------------
+# growing-token-list resolve
+# ---------------------------------------------------------------------
+
+
+def _resolve_grow1(kind, pos, rlen, slot0, v0, chunk: int):
+    """One row's round resolved over a growing token list.  Exactly
+    ``resolve_ranges_scan`` (same step, same outputs) but the scan runs
+    in op chunks of ``chunk`` with the carry widened between chunks —
+    ops [0, c) only ever touch ``2c + 2`` tokens, so early chunks skip
+    most of the worst-case width."""
+    B = kind.shape[0]
+    T_full = 2 * B + 2
+    ops = (
+        jnp.asarray(kind, jnp.int32),
+        jnp.asarray(pos, jnp.int32),
+        jnp.asarray(rlen, jnp.int32),
+        jnp.asarray(slot0, jnp.int32),
+    )
+    carry = res_carry_init(2 * min(chunk, B) + 2, v0)
+    outs = []
+    c0 = 0
+    while c0 < B:
+        c1 = min(c0 + chunk, B)
+        T = 2 * c1 + 2
+        carry = res_carry_grow(carry, T)
+        sl = tuple(o[c0:c1] for o in ops)
+        carry, ys = jax.lax.scan(
+            lambda c, o: res_step(c, o, T), carry, sl
+        )
+        outs.append(ys)
+        c0 = c1
+    carry = res_carry_grow(carry, T_full)
+    dlo = jnp.concatenate([y[0] for y in outs])
+    dhi = jnp.concatenate([y[1] for y in outs])
+    dn = jnp.concatenate([y[2] for y in outs])
+    tokens, nused = res_finalize(carry)
+    return tokens, (dlo, dhi, dn), nused
+
+
+def resolve_round_rows_grow(kind, pos, rlen, slot0, v0,
+                            chunk: int = RESOLVE_OP_CHUNK):
+    """Per-row growing-list resolve of ONE round: kind/pos/rlen/slot0
+    [R, B] (any integer dtype — widened here, see ops/packing.py), v0
+    int32[R].  Returns (tokens [R, T], dints [R, B]) — byte-identical
+    to ``resolve_ranges_rows`` (differentially tested)."""
+    f = lambda k, p, l, s, v: _resolve_grow1(k, p, l, s, v, chunk)
+    tokens, dints, _ = jax.vmap(f)(
+        *(jnp.asarray(a, jnp.int32) for a in (kind, pos, rlen, slot0)),
+        jnp.asarray(v0, jnp.int32),
+    )
+    return tokens, dints
+
+
+def resolve_round_rows_padded(kind, pos, rlen, slot0, v0, out_B: int,
+                              chunk: int = RESOLVE_OP_CHUNK):
+    """Resolve a FRONT-PACKED narrow op slice (ops [R, b] with b <
+    out_B) and pad the outputs to the full round width: FREE/zero-
+    length tail tokens and empty delete intervals are inert everywhere
+    downstream, so the result is byte-identical to resolving the full
+    [R, out_B] slice whose trailing slots are PAD.  The pool uses this
+    when the host can see every lane of a chunk carries few ops —
+    resolve cost scales with b * (2b + 2), so a 16-op slice costs ~6%
+    of a 64-op one."""
+    tokens, dints = resolve_round_rows_grow(
+        kind, pos, rlen, slot0, v0, chunk
+    )
+    from .resolve import FREE
+
+    R, b = kind.shape[0], kind.shape[1]
+    padT = (2 * out_B + 2) - (2 * b + 2)
+    padB = out_B - b
+    ttype, ta, tch, tlen = tokens
+    fill = lambda x, v: jnp.concatenate(
+        [x, jnp.full((R, padT), v, jnp.int32)], axis=1
+    )
+    tokens = (
+        fill(ttype, FREE), fill(ta, 0), fill(tch, 0), fill(tlen, 0)
+    )
+    dlo, dhi, dn = dints
+    fillB = lambda x, v: jnp.concatenate(
+        [x, jnp.full((R, padB), v, jnp.int32)], axis=1
+    )
+    return tokens, (fillB(dlo, -1), fillB(dhi, -1), fillB(dn, 0))
+
+
+# ---------------------------------------------------------------------
+# off-TPU apply round (the XLA twin of the serve kernel)
+# ---------------------------------------------------------------------
+
+
+def serve_apply_round_xla(state: PackedState, tokens, dints,
+                          nbits: int) -> PackedState:
+    """One round's range application, host-tuned: same contract and
+    byte semantics as ``apply_range_batch`` (differentially tested) but
+    with the expansion as ONE gather — ``y[d] = x[d - cnt[d]]`` via
+    ``take_along_axis`` — instead of ``nbits`` masked roll passes, and
+    all spreads as native row scatter-adds.  Positions with
+    ``d - cnt[d] < 0`` can only be insert holes (cnt[d] > d means every
+    position <= d is a hole), so the clamped gather's garbage there is
+    always overwritten by the fill."""
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    R, C = state.doc.shape
+    B = dlo.shape[1]
+    drop = jnp.int32(C + 7)
+    col = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+    vis_bit = jnp.bitwise_and(state.doc, 1)
+
+    has_del = dlo >= 0
+    live, gvis, cumlen = extract_range_tokens(
+        ttype, ta, tch, tlen, v0=state.nvis
+    )
+    allq_in = jnp.concatenate(
+        [
+            jnp.where(has_del, dlo, 0),
+            jnp.where(has_del, dhi, 0),
+            jnp.where(live, gvis, 0),
+        ],
+        axis=1,
+    )
+    cumvis = jnp.cumsum(
+        vis_bit * (col < state.length[:, None]).astype(jnp.int32), axis=1
+    )
+    allq = count_le_tiled(cumvis, allq_in)
+    lo_phys = allq[:, :B]
+    hi_phys = allq[:, B : 2 * B]
+    gq_phys = allq[:, 2 * B :]
+
+    # ---- deletes: clear visible bits over physical rank intervals ----
+    starts = spread_add_rows(
+        jnp.where(has_del, lo_phys, drop), has_del.astype(jnp.int32), C
+    )
+    stops = spread_add_rows(
+        jnp.where(has_del, hi_phys + 1, drop), has_del.astype(jnp.int32), C
+    )
+    in_del = jnp.cumsum(starts - stops, axis=1) > 0
+    doc = state.doc - (vis_bit & in_del.astype(jnp.int32))
+
+    # ---- insert runs: destinations, hole counts, per-run deltas ----
+    at_end = gvis >= state.nvis[:, None]
+    g_phys = jnp.where(at_end, state.length[:, None], gq_phys)
+    dest0 = jnp.where(live, g_phys + cumlen, drop)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+    s1 = spread_add_rows(dest0, live.astype(jnp.int32), C)
+    s2 = spread_add_rows(dstop, live.astype(jnp.int32), C)
+    ind = (jnp.cumsum(s1 - s2, axis=1) > 0).astype(jnp.int32)
+    cnt = jnp.cumsum(ind, axis=1)
+    delta = jnp.where(live, ta + tch - dest0, 0)
+    ddelta = jnp.where(live, delta - _prev_value(delta, live), 0)
+    dd_dense = spread_add_rows(
+        jnp.where(live, dest0, drop), ddelta, C
+    )
+    delta_cum = jnp.cumsum(dd_dense, axis=1)
+
+    # ---- expansion as one clamped gather + fill ----
+    doc = jnp.take_along_axis(doc, jnp.maximum(col - cnt, 0), axis=1)
+    doc = jnp.where(
+        ind > 0, jnp.left_shift(col + delta_cum + 2, 1) | 1, doc
+    )
+
+    n_ins = jnp.sum(jnp.where(live, tlen, 0), axis=1)
+    n_del = jnp.sum(jnp.where(has_del, dcount, 0), axis=1)
+    length = state.length + n_ins
+    beyond = col >= length[:, None]
+    return PackedState(
+        doc=jnp.where(beyond, jnp.int32(2), doc),  # pack(-1, 0) == 2
+        length=length,
+        nvis=state.nvis + n_ins - n_del,
+    )
+
+
+# ---------------------------------------------------------------------
+# the serve kernel: all K rounds in one pallas_call
+# ---------------------------------------------------------------------
+
+#: Estimated Mosaic scoped-stack bytes per doc position for
+#: _serve_round_kernel: the range-fused working set (~150 B/pos) plus
+#: the in-kernel rank-query intermediates — the (Rt, nt, Q) tile
+#: compare and the (Rt, LANE, Q) row fetch with Q = 2*Bp + Tp.
+SERVE_FUSED_BYTES_PER_POS = 220
+
+
+def _serve_pads(B: int) -> tuple[int, int]:
+    """(Bp, Tp): the kernel's lane-padded delete-interval and token
+    widths (minor dims must be LANE multiples — lint G010)."""
+    Bp = -(-B // LANE) * LANE
+    Tp = -(-(2 * B + 2) // LANE) * LANE
+    return Bp, Tp
+
+
+def serve_fused_fits(C: int, B: int) -> bool:
+    """The ONE VMEM gate for the serve kernel (mirrors
+    ``range_fused_fits``): callers and the dispatcher must agree."""
+    return SERVE_FUSED_BYTES_PER_POS * C <= 96 * 2**20
+
+
+def _prev_value_flat(x, m, t2: int):
+    """In-kernel ``_prev_value``: per row, for each masked position the
+    previous masked position's value (0 if none), over (Rt, t2, LANE)
+    arrays in flattened (tile, lane) order.  Log-shift forward fill via
+    _flat_roll with the wrapped lanes masked by the flat column."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 2)
+    col = (
+        jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) * LANE + lane
+    )
+    carry_v = jnp.where(m, x, 0)
+    carry_m = m.astype(jnp.int32)
+    s = 1
+    while s < t2 * LANE:
+        sv = jnp.where(col >= s, _flat_roll(carry_v, s), 0)
+        sm = jnp.where(col >= s, _flat_roll(carry_m, s), 0)
+        carry_v = jnp.where(carry_m > 0, carry_v, sv)
+        carry_m = jnp.maximum(carry_m, sm)
+        s *= 2
+    pv = jnp.where(col >= 1, _flat_roll(carry_v, 1), 0)
+    pm = jnp.where(col >= 1, _flat_roll(carry_m, 1), 0)
+    return jnp.where(m & (pm > 0), pv, 0)
+
+
+def _spread_dot(tileq, laneq, val, nt: int):
+    """In-kernel exact scatter-add of ``val[r, w]`` at flat position
+    ``tileq[r, w] * LANE + laneq[r, w]`` into a dense (Rt, nt, LANE)
+    int32 array, as two one-hot contractions (the _mxu_spread
+    factorization run in VMEM).  Out-of-range positions must arrive
+    with ``tileq >= nt`` (no one-hot match = dropped).  Exactness:
+    every value is f32-exact (small ints or 7-bit chunks shifted by
+    2^7k) and collisions accumulate in f32 (< 2^24)."""
+    Rt, W = tileq.shape
+    ohT = (
+        jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, W), 1)
+        == tileq[:, None, :]
+    ).astype(jnp.float32)
+    m1 = ohT * val[:, None, :].astype(jnp.float32)
+    ohL = (
+        jax.lax.broadcasted_iota(jnp.int32, (Rt, W, LANE), 2)
+        == laneq[:, :, None]
+    ).astype(jnp.float32)
+    dense = jax.lax.dot_general(
+        m1, ohL, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    return dense.astype(jnp.int32)
+
+
+def _count_le_kernel(cv, q, nt: int, C: int):
+    """In-kernel ``count_le``: #{flat positions with cumvis <= q} from
+    the absolute within-kernel cumvis (Rt, nt, LANE).  Tile-maxima
+    narrowing + a 7-bit-chunked one-hot row fetch (cumvis values reach
+    C > the bf16-exact range, so the fetch rides chunk dots), then a
+    lane compare — the count_le_tiled contract without a single
+    serialized gather."""
+    Rt, Q = q.shape
+    tmax = cv[:, :, LANE - 1 :]  # (Rt, nt, 1)
+    nfull = jnp.sum(
+        (tmax <= q[:, None, :]).astype(jnp.int32), axis=1
+    )  # (Rt, Q)
+    tq = jnp.minimum(nfull, nt - 1)
+    ohT = (
+        jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, Q), 1)
+        == tq[:, None, :]
+    ).astype(jnp.float32)
+    n_ch = max(3, -(-((int(C) - 1).bit_length()) // 7))
+    rows = jnp.zeros((Rt, LANE, Q), jnp.int32)
+    for k in range(n_ch):
+        chunk = jnp.bitwise_and(
+            jnp.right_shift(cv, 7 * k), 127
+        ).astype(jnp.float32)
+        rows = rows + jnp.left_shift(
+            jax.lax.dot_general(
+                chunk, ohT, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32),
+            7 * k,
+        )
+    within = jnp.sum(
+        (rows <= q[:, None, :]).astype(jnp.int32), axis=1
+    )
+    return jnp.where(nfull >= nt, C, nfull * LANE + within)
+
+
+def _serve_round_kernel(
+    doc_ref, dlo_ref, dhi_ref, gvis_ref, live_ref, cumlen_ref,
+    atch_ref, tlen_ref, lenk_ref, nvisk_ref, newlen_ref,
+    doc_out,
+    *, nt: int, nbits: int, Rt: int, Bp: int, Tp: int, dlv: int,
+):
+    """One (row-block, round) grid step of the fused serve dispatch.
+
+    The doc block is CARRIED across the K rounds of the grid's minor
+    axis: the output block's index map pins (i, k) -> block i, so
+    Pallas keeps it VMEM-resident between rounds (round 0 seeds it from
+    the input doc) while the per-round op tensors stream in
+    double-buffered.  Everything capacity-wide happens here; the
+    B/T-sized inputs were precomputed by :func:`serve_round_inputs`.
+    """
+    k = pl.program_id(1)
+    C = nt * LANE
+    drop = jnp.int32(C + 7)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 2)
+    col = (
+        jax.lax.broadcasted_iota(jnp.int32, (Rt, nt, LANE), 1) * LANE
+        + lane
+    )
+    li = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
+    tri = (li <= lj).astype(jnp.float32)
+
+    @pl.when(k == 0)
+    def _():
+        doc_out[:] = doc_ref[:]
+
+    doc = doc_out[:]
+    vis = jnp.bitwise_and(doc, 1)
+    cv = _flat_cumsum_f32(vis, tri)  # absolute cumvis of THIS round
+
+    dlo = dlo_ref[0]
+    dhi = dhi_ref[0]
+    gvis = gvis_ref[0]
+    live = live_ref[0] > 0
+    cumlen = cumlen_ref[0]
+    atch = atch_ref[0]
+    tlen = tlen_ref[0]
+    len_k = lenk_ref[0]  # (Rt, 1)
+    nvis_k = nvisk_ref[0]
+    newlen = newlen_ref[0]
+
+    # ---- rank queries: delete endpoints + insert gaps in one pass ----
+    has_del = dlo >= 0
+    q = jnp.concatenate(
+        [
+            jnp.where(has_del, dlo, 0),
+            jnp.where(has_del, dhi, 0),
+            jnp.where(live, gvis, 0),
+        ],
+        axis=1,
+    )  # (Rt, 2*Bp + Tp)
+    allq = _count_le_kernel(cv, q, nt, C)
+    lo_phys = allq[:, :Bp]
+    hi_phys = allq[:, Bp : 2 * Bp]
+    gq_phys = allq[:, 2 * Bp :]
+
+    # ---- deletes: signed boundary spread -> depth -> clear vis ----
+    idx_d = jnp.concatenate(
+        [
+            jnp.where(has_del, lo_phys, drop),
+            jnp.where(has_del, hi_phys + 1, drop),
+        ],
+        axis=1,
+    )
+    hd = has_del.astype(jnp.int32)
+    val_d = jnp.concatenate([hd, -hd], axis=1)
+    deld = _spread_dot(
+        jnp.right_shift(idx_d, 7), jnp.bitwise_and(idx_d, 127), val_d, nt
+    )
+    depth = _flat_cumsum_f32(deld, tri)
+    doc = doc - (vis & (depth > 0).astype(jnp.int32))
+
+    # ---- insert destinations and the hole map ----
+    at_end = gvis >= nvis_k
+    g_phys = jnp.where(at_end, len_k, gq_phys)
+    dest0 = jnp.where(live, g_phys + cumlen, drop)
+    dstop = jnp.where(live, dest0 + tlen, drop)
+    lv = live.astype(jnp.int32)
+    idx_i = jnp.concatenate([dest0, dstop], axis=1)
+    val_i = jnp.concatenate([lv, -lv], axis=1)
+    ind_d = _spread_dot(
+        jnp.right_shift(idx_i, 7), jnp.bitwise_and(idx_i, 127), val_i, nt
+    )
+    run_ind = (_flat_cumsum_f32(ind_d, tri) > 0).astype(jnp.int32)
+    cnt = _flat_cumsum_f32(run_ind, tri)
+
+    # ---- per-run slot deltas: one chunked spread + chunked cumsum ----
+    delta = jnp.where(live, atch - dest0, 0)
+    ddelta = jnp.where(
+        live, delta - _prev_value_flat(
+            delta.reshape(Rt, Tp // LANE, LANE),
+            live.reshape(Rt, Tp // LANE, LANE),
+            Tp // LANE,
+        ).reshape(Rt, Tp),
+        0,
+    )
+    sgn = jnp.where(ddelta < 0, -1, 1)
+    mag = jnp.abs(ddelta)
+    lvl = [
+        sgn * jnp.left_shift(
+            jnp.bitwise_and(jnp.right_shift(mag, 7 * j), 127), 7 * j
+        )
+        for j in range(dlv)
+    ]
+    dd = _spread_dot(
+        jnp.concatenate([jnp.right_shift(dest0, 7)] * dlv, axis=1),
+        jnp.concatenate([jnp.bitwise_and(dest0, 127)] * dlv, axis=1),
+        jnp.concatenate(lvl, axis=1),
+        nt,
+    )
+    # chunked tile cumsum of the signed dd (the _range_fused_kernel
+    # exactness argument: per level, partial sums stay below 2^24)
+    dcum_w = jnp.zeros((Rt, nt, LANE), jnp.int32)
+    for v, sign in ((jnp.maximum(dd, 0), 1), (jnp.maximum(-dd, 0), -1)):
+        for j in range(dlv):
+            chunk = jnp.bitwise_and(jnp.right_shift(v, 7 * j), 127)
+            dcum_w = dcum_w + sign * jnp.left_shift(
+                _tile_cumsum(chunk, tri), 7 * j
+            )
+    dcum = dcum_w + _tile_scan_excl(dcum_w[:, :, LANE - 1 :])
+
+    # ---- expansion y[d] = x[d - cnt[d]] + fill + beyond-length ----
+    maxcnt = jnp.max(cnt[:, :, LANE - 1 :])
+    doc_out[:] = doc
+    for b in reversed(range(nbits)):
+        step = 1 << b
+
+        @pl.when(maxcnt >= step)
+        def _():
+            d = doc_out[:]
+            take = (jnp.bitwise_and(cnt, step) != 0) & (col >= step)
+            doc_out[:] = jnp.where(take, _flat_roll(d, step), d)
+
+    fill = jnp.left_shift(col + dcum + 2, 1) | 1
+    doc_out[:] = jnp.where(run_ind != 0, fill, doc_out[:])
+    nl = newlen.reshape(Rt, 1, 1)
+    doc_out[:] = jnp.where(col >= nl, 2, doc_out[:])
+
+
+def serve_round_inputs(tokens, dints, length0, nvis0):
+    """XLA prologue shared by the kernel and its fallback: per-round
+    B/T-sized arrays derived from the K resolved rounds.  tokens:
+    (ttype, ta, tch, tlen) int32[K, R, T]; dints int32[K, R, B];
+    length0/nvis0 int32[R] the macro dispatch's starting state.
+
+    Per-round lengths and visible counts are data-independent of the
+    document (insert/delete volumes come straight from the resolve
+    outputs), so the whole K-round schedule is computed here once:
+    returns (live, gvis, cumlen int32[K, R, T], len_k, nvis_k, newlen
+    int32[K, R], length_K, nvis_K int32[R])."""
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    live0 = (ttype == TINS) & (tlen > 0)
+    n_ins = jnp.sum(jnp.where(live0, tlen, 0), axis=2)  # (K, R)
+    n_del = jnp.sum(jnp.where(dlo >= 0, dcount, 0), axis=2)
+    ins_cum = jnp.cumsum(n_ins, axis=0)
+    del_cum = jnp.cumsum(n_del, axis=0)
+    len_k = length0[None, :] + ins_cum - n_ins  # round-start lengths
+    nvis_k = nvis0[None, :] + (ins_cum - n_ins) - (del_cum - n_del)
+    newlen = length0[None, :] + ins_cum
+    live, gvis, cumlen = jax.vmap(extract_range_tokens)(
+        ttype, ta, tch, tlen, nvis_k
+    )
+    return (
+        live.astype(jnp.int32), gvis, cumlen, len_k, nvis_k, newlen,
+        length0 + ins_cum[-1], nvis0 + ins_cum[-1] - del_cum[-1],
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nbits", "replica_tile", "interpret")
+)
+def serve_macro_fused(state: PackedState, tokens, dints, *,
+                      nbits: int, replica_tile: int = 0,
+                      interpret: bool = False) -> PackedState:
+    """Apply all K resolved rounds to a PackedState stack with ONE
+    pallas_call (grid = (row_blocks, K); the doc block rides VMEM
+    across the K axis).  tokens/dints as from K stacked
+    ``resolve_round_rows_grow`` calls.  Falls back is the caller's job
+    (see ``serve_fused_fits``); interpret=True runs the kernel under
+    the Pallas interpreter for off-TPU differential tests."""
+    ttype, ta, tch, tlen = tokens
+    dlo, dhi, dcount = dints
+    K, R, T = ttype.shape
+    B = dlo.shape[2]
+    C = state.doc.shape[1]
+    nt = C // LANE
+    Bp, Tp = _serve_pads(B)
+
+    (live, gvis, cumlen, len_k, nvis_k, newlen, length_K, nvis_K
+     ) = serve_round_inputs(tokens, dints, state.length, state.nvis)
+
+    padT = lambda x, v: jnp.concatenate(
+        [x, jnp.full((K, R, Tp - T), v, jnp.int32)], axis=2
+    ) if Tp > T else x
+    padB = lambda x, v: jnp.concatenate(
+        [x, jnp.full((K, R, Bp - B), v, jnp.int32)], axis=2
+    ) if Bp > B else x
+
+    Rt = replica_tile
+    if Rt <= 0:
+        Rt = max(1, (96 * 2**20) // (SERVE_FUSED_BYTES_PER_POS * C))
+    Rt = min(Rt, R)
+    while R % Rt:
+        Rt -= 1
+    doc_spec = pl.BlockSpec(
+        (Rt, nt, LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    rnd = lambda W: pl.BlockSpec(
+        (1, Rt, W), lambda i, k: (k, i, 0), memory_space=pltpu.VMEM
+    )
+    one = pl.BlockSpec(
+        (1, Rt, 1), lambda i, k: (k, i, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _serve_round_kernel, nt=nt, nbits=nbits, Rt=Rt, Bp=Bp, Tp=Tp,
+        dlv=ddelta_levels(C),
+    )
+    doc_o = pl.pallas_call(
+        kernel,
+        grid=(R // Rt, K),
+        in_specs=[doc_spec] + [rnd(Bp)] * 2 + [rnd(Tp)] * 5
+        + [one] * 3,
+        out_specs=doc_spec,
+        out_shape=jax.ShapeDtypeStruct((R, nt, LANE), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2**20
+        ),
+        interpret=interpret,
+    )(
+        state.doc.reshape(R, nt, LANE),
+        padB(dlo, -1), padB(dhi, -1),
+        padT(gvis, 0), padT(live, 0), padT(cumlen, 0),
+        padT(ta + tch, 0), padT(tlen, 0),
+        len_k[:, :, None], nvis_k[:, :, None], newlen[:, :, None],
+    )
+    return PackedState(
+        doc=doc_o.reshape(R, C), length=length_K, nvis=nvis_K
+    )
+
+
+def serve_macro_rounds_xla(state: PackedState, tokens, dints,
+                           nbits: int) -> PackedState:
+    """The fused dispatch's non-kernel twin: scan the K resolved rounds
+    through the per-round apply (host-tuned off TPU, the proven
+    ``apply_range_batch`` on TPU shapes beyond the VMEM gate)."""
+    on_tpu = jax.default_backend() == "tpu"
+
+    def body(st, x):
+        tok, di = x
+        if on_tpu:
+            return apply_range_batch(st, tok, di, nbits=nbits), None
+        return serve_apply_round_xla(st, tok, di, nbits), None
+
+    out, _ = jax.lax.scan(body, state, (tokens, dints))
+    return out

@@ -157,6 +157,7 @@ def run_serve_bench(
     bands: dict | None = None,
     macro_k: int = 8,
     batch_chars: int = 256,
+    serve_kernel: str = "fused",
     spool_dir: str | None = None,
     journal_dir: str | None = None,
     snapshot_every: int = 32,
@@ -254,7 +255,7 @@ def run_serve_bench(
             delivery=delivery,
         )
         pool = DocPool(classes=classes, slots=slots, mesh=mesh,
-                       spool_dir=spool_dir)
+                       spool_dir=spool_dir, serve_kernel=serve_kernel)
         streams = prepare_streams(
             sessions, pool, batch=batch, batch_chars=batch_chars
         )
@@ -266,6 +267,8 @@ def run_serve_bench(
             f"serve: {len(sessions)} docs, {total_ops} range ops "
             f"({total_units} unit ops), classes={classes} slots={slots} "
             f"batch={batch} chars={batch_chars} K={macro_k} "
+            f"kernel={serve_kernel} "
+            f"lanes={'/'.join(str(d) for d in pool.op_dtypes)} "
             f"mesh={mesh_devices if mesh else 'off'}"
         )
 
@@ -278,6 +281,7 @@ def run_serve_bench(
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
             profiler=profiler, telemetry=telemetry,
+            warm_start=True,
         )
         # per-fence boundary-sync counters cover drain + verify; with
         # CRDT_BENCH_SANITIZE_SYNCS=1 any sync outside a declared fence
@@ -457,6 +461,8 @@ def run_serve_bench(
                 "batch": batch,
                 "batch_chars": batch_chars,
                 "macro_k": macro_k,
+                "kernel": serve_kernel,
+                "op_dtypes": [str(d) for d in pool.op_dtypes],
                 "classes": list(classes),
                 "slots": list(slots),
                 "mesh_devices": mesh_devices if mesh else 0,

@@ -21,14 +21,19 @@ checkpoint spool (``utils/checkpoint.py`` .npz round-trip) when their
 bucket is full — cold docs rehydrate into *any* free row later.
 
 The serving hot path is the **macro step**: K rounds of per-class
-``(R, B)`` range-op tensors staged into one device buffer and consumed by
-a single jitted ``lax.scan`` — the device, not the Python round loop,
-owns the steady state (one dispatch instead of K, donated state keeps the
-scan allocation-free).  Because mean lane occupancy in a serving fleet is
-low, the step can run on a **row-tier slice** of the stack: the scheduler
-compacts the macro-round's active documents into the first ``Rt`` rows
-(per shard, under a mesh) and the jitted step slices/writes back inside
-the same dispatch, so idle rows cost nothing.
+``(R, B)`` range-op tensors staged (in packed narrow lane dtypes —
+``ops/packing.py``) and applied with donated device state through one of
+two byte-identical kernels (``serve_kernel``): the default **fused**
+path (``ops/serve_fused.py`` — shape-shared resolve executables, a
+per-round host-tuned apply off TPU, one VMEM-resident ``pallas_call``
+for all K rounds on TPU) or the legacy **scan** path (one jitted
+``lax.scan`` whose body resolves + applies, compiled per shape).
+Either way the device, not the Python round loop, owns the steady
+state.  Because mean lane occupancy in a serving fleet is low, the step
+runs on a **row-tier slice** of the stack: the scheduler compacts the
+macro-round's active documents into the first ``Rt`` rows (per shard,
+under a mesh) and the step slices/writes back around the dispatch, so
+idle rows cost nothing.
 
 The optional ``mesh`` shards every bucket's row (document) axis over the
 ``parallel/mesh.py`` replica mesh axis — the docs-over-mesh layout.  All
@@ -54,13 +59,36 @@ from ..lint.sanitizer import fenced
 from ..obs.metrics import Counter
 from ..ops.apply2 import LANE, PackedState, apply_batch3
 from ..ops.apply_range import apply_range_batch
+from ..ops.packing import op_lane_dtypes, widen_ops
 from ..ops.resolve import resolve_batch
 from ..ops.resolve_range_scan import resolve_ranges_rows
+from ..ops.serve_fused import (
+    NARROW_RESOLVE_OPS,
+    RESOLVE_CHUNK_ROWS,
+    AotJit,
+    resolve_round_rows_grow,
+    resolve_round_rows_padded,
+    round_starts,
+    round_total_delta,
+    serve_apply_round_xla,
+    serve_fused_fits,
+    serve_macro_fused,
+    serve_macro_rounds_xla,
+    trivial_round_tokens,
+)
+from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
     CorruptCheckpointError,
     load_state,
     save_state,
 )
+
+#: Serve-step kernel selections (`--serve-kernel`): "fused" = the
+#: ops/serve_fused.py path (shared resolve executables, host-tuned
+#: apply off TPU, the single-pallas_call macro kernel on TPU); "scan" =
+#: the PR 2 lax.scan body (resolve + apply per scanned round in one
+#: jit per shape) kept as the differential baseline.
+SERVE_KERNELS = ("fused", "scan")
 
 
 @boundary(
@@ -225,9 +253,15 @@ class DocPool:
         slots: tuple[int, ...] = (2048, 512, 128, 32, 16),
         mesh=None,
         spool_dir: str | None = None,
+        serve_kernel: str = "fused",
     ):
         if len(classes) != len(slots):
             raise ValueError("classes and slots must have equal length")
+        if serve_kernel not in SERVE_KERNELS:
+            raise ValueError(
+                f"unknown serve kernel {serve_kernel!r}"
+                f" (expected one of {SERVE_KERNELS})"
+            )
         if list(classes) != sorted(set(classes)):
             raise ValueError(f"classes must be ascending/unique: {classes}")
         for c in classes:
@@ -260,7 +294,24 @@ class DocPool:
         self._owns_spool = spool_dir is None
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="crdt_serve_")
         os.makedirs(self.spool_dir, exist_ok=True)
+        self.serve_kernel = serve_kernel
+        #: staged op-lane dtypes (ops/packing.py): static per pool, so
+        #: every class shares one resolve executable and a quiet round
+        #: can never flip dtypes mid-run
+        self.op_dtypes = op_lane_dtypes(max(classes))
         self._macro_fns: dict[tuple, object] = {}
+        # fused-path executable caches — keyed so compiles are SHARED:
+        # the resolve depends only on (B,), the per-round apply on
+        # (C, Rt, B, nbits) but not on K or the macro depth, the tier
+        # slice/writeback on (cls, Rt).  The scan path recompiles its
+        # whole body per (cls, K, Rt, B, nbits) — that compile spread
+        # was ~55% of the serve/mixed/4096 wall time.
+        self._starts_fns: dict[tuple, object] = {}
+        self._resolve_fns: dict[tuple, object] = {}
+        self._apply_fns: dict[tuple, object] = {}
+        self._tier_takes: dict[tuple, object] = {}
+        self._tier_puts: dict[tuple, object] = {}
+        self._fused_tpu_fns: dict[tuple, object] = {}
         # counters (reported by the scheduler / bench): typed
         # obs/metrics.py Counters so a serve drain's registry carries
         # them in the artifact's metrics block (bind_metrics); the
@@ -529,6 +580,10 @@ class DocPool:
             return apply_range_batch(st, tokens, dints, nbits=nbits), None
 
         def fn(state, kind, pos, rlen, slot0):
+            # staged lanes arrive in the pool's narrow dtypes
+            # (ops/packing.py); widening here is a free cast and keeps
+            # the host->device transfer at the packed width
+            kind, pos, rlen, slot0 = widen_ops(kind, pos, rlen, slot0)
             if full:
                 out, _ = jax.lax.scan(
                     body, state, (kind, pos, rlen, slot0)
@@ -572,23 +627,393 @@ class DocPool:
 
         return jax.jit(fn, donate_argnums=(0,))
 
+    # ---- fused-path executables (ops/serve_fused.py) ----
+
+    @property
+    def fused_accel_form(self) -> bool:
+        """True when the fused dispatch runs as the accelerator form —
+        ONE jit wrapping the serve kernel (real TPU, or the Pallas
+        interpreter under CRDT_BENCH_SERVE_INTERPRET=1) — rather than
+        the host-orchestrated shared-executable form.  The scheduler's
+        exact-k_eff trim and :meth:`warm_fused` both key off this: the
+        accelerator form's jit IS keyed by K, and none of the host
+        executables are ever called there."""
+        return (
+            os.environ.get("CRDT_BENCH_SERVE_INTERPRET") == "1"
+            or jax.default_backend() == "tpu"
+        )
+
+    def _tier_closures(self, cls: int, Rt: int):
+        """Plain (take, put) tier slice/writeback closures — traceable,
+        so the accelerator-form fused jit can inline them; the host
+        path wraps them in AotJit via :meth:`_tier_fns`."""
+        b = self.buckets[cls]
+        R, n_sh = b.R, b.n_sh
+        shard = self._sharding
+        Rg, rt = R // n_sh, Rt // n_sh
+
+        def take(state):
+            def tk(x):
+                y = x.reshape((n_sh, Rg) + x.shape[1:])[:, :rt]
+                return y.reshape((Rt,) + x.shape[1:])
+
+            sub = PackedState(
+                doc=tk(state.doc), length=tk(state.length),
+                nvis=tk(state.nvis),
+            )
+            if shard is not None:
+                sub = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, shard),
+                    sub,
+                )
+            return sub
+
+        def put(state, sub):
+            def pt(x, s):
+                y = x.reshape((n_sh, Rg) + x.shape[1:])
+                z = y.at[:, :rt].set(
+                    s.reshape((n_sh, rt) + s.shape[1:])
+                )
+                return z.reshape(x.shape)
+
+            out = PackedState(
+                doc=pt(state.doc, sub.doc),
+                length=pt(state.length, sub.length),
+                nvis=pt(state.nvis, sub.nvis),
+            )
+            if shard is not None:
+                out = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, shard),
+                    out,
+                )
+            return out
+
+        return take, put
+
+    def _tier_fns(self, cls: int, Rt: int):
+        """(take, put) jitted tier slice/writeback for the fused HOST
+        path (the scan path fuses these into its one executable, the
+        accelerator form inlines the plain closures into its jit).
+        ``take`` must not donate (``put`` re-reads the full state)."""
+        key = (cls, Rt)
+        fresh = key not in self._tier_takes
+        if fresh:
+            take, put = self._tier_closures(cls, Rt)
+            self._tier_takes[key] = AotJit(take)
+            # only the full state donates: the sub-tier's buffers can
+            # never back the (R, C) output, so donating them just emits
+            # "donated buffers were not usable" warnings
+            self._tier_puts[key] = AotJit(put, donate_argnums=(0,))
+        return self._tier_takes[key], self._tier_puts[key], fresh
+
+    def _starts_fn(self, Rtp: int, Rt: int, B: int):
+        """(seed, delta) for the chained round-start totals, keyed
+        (padded-rows, true-rows, B) — NOT by the macro depth K: the
+        host advances the recurrence one round at a time
+        (``round_total_delta``), so k_eff-trimmed dispatches of any
+        depth share these two executables.  ``seed`` zero-pads the
+        tier's nvis out to the resolve-chunk row count."""
+        fresh = False
+        skey = ("seed", Rtp, Rt)
+        if skey not in self._starts_fns:
+            fresh = True
+            pad = Rtp - Rt
+
+            def seed(nvis):
+                if pad:
+                    return jnp.concatenate(
+                        [nvis, jnp.zeros((pad,), jnp.int32)]
+                    )
+                return jnp.asarray(nvis, jnp.int32)
+
+            self._starts_fns[skey] = AotJit(seed)
+        dkey = ("delta", Rtp, B)
+        if dkey not in self._starts_fns:
+            fresh = True
+
+            def delta(kind, pos, rlen, v0):
+                return round_total_delta(
+                    kind.astype(jnp.int32), pos.astype(jnp.int32),
+                    rlen.astype(jnp.int32), v0,
+                )
+
+            self._starts_fns[dkey] = AotJit(delta)
+        return self._starts_fns[skey], self._starts_fns[dkey], fresh
+
+    def _resolve_fn(self, B: int):
+        """THE shared resolve executable: one compile per op-batch
+        width serves every class, tier, and macro depth (the resolve is
+        row-local and capacity-independent; rows stream through it in
+        RESOLVE_CHUNK_ROWS chunks)."""
+        key = (B,)
+        fresh = key not in self._resolve_fns
+        if fresh:
+            self._resolve_fns[key] = AotJit(resolve_round_rows_grow)
+            self._resolve_fns[("trivial", B)] = AotJit(
+                partial(trivial_round_tokens, B=B)
+            )
+            self._resolve_fns[("narrow", B)] = (
+                AotJit(partial(resolve_round_rows_padded, out_B=B))
+                if B > NARROW_RESOLVE_OPS else None
+            )
+        return (
+            self._resolve_fns[key],
+            self._resolve_fns[("trivial", B)],
+            self._resolve_fns[("narrow", B)],
+            fresh,
+        )
+
+    def _apply_fn(self, cls: int, Rt: int, B: int, nbits: int):
+        """Per-round fused apply, keyed WITHOUT the macro depth K (the
+        host loops rounds), so k_eff-trimmed dispatches reuse the same
+        executable."""
+        key = (cls, Rt, B, nbits)
+        fresh = key not in self._apply_fns
+        if fresh:
+            self._apply_fns[key] = AotJit(
+                partial(serve_apply_round_xla, nbits=nbits),
+                donate_argnums=(0,),
+            )
+        return self._apply_fns[key], fresh
+
+    def _build_fused_tpu_fn(self, cls: int, Rt: int, nbits: int,
+                            interpret: bool):
+        """The accelerator form of the fused dispatch: ONE jit per
+        (cls, K, Rt, B) whose capacity-wide work is a single
+        pallas_call over grid (row_blocks, K) — document state rides
+        VMEM across the K rounds while the pipeline double-buffers
+        round m+1's op tensors during round m (ops/serve_fused.py
+        serve_macro_fused).  ``interpret`` runs the same kernel under
+        the Pallas interpreter (the CPU differential-test path,
+        CRDT_BENCH_SERVE_INTERPRET=1)."""
+        b = self.buckets[cls]
+        full = Rt == b.R
+        take = put = None
+        if not full:
+            # the PLAIN closures: this whole fn is traced by jax.jit,
+            # and an AotJit-compiled executable cannot be applied to
+            # tracers (code-review r8)
+            take, put = self._tier_closures(cls, Rt)
+
+        def fn(state, kind, pos, rlen, slot0):
+            kind, pos, rlen, slot0 = widen_ops(kind, pos, rlen, slot0)
+            sub = state if full else take(state)
+            starts = round_starts(kind, pos, rlen, sub.nvis)
+            tokens, dints = jax.vmap(resolve_round_rows_grow)(
+                kind, pos, rlen, slot0, starts
+            )
+            C = b.C
+            if interpret or (
+                jax.default_backend() == "tpu"
+                and serve_fused_fits(C, kind.shape[2])
+            ):
+                sub = serve_macro_fused(
+                    sub, tokens, dints, nbits=nbits, interpret=interpret
+                )
+            else:
+                sub = serve_macro_rounds_xla(sub, tokens, dints, nbits)
+            return sub if full else put(state, sub)
+
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def _fused_macro(self, cls: int, kind, pos, rlen, slot0,
+                     nbits: int) -> bool:
+        """Host-orchestrated fused dispatch (everything enqueued async;
+        no host syncs): round starts -> chunked shared resolve ->
+        per-round apply, with tier slice/writeback around it.  Returns
+        True when any executable compiled for the first time."""
+        b = self.buckets[cls]
+        K, Rt, B = kind.shape
+        interpret = os.environ.get("CRDT_BENCH_SERVE_INTERPRET") == "1"
+        if self.fused_accel_form:
+            key = (cls, K, Rt, B, nbits, interpret)
+            fresh = key not in self._fused_tpu_fns
+            if fresh:
+                self._fused_tpu_fns[key] = self._build_fused_tpu_fn(
+                    cls, Rt, nbits, interpret
+                )
+            args = [jnp.asarray(a) for a in (kind, pos, rlen, slot0)]
+            if self._op_sharding is not None:
+                args = [
+                    jax.device_put(a, self._op_sharding) for a in args
+                ]
+            b.state = self._fused_tpu_fns[key](b.state, *args)
+            return fresh
+        RC = RESOLVE_CHUNK_ROWS
+        Rtp = -(-Rt // RC) * RC
+        pad = Rtp - Rt
+        # the host can SEE which rounds/chunks carry no ops (the narrow
+        # staged arrays are right here): an all-PAD round is an exact
+        # no-op (skipped outright) and an all-PAD chunk's resolution is
+        # the trivial one-token list (built directly, no scan).  With
+        # k_eff trimmed exactly for the fused path, trailing drained
+        # lanes stop costing resolve time at all.
+        # per-(round, chunk) max op count: 0 = all-PAD (skip/trivial),
+        # <= NARROW_RESOLVE_OPS = the cheap narrow resolve (ops are
+        # front-packed per lane at staging, so a per-lane count is the
+        # filled prefix length)
+        chunk_ops = [
+            [
+                int(
+                    (kind[k, c : min(c + RC, Rt)] != PAD)
+                    .sum(axis=1).max(initial=0)
+                )
+                for c in range(0, Rtp, RC)
+            ]
+            for k in range(K)
+        ]
+        live_round = [any(chunk_ops[k]) for k in range(K)]
+        if pad:
+            z = lambda a, v: np.concatenate(
+                [a, np.full((K, pad, B), v, a.dtype)], axis=1
+            )
+            kind, pos, rlen, slot0 = (
+                z(kind, PAD), z(pos, 0), z(rlen, 0), z(slot0, 0)
+            )
+        args = [jnp.asarray(a) for a in (kind, pos, rlen, slot0)]
+        if self._op_sharding is not None:
+            args = [jax.device_put(a, self._op_sharding) for a in args]
+        kd, pd, ld, sd = args
+
+        full = Rt == b.R
+        fresh = False
+        if full:
+            sub = b.state
+        else:
+            take, _put, f = self._tier_fns(cls, Rt)
+            fresh |= f
+            sub = take(b.state)
+        seed_fn, delta_fn, f = self._starts_fn(Rtp, Rt, B)
+        fresh |= f
+        v0 = seed_fn(sub.nvis)
+        resolve, trivial, narrow, f = self._resolve_fn(B)
+        fresh |= f
+        apply_fn, f = self._apply_fn(cls, Rt, B, nbits)
+        fresh |= f
+        NB = NARROW_RESOLVE_OPS
+        for k in range(K):
+            if not live_round[k]:
+                continue  # no ops anywhere: byte-exact no-op round
+            parts = []
+            for j, c in enumerate(range(0, Rtp, RC)):
+                v0c = v0[c : c + RC]
+                n_ops = chunk_ops[k][j]
+                if n_ops == 0:
+                    parts.append(trivial(v0c))
+                elif narrow is not None and n_ops <= NB:
+                    parts.append(narrow(
+                        kd[k, c : c + RC, :NB], pd[k, c : c + RC, :NB],
+                        ld[k, c : c + RC, :NB], sd[k, c : c + RC, :NB],
+                        v0c,
+                    ))
+                else:
+                    parts.append(resolve(
+                        kd[k, c : c + RC], pd[k, c : c + RC],
+                        ld[k, c : c + RC], sd[k, c : c + RC], v0c,
+                    ))
+            # dead rounds advance nothing, so the recurrence only needs
+            # to cross LIVE rounds that still have a live successor
+            if any(live_round[k + 1 :]):
+                v0 = delta_fn(kd[k], pd[k], ld[k], v0)
+            if len(parts) == 1:
+                tokens, dints = parts[0]
+            else:
+                tokens = tuple(
+                    jnp.concatenate([p[0][i] for p in parts])
+                    for i in range(4)
+                )
+                dints = tuple(
+                    jnp.concatenate([p[1][i] for p in parts])
+                    for i in range(3)
+                )
+            if pad:
+                tokens = tuple(t[:Rt] for t in tokens)
+                dints = tuple(d[:Rt] for d in dints)
+            sub = apply_fn(sub, tokens, dints)
+        if full:
+            b.state = sub
+        else:
+            _take, put, _ = self._tier_fns(cls, Rt)
+            b.state = put(b.state, sub)
+        return fresh
+
+    def warm_fused(self, batch: int, nbits: int) -> None:
+        """Pre-compile the fused path's SHARED executables at
+        deployment time (fleet construction), before the drain clock
+        starts: the resolve / narrow-resolve / trivial-tokens builders
+        (keyed only by the op-batch width) and the round-totals
+        seed/delta pair for every tier the classes can compact to.
+        These are exactly the executables whose keys do not depend on
+        which shapes traffic happens to produce, so warming them is
+        deterministic; the per-(class, tier) applies stay lazy (their
+        tier usage is traffic-dependent) and keep the compile-round
+        tagging.  Idempotent — every warmed entry is a cache hit at
+        serve time.  No-op for the scan kernel (its executables are
+        monolithic per shape; nothing is shareable ahead of time)."""
+        if self.serve_kernel != "fused":
+            return
+        if self._sharding is not None:
+            # mesh pools: runtime inputs arrive mesh-sharded, so
+            # single-device warm compiles would never be hit (and would
+            # pin the AOT executables to the wrong shardings)
+            return
+        if self.fused_accel_form:
+            # the accelerator form never calls the host executables —
+            # warming them there is pure wasted compile (code-review r8)
+            return
+        del nbits  # applies stay lazy; reserved for future warm tiers
+        B = batch
+        RC = RESOLVE_CHUNK_ROWS
+        resolve, trivial, narrow, _ = self._resolve_fn(B)
+        zeros = [
+            jnp.zeros((RC, B), dtype=dt) for dt in self.op_dtypes
+        ]
+        v0c = jnp.zeros((RC,), jnp.int32)
+        resolve(*zeros, v0c)
+        trivial(v0c)
+        if narrow is not None:
+            nz = [z[:, : NARROW_RESOLVE_OPS] for z in zeros]
+            narrow(*nz, v0c)
+        warmed_delta: set[int] = set()
+        for cls in self.classes:
+            for Rt in self.tiers(cls):
+                Rtp = -(-Rt // RC) * RC
+                seed_fn, delta_fn, _ = self._starts_fn(Rtp, Rt, B)
+                seed_fn(jnp.zeros((Rt,), jnp.int32))
+                if Rtp not in warmed_delta:
+                    warmed_delta.add(Rtp)
+                    delta_fn(
+                        *(jnp.zeros((Rtp, B), dtype=dt)
+                          for dt in self.op_dtypes[:3]),
+                        jnp.zeros((Rtp,), jnp.int32),
+                    )
+
     @boundary(
-        dtypes=(None, None, "int32", "int32", "int32", "int32"),
+        # op lanes arrive in the pool's packed dtypes (op_dtypes), so
+        # the historical all-int32 dtype contract is gone on purpose;
+        # the shape contract still pins the staged (K, Rt, B) layout
+        dtypes=(),
         shapes=(None, None, "K R B", "K R B", "K R B", "K R B"),
     )
     def macro_step(self, cls: int, kind: np.ndarray, pos: np.ndarray,
                    rlen: np.ndarray, slot0: np.ndarray, nbits: int) -> bool:
         """ONE async dispatch applying K staged rounds to class ``cls``:
-        op tensors int32[K, Rt, B] (Rt a row tier from :meth:`tiers`,
-        row r covering local rows ``0..Rt/n_sh`` of every shard), scanned
-        on device with donated state.  No host sync — callers fence via
-        :meth:`block` or a boundary pull.  Returns True when this
-        (shape, nbits) compiled for the first time (the scheduler tags
-        the round as compile-skewed)."""
+        op tensors [K, Rt, B] in the pool's staged lane dtypes
+        (:attr:`op_dtypes`; Rt a row tier from :meth:`tiers`, row r
+        covering local rows ``0..Rt/n_sh`` of every shard), applied on
+        device with donated state through the selected serve kernel
+        (:attr:`serve_kernel`).  No host sync — callers fence via
+        :meth:`block` or a boundary pull.  Returns True when any
+        executable for this shape compiled for the first time (the
+        scheduler tags the round as compile-skewed)."""
         b = self.buckets[cls]
         K, Rt, B = kind.shape
         if Rt % b.n_sh or not b.n_sh <= Rt <= b.R:
             raise ValueError(f"tier {Rt} incompatible with bucket {b.R}")
+        if self.serve_kernel == "fused":
+            fresh = self._fused_macro(cls, kind, pos, rlen, slot0, nbits)
+            b.steps += K
+            return fresh
         key = (cls, K, Rt, B, nbits)
         fresh = key not in self._macro_fns
         if fresh:

@@ -113,8 +113,8 @@ class DocStream:
     and ``lossy`` marks docs excluded from byte-verification."""
 
     doc_id: int
-    kind: np.ndarray  # int32[N] range ops (unpadded)
-    pos: np.ndarray
+    kind: np.ndarray  # [N] range ops (unpadded), in the pool's packed
+    pos: np.ndarray   # lane dtypes (ops/packing.py op_lane_dtypes)
     rlen: np.ndarray
     slot0: np.ndarray
     ins_cum: np.ndarray  # int32[N] inclusive cumulative INSERT chars
@@ -181,7 +181,14 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
     the docs with the pool, and return the per-doc op queues.  Sessions
     sharing an identical trace object (the workload caches trace
     prefixes) share the tensorized arrays — the queues only differ in
-    cursor state."""
+    cursor state.
+
+    Stream arrays are stored in the pool's packed lane dtypes
+    (``ops/packing.py``): packing here — once per distinct trace, with
+    range checking that raises rather than wraps — means staging copies
+    narrow-to-narrow and a macro round uploads half the bytes."""
+    from ..ops.packing import pack_ops
+
     streams: dict[int, DocStream] = {}
     cache: dict[int, tuple] = {}  # id(trace) -> (arrays, rt)
     for s in sessions:
@@ -192,6 +199,15 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
             arrays = split_insert_runs(
                 rt.kind[:n], rt.pos[:n], rt.rlen[:n], rt.slot0[:n],
                 batch_chars,
+            )
+            kind_a, pos_a, rlen_a, slot_a = arrays
+            # slot0 is only ever read for INSERT ops; the tensorizer's
+            # -1 sentinel on deletes would (rightly) fail the unsigned
+            # lane's range check, so normalize it away first
+            slot_a = np.where(kind_a == INSERT, slot_a, 0)
+            arrays = pack_ops(
+                kind_a, pos_a, rlen_a, slot_a,
+                max_class=max(pool.classes),
             )
             ins_cum = np.cumsum(
                 np.where(arrays[0] == INSERT, arrays[2], 0)
@@ -393,7 +409,8 @@ class FleetScheduler:
                  snapshot_every: int = 0, snapshot_keep: int = 2,
                  degrade_after: int = 3, degrade_window: int = 8,
                  degrade_rounds: int = 4,
-                 start_round: int = 0, profiler=None, telemetry=None):
+                 start_round: int = 0, profiler=None, telemetry=None,
+                 warm_start: bool = False):
         if overflow_policy not in ("defer", "shed"):
             raise ValueError(f"unknown overflow policy {overflow_policy!r}")
         self.pool = pool
@@ -402,6 +419,10 @@ class FleetScheduler:
         self.macro_k = max(1, macro_k)
         self.batch_chars = batch_chars
         self.nbits = max(1, int(batch_chars).bit_length())
+        if warm_start:
+            # deployment-time compile of the fused path's shared
+            # executables — cold-start spread the drain never pays
+            pool.warm_fused(self.batch, self.nbits)
         self.round = start_round
         self.queue_cap = max(0, queue_cap)
         self.overflow_policy = overflow_policy
@@ -682,13 +703,18 @@ class FleetScheduler:
                 vrec.cls = vrec.row = None
                 pool.evictions += 1
             # ---- occupancy-aware compaction: choose the row tier ----
-            # pow2 K depths bound the compile-shape count; the macro_k
-            # clamp keeps a non-pow2 --serve-macro from dispatching
-            # guaranteed-all-PAD tail slices.
-            k_eff = min(
-                _pow2ceil(max(len(l.takes) for l in lanes)),
-                self._k_round,
-            )
+            # scan kernel AND the fused accelerator form: pow2 K depths
+            # bound the compile-shape count (each K is a whole new
+            # executable there); fused HOST form: K never keys an
+            # executable (the host loops rounds), so the depth trims
+            # EXACTLY to the deepest lane and trailing all-PAD slices
+            # are never staged at all.
+            deepest = max(len(l.takes) for l in lanes)
+            if (self.pool.serve_kernel == "fused"
+                    and not self.pool.fused_accel_form):
+                k_eff = min(deepest, self._k_round)
+            else:
+                k_eff = min(_pow2ceil(deepest), self._k_round)
             resident_locals = [
                 (lane, divmod(lane.row, b.Rg)) for lane in lanes
                 if lane.row >= 0
@@ -795,14 +821,19 @@ class FleetScheduler:
     def _stage(self, plan: _Plan) -> dict[int, tuple]:
         tensors: dict[int, tuple] = {}
         B = self.batch
+        dt_kind, dt_pos, dt_rlen, dt_slot = self.pool.op_dtypes
         for cls, lanes in plan.lanes.items():
             K, Rt = plan.k_eff[cls], plan.rt[cls]
             b = self.pool.buckets[cls]
             rt = Rt // b.n_sh
-            kind = np.full((K, Rt, B), PAD, np.int32)
-            pos = np.zeros((K, Rt, B), np.int32)
-            rlen = np.zeros((K, Rt, B), np.int32)
-            slot0 = np.full((K, Rt, B), -1, np.int32)
+            # staged in the pool's packed lane dtypes: stream arrays
+            # are already packed (prepare_streams), so every copy here
+            # is narrow-to-narrow — no silent wrap is possible.  PAD
+            # lanes carry slot0 = 0 (never read; kind == PAD gates it).
+            kind = np.full((K, Rt, B), PAD, dt_kind)
+            pos = np.zeros((K, Rt, B), dt_pos)
+            rlen = np.zeros((K, Rt, B), dt_rlen)
+            slot0 = np.zeros((K, Rt, B), dt_slot)
             for lane in lanes:
                 st = lane.stream
                 s, l = divmod(lane.row, b.Rg)

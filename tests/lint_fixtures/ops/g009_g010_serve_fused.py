@@ -1,0 +1,46 @@
+"""Seeded G009/G010 violations in a minimized copy of the fused serve
+kernel's launch (ops/serve_fused.py serve_macro_fused): the real thing
+runs grid (row_blocks, K) with the doc block revisited along K and the
+per-round op tensors streamed in — which is exactly the geometry where
+a stale index map or an unpadded token width would compile into silent
+cross-round corruption.  Seeded here: a doc spec whose index map still
+has the pre-K single-axis arity, a per-round spec whose token width is
+not LANE-padded, and a launch invoked with one round tensor missing."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+Rt = 8
+nt = 2
+K = 4
+T = 130  # deliberately the UNPADDED 2B+2 token width
+
+
+def _round_kernel(doc_ref, tok_ref, doc_out):
+    doc_out[:] = doc_ref[:] + tok_ref[0, :, :1]
+
+
+def serve_macro_minimized(doc, tokens):
+    doc_spec = pl.BlockSpec((Rt, nt, LANE), lambda i: (i, 0, 0))  # expect: G009
+    tok_spec = pl.BlockSpec((1, Rt, T), lambda i, k: (k, i, 0))  # expect: G010
+    return pl.pallas_call(
+        _round_kernel,
+        grid=(2, K),
+        in_specs=[doc_spec, tok_spec],
+        out_specs=pl.BlockSpec((Rt, nt, LANE), lambda i, k: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, nt, LANE), jnp.int32),
+    )(doc, tokens)
+
+
+def serve_macro_missing_round_input(doc, tokens, dints):
+    spec3 = pl.BlockSpec((Rt, nt, LANE), lambda i, k: (i, 0, 0))
+    rnd = pl.BlockSpec((1, Rt, LANE), lambda i, k: (k, i, 0))
+    return pl.pallas_call(  # expect: G009
+        _round_kernel,
+        grid=(2, K),
+        in_specs=[spec3, rnd, rnd],
+        out_specs=spec3,
+        out_shape=jax.ShapeDtypeStruct((16, nt, LANE), jnp.int32),
+    )(doc, tokens, dints)

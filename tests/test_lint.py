@@ -74,6 +74,21 @@ def test_fixture_flagged_exactly(path: Path):
     )
 
 
+def test_serve_fused_kernel_fixture_covers_both_pallas_rules():
+    """The fused-serve-kernel fixture (a minimized copy of
+    ops/serve_fused.py serve_macro_fused's launch geometry) must seed
+    BOTH Pallas rules — a stale pre-K index-map arity and a missing
+    round input under G009, an unpadded 2B+2 token width under G010 —
+    at exact (rule, line) positions.  Guards the fixture against
+    decaying into a file that asserts nothing."""
+    path = FIXTURES / "ops" / "g009_g010_serve_fused.py"
+    findings = run_lint([str(path)])
+    got = {(f.rule, f.line) for f in findings}
+    assert got == expected_markers(path)
+    assert {f.rule for f in findings} == {"G009", "G010"}
+    assert sum(f.rule == "G009" for f in findings) == 2
+
+
 def test_xmod_g008_corpus_flagged_exactly():
     """The cross-module drift corpus lints as a directory: every
     marker across its files is flagged (path, rule, line)-exactly and

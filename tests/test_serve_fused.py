@@ -1,0 +1,319 @@
+"""The fused serve step (ops/serve_fused.py): byte parity and packing.
+
+Every component of the fused path must be byte-identical to the scan
+path it replaces — the resolve restructurings (independent per-round
+resolves off the scalar totals recurrence, the growing token list, the
+narrow front-packed slice), the host-tuned apply, the trivial all-PAD
+tokens, and the single-pallas_call macro kernel (run here under the
+Pallas interpreter).  The narrow-dtype lane packing must be lossless
+in-range and LOUD out of range.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.ops import serve_fused as SF
+from crdt_benches_tpu.ops.apply2 import PackedState
+from crdt_benches_tpu.ops.apply_range import apply_range_batch
+from crdt_benches_tpu.ops.packing import (
+    OpRangeError,
+    op_lane_dtypes,
+    pack_ops,
+    widen_ops,
+)
+from crdt_benches_tpu.ops.resolve_range_scan import resolve_ranges_rows
+from crdt_benches_tpu.traces.tensorize import DELETE, INSERT, PAD
+
+
+def _gen_ops(rng, K, R, B, nvis0, pad_tail=0):
+    """Valid random per-row op streams (inserts/deletes in range) with
+    PAD tails; returns int32 (K, R, B) arrays."""
+    kind = np.full((K, R, B), PAD, np.int32)
+    pos = np.zeros((K, R, B), np.int32)
+    rlen = np.zeros((K, R, B), np.int32)
+    slot0 = np.zeros((K, R, B), np.int32)
+    slot_next = nvis0.astype(np.int64).copy()
+    total = nvis0.astype(np.int64).copy()
+    for r in range(R):
+        for k in range(K):
+            nops = int(rng.integers(0, B + 1 - pad_tail))
+            for b in range(nops):
+                if total[r] > 2 and rng.random() < 0.4:
+                    kk = DELETE
+                    p = int(rng.integers(0, total[r]))
+                    L = int(rng.integers(1, min(6, total[r] - p) + 1))
+                else:
+                    kk = INSERT
+                    p = int(rng.integers(0, total[r] + 1))
+                    L = int(rng.integers(1, 6))
+                kind[k, r, b] = kk
+                pos[k, r, b] = p
+                rlen[k, r, b] = L
+                if kk == INSERT:
+                    slot0[k, r, b] = slot_next[r]
+                    slot_next[r] += L
+                    total[r] += L
+                else:
+                    total[r] -= L
+    return kind, pos, rlen, slot0
+
+
+def _mkstate(nvis0, C):
+    R = len(nvis0)
+    doc = np.full((R, C), 2, np.int32)
+    for r in range(R):
+        idx = np.arange(nvis0[r])
+        doc[r, : nvis0[r]] = ((idx + 2) << 1) | 1
+    return PackedState(
+        doc=jnp.asarray(doc),
+        length=jnp.asarray(nvis0),
+        nvis=jnp.asarray(nvis0),
+    )
+
+
+def _scan_reference(state, kind, pos, rlen, slot0, nbits):
+    """The scan kernel's body, round by round — THE byte oracle every
+    fused component is held to."""
+    outs = []
+    for k in range(kind.shape[0]):
+        outs.append(state)
+        tokens, dints, _ = resolve_ranges_rows(
+            kind[k], pos[k], rlen[k], slot0[k], state.nvis
+        )
+        state = apply_range_batch(state, tokens, dints, nbits=nbits)
+    return state, outs
+
+
+@pytest.fixture(scope="module")
+def case():
+    rng = np.random.default_rng(7)
+    K, R, B, C = 4, 6, 12, 256
+    nvis0 = rng.integers(3, 24, R).astype(np.int32)
+    ops = _gen_ops(rng, K, R, B, nvis0)
+    return K, R, B, C, nvis0, ops
+
+
+def _eq_state(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(a, b)
+    )
+
+
+def test_round_starts_match_interleaved_nvis(case):
+    K, R, B, C, nvis0, (kind, pos, rlen, slot0) = case
+    _, states = _scan_reference(_mkstate(nvis0, C), kind, pos, rlen,
+                                slot0, nbits=6)
+    want = np.stack([np.asarray(s.nvis) for s in states])
+    got = np.asarray(SF.round_starts(kind, pos, rlen, nvis0))
+    assert np.array_equal(got, want)
+    # the chained per-round delta walks the same sequence
+    v0 = jnp.asarray(nvis0)
+    for k in range(K):
+        assert np.array_equal(np.asarray(v0), want[k])
+        v0 = SF.round_total_delta(kind[k], pos[k], rlen[k], v0)
+
+
+def test_growing_resolve_byte_identical(case):
+    K, R, B, C, nvis0, (kind, pos, rlen, slot0) = case
+    t_ref, d_ref, _ = resolve_ranges_rows(
+        kind[0], pos[0], rlen[0], slot0[0], nvis0
+    )
+    t, d = SF.resolve_round_rows_grow(
+        kind[0], pos[0], rlen[0], slot0[0], nvis0
+    )
+    assert all(np.array_equal(a, b) for a, b in zip(t, t_ref))
+    assert all(np.array_equal(a, b) for a, b in zip(d, d_ref))
+
+
+def test_narrow_resolve_pads_to_full_width(case):
+    """A front-packed <=16-op slice resolved narrow + padded equals the
+    full-width resolve of the same slice with PAD tails."""
+    _K, R, _B, C, nvis0, _ = case
+    B = 24  # wider than the narrow width so the pad tail is real
+    rng = np.random.default_rng(11)
+    kind, pos, rlen, slot0 = (
+        a[0] for a in _gen_ops(rng, 1, R, B, nvis0,
+                               pad_tail=B - SF.NARROW_RESOLVE_OPS)
+    )
+    NB = SF.NARROW_RESOLVE_OPS
+    assert (kind[:, NB:] == PAD).all()
+    t_ref, d_ref, _ = resolve_ranges_rows(kind, pos, rlen, slot0, nvis0)
+    t, d = SF.resolve_round_rows_padded(
+        kind[:, :NB], pos[:, :NB], rlen[:, :NB], slot0[:, :NB],
+        nvis0, out_B=B,
+    )
+    assert all(np.array_equal(a, b) for a, b in zip(t, t_ref))
+    assert all(np.array_equal(a, b) for a, b in zip(d, d_ref))
+
+
+def test_trivial_tokens_match_all_pad_resolve(case):
+    K, R, B, C, nvis0, _ = case
+    z = np.zeros((R, B), np.int32)
+    pad = np.full((R, B), PAD, np.int32)
+    t_ref, d_ref, _ = resolve_ranges_rows(pad, z, z, z, nvis0)
+    t, d = SF.trivial_round_tokens(jnp.asarray(nvis0), B)
+    assert all(np.array_equal(a, b) for a, b in zip(t, t_ref))
+    assert all(np.array_equal(a, b) for a, b in zip(d, d_ref))
+
+
+def test_apply_round_xla_byte_identical(case):
+    K, R, B, C, nvis0, (kind, pos, rlen, slot0) = case
+    state = _mkstate(nvis0, C)
+    tokens, dints, _ = resolve_ranges_rows(
+        kind[0], pos[0], rlen[0], slot0[0], state.nvis
+    )
+    want = apply_range_batch(state, tokens, dints, nbits=6)
+    got = SF.serve_apply_round_xla(
+        _mkstate(nvis0, C), tokens, dints, nbits=6
+    )
+    assert _eq_state(want, got)
+
+
+def test_macro_rounds_xla_byte_identical(case):
+    K, R, B, C, nvis0, (kind, pos, rlen, slot0) = case
+    want, _ = _scan_reference(_mkstate(nvis0, C), kind, pos, rlen,
+                              slot0, nbits=6)
+    starts = SF.round_starts(kind, pos, rlen, nvis0)
+    parts = [
+        SF.resolve_round_rows_grow(
+            kind[k], pos[k], rlen[k], slot0[k], starts[k]
+        )
+        for k in range(K)
+    ]
+    tokens = tuple(
+        jnp.stack([p[0][i] for p in parts]) for i in range(4)
+    )
+    dints = tuple(
+        jnp.stack([p[1][i] for p in parts]) for i in range(3)
+    )
+    got = SF.serve_macro_rounds_xla(_mkstate(nvis0, C), tokens, dints, 6)
+    assert _eq_state(want, got)
+
+    # the single-pallas_call serve kernel, under the interpreter, is
+    # byte-identical too (grid (row_blocks, K) with a VMEM-carried doc
+    # block — the TPU form of the same dispatch)
+    got_k = SF.serve_macro_fused(
+        _mkstate(nvis0, C), tokens, dints, nbits=6, replica_tile=3,
+        interpret=True,
+    )
+    assert _eq_state(want, got_k)
+
+
+def test_pool_fused_tpu_form_interpret(tmp_path, monkeypatch):
+    """End to end through DocPool with CRDT_BENCH_SERVE_INTERPRET=1:
+    the accelerator-form fused dispatch (one jit wrapping the serve
+    kernel) drains a small fleet byte-identical to the oracle —
+    INCLUDING row-tier compaction (3 docs on a 16-row bucket pick the
+    Rt=4 sub-tier, so the in-jit tier slice/writeback is traced; a
+    compiled-executable take/put here is the code-review-r8 crash)."""
+    from crdt_benches_tpu.oracle.text_oracle import replay_trace
+    from crdt_benches_tpu.serve.pool import DocPool
+    from crdt_benches_tpu.serve.scheduler import (
+        FleetScheduler,
+        prepare_streams,
+    )
+    from crdt_benches_tpu.serve.workload import Session
+    from crdt_benches_tpu.traces.synth import synth_trace
+
+    monkeypatch.setenv("CRDT_BENCH_SERVE_INTERPRET", "1")
+    traces = [synth_trace(seed=300 + i, n_ops=60) for i in range(3)]
+    sessions = [
+        Session(doc_id=i, band="synth-small", source="synth", trace=t)
+        for i, t in enumerate(traces)
+    ]
+    pool = DocPool(classes=(128,), slots=(16,), spool_dir=str(tmp_path))
+    assert pool.fused_accel_form
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32)
+    sched.run()
+    assert sched.done
+    # the sub-tier really was exercised (the fused jit cache holds a
+    # key whose Rt is below the bucket's 16 rows)
+    assert any(k[2] < 16 for k in pool._fused_tpu_fns)
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+
+
+# ---------------------------------------------------------------------
+# narrow-dtype lane packing (ops/packing.py)
+# ---------------------------------------------------------------------
+
+
+def test_op_lane_dtypes_static_rule():
+    assert [str(d) for d in op_lane_dtypes(49152)] == [
+        "int8", "uint16", "uint16", "uint16",
+    ]
+    assert [str(d) for d in op_lane_dtypes(1 << 20)] == [
+        "int8", "int32", "int32", "int32",
+    ]
+
+
+def test_pack_widen_round_trip_property():
+    """Property: for ALL in-range values, widen(pack(x)) == x exactly
+    (both dtype regimes), across the full lane ranges including the
+    boundary values."""
+    rng = np.random.default_rng(3)
+    for max_class in (49152, 1 << 20):
+        dts = op_lane_dtypes(max_class)
+        his = [np.iinfo(d).max for d in dts]
+        kind = rng.integers(0, 3, 4096).astype(np.int32)
+        pos = rng.integers(0, min(his[1], 1 << 22) + 1, 4096).astype(
+            np.int32
+        )
+        rlen = rng.integers(0, min(his[2], 1 << 22) + 1, 4096).astype(
+            np.int32
+        )
+        slot0 = rng.integers(0, min(his[3], 1 << 22) + 1, 4096).astype(
+            np.int32
+        )
+        # pin the exact lane boundary values into the sample
+        pos[0], rlen[0], slot0[0] = (
+            min(his[1], 1 << 22), min(his[2], 1 << 22),
+            min(his[3], 1 << 22),
+        )
+        packed = pack_ops(kind, pos, rlen, slot0, max_class=max_class)
+        assert [p.dtype for p in packed] == list(dts)
+        wide = widen_ops(*packed)
+        for w, orig in zip(wide, (kind, pos, rlen, slot0)):
+            assert w.dtype == np.int32
+            assert np.array_equal(w, orig)
+
+
+def test_pack_raises_not_wraps_out_of_range():
+    """An id-space bump past the narrow bound must raise LOUDLY, never
+    truncate: 65536 wraps to 0 in uint16 — exactly the silent slot-id
+    corruption the checked pack exists to prevent."""
+    kind = np.zeros(4, np.int32)
+    ok = np.zeros(4, np.int32)
+    big = np.array([0, 1, 65536, 2], np.int32)
+    for lane in range(1, 4):
+        args = [kind, ok, ok, ok]
+        args[lane] = big
+        with pytest.raises(OpRangeError, match="do not fit uint16"):
+            pack_ops(*args, max_class=49152)
+    with pytest.raises(OpRangeError):
+        pack_ops(np.array([999], np.int32), ok[:1], ok[:1], ok[:1],
+                 max_class=49152)
+    # the same values pack fine once the pool's id space forces int32
+    out = pack_ops(kind, big, big, big, max_class=1 << 20)
+    assert all(o.dtype == np.int32 for o in out[1:])
+
+
+def test_aot_jit_applies_options_and_falls_back():
+    calls = {}
+
+    def f(x):
+        return x + 1
+
+    g = SF.AotJit(f)
+    x = jnp.arange(4, dtype=jnp.int32)
+    assert np.array_equal(np.asarray(g(x)), np.arange(1, 5))
+    assert g._compiled is not None
+    # bogus options fall back to the plain jit rather than failing
+    h = SF.AotJit(f, options={"definitely_not_an_xla_flag": True})
+    assert np.array_equal(np.asarray(h(x)), np.arange(1, 5))
+    del calls

@@ -229,6 +229,90 @@ def test_stats_pad_fraction_and_coalesce_ratio(tmp_path):
     assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
 
 
+@pytest.mark.parametrize("macro_k", [1, 8])
+def test_fused_scan_byte_parity_all_classes(tmp_path, macro_k):
+    """THE kernel-selection parity gate: the same fleet drained through
+    the fused serve step and the legacy scan body is byte-identical for
+    EVERY doc, across both hosted capacity classes, at K=1 and K=8 —
+    and both match the oracle."""
+    sessions = _mixed_sessions(tmp_path)
+
+    def run(kernel, sub):
+        pool = DocPool(classes=(256, 1024), slots=(6, 3),
+                       spool_dir=str(tmp_path / sub),
+                       serve_kernel=kernel)
+        stats = _drain(sessions, pool, macro_k=macro_k)
+        out = {s.doc_id: pool.decode(s.doc_id) for s in sessions}
+        hosted = {pool.docs[s.doc_id].cls for s in sessions}
+        return out, stats, hosted
+
+    fused, sf, hosted = run("fused", f"fused{macro_k}")
+    scan, ss, _ = run("scan", f"scan{macro_k}")
+    assert fused == scan
+    assert len([c for c in hosted if c]) >= 2
+    for s in sessions:
+        assert fused[s.doc_id] == replay_trace(s.trace), (
+            f"doc {s.doc_id} ({s.band}) diverged from oracle"
+        )
+    # identical streams -> identical op accounting on both kernels
+    assert sf.ops == ss.ops and sf.unit_ops == ss.unit_ops
+
+
+def test_fused_scan_parity_row_tier_slicing(tmp_path):
+    """Fused-vs-scan parity where compaction picks a SUB-tier
+    (Rt < R): 64 rows, 12 docs -> the Rt=16 tier, so the fused path's
+    tier take/put executables and the scan path's in-jit slice are both
+    exercised — and must agree byte for byte."""
+    sessions = build_fleet(
+        12, mix={"synth-small": 1.0}, seed=9, arrival_span=2,
+        bands=TINY_BANDS,
+    )
+
+    def run(kernel, sub):
+        pool = DocPool(classes=(128,), slots=(64,),
+                       spool_dir=str(tmp_path / sub),
+                       serve_kernel=kernel)
+        stats = _drain(sessions, pool, macro_k=4)
+        assert stats.pad_fraction < 1.0
+        return {s.doc_id: pool.decode(s.doc_id) for s in sessions}
+
+    fused = run("fused", "fused")
+    scan = run("scan", "scan")
+    assert fused == scan
+    for s in sessions:
+        assert fused[s.doc_id] == replay_trace(s.trace)
+
+
+@pytest.mark.parametrize("kernel", ["fused", "scan"])
+def test_evict_restore_mid_macro_round_both_kernels(tmp_path, kernel):
+    """Mid-macro-round evict/restore churn under BOTH kernels: the
+    forced-sync spool round-trip must land on identical bytes whichever
+    serve step is selected."""
+    from crdt_benches_tpu.traces.synth import synth_trace
+
+    traces = [synth_trace(seed=400 + i, n_ops=100) for i in range(3)]
+    sessions = [
+        Session(doc_id=i, band="synth-small", source="synth", trace=t)
+        for i, t in enumerate(traces)
+    ]
+    pool = DocPool(classes=(128,), slots=(2,),
+                   spool_dir=str(tmp_path / kernel),
+                   serve_kernel=kernel)
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32)
+    sched.run(max_rounds=1)
+    victim = next(
+        d for d, _row in pool.residents(128) if streams[d].remaining > 0
+    )
+    pool.evict(victim)  # forced sync against the in-flight dispatch
+    pool.admit(victim, need=pool.docs[victim].length)
+    sched.run()
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+    assert pool.restores >= 1
+
+
 def test_steady_quantiles_excludes_flagged():
     lats = [5.0, 0.1, 0.2, 0.3, 9.0]
     flags = [True, False, False, False, True]

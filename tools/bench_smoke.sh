@@ -50,6 +50,33 @@ case "$family" in
         --serve-slots 16,6,2,2,2 \
         --serve-arrival-span 2 --serve-verify-sample 6 \
         --serve-save-name serve_smoke
+    # Scan-kernel leg: the same fleet through the legacy per-shape
+    # lax.scan serve step (--serve-kernel scan; the default leg above
+    # runs the fused ops/serve_fused.py path).  Both must byte-verify
+    # green, and the fused leg is gated at <=15% throughput vs scan —
+    # on host CPU the gate is correctness + no-pathology, not speedup
+    # (the 24-doc drain is compile-dominated and jitters ~+-10% run to
+    # run, so a tighter gate is pure flake)
+    # (the 1.5x fused headline is measured on the full
+    # serve/mixed/4096 fleet where compile spread and steady rate
+    # dominate; a 24-doc smoke is all cold start).
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-kernel scan \
+        --serve-save-name serve_smoke_scan
+    # (p99 is relaxed for THIS cross-kernel comparison only: the two
+    # kernels shape rounds differently — the fused path trims k_eff
+    # exactly, so its rounds are fewer and individually longer at toy
+    # scale — and on the full fleet fused p99 is strictly better:
+    # 1.64s vs 1.95s, bench_results/serve_mixed_4096*.json)
+    python tools/bench_compare.py \
+      bench_results/serve_smoke.json bench_results/serve_smoke_scan.json \
+      --max-throughput-regress 15 --max-p99-regress 150
     # Sanitized leg: the same drain under CRDT_BENCH_SANITIZE_SYNCS=1 —
     # any host sync outside a declared `# graftlint: fence` raises at
     # its callsite and fails this smoke (the dynamic proof of the G002
@@ -72,9 +99,9 @@ case "$family" in
     # armed.  Two gates: the emitted Chrome trace must validate against
     # the schema (spans nested, fence instants inside their owning
     # span), and armed-tracing THROUGHPUT overhead vs the plain leg
-    # must stay within 5% (bench_compare with a tightened threshold;
-    # the p99 of a tiny smoke drain is too noisy to gate that hard —
-    # the 2% headline overhead claim is measured on the full
+    # must stay within 15% (the compile-dominated 24-doc drain jitters
+    # ~+-10% run to run — measured PR 8 — so a tighter gate is pure
+    # flake; the 2% headline overhead claim is measured on the full
     # serve/mixed/4096 fleet where run noise is smaller).
     timeout -k 10 300 env JAX_PLATFORMS=cpu \
       python -m crdt_benches_tpu.bench.runner --family serve \
@@ -88,11 +115,11 @@ case "$family" in
     python -m crdt_benches_tpu.obs.trace bench_results/serve_smoke_trace.json
     python tools/bench_compare.py \
       bench_results/serve_smoke_traced.json bench_results/serve_smoke.json \
-      --max-throughput-regress 5
+      --max-throughput-regress 15
     # Telemetry leg: the same drain with the obs/ v2 continuous
     # telemetry armed — live status server (ephemeral port) + windowed
     # time-series recorder.  Armed-telemetry throughput overhead vs the
-    # plain leg is gated at the same 5% the traced leg uses (the 2%
+    # plain leg is gated at the same 15% the traced leg uses (the 2%
     # headline claim is measured on the full serve/mixed/4096 fleet,
     # bench_results/serve_mixed_4096_telemetry.json, where run noise is
     # smaller).
@@ -108,7 +135,7 @@ case "$family" in
         --serve-save-name serve_smoke_telemetry
     exec python tools/bench_compare.py \
       bench_results/serve_smoke_telemetry.json bench_results/serve_smoke.json \
-      --max-throughput-regress 5
+      --max-throughput-regress 15
     ;;
   serve-faults)
     # Chaos smoke under the soak detectors: the pinned late-round stall
