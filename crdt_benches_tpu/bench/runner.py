@@ -658,6 +658,70 @@ def run_serve(args) -> int:
         run_serve_soak,
     )
 
+    if args.serve_writers > 1:
+        # replicated family: serve/repl/<mix>/<fleet>x<writers>
+        # (serve/replicate/bench.py).  Exit gate is the verification
+        # TIER: full-fleet byte convergence against the oracle AND the
+        # RA-linearizability axioms over sampled broadcast histories —
+        # plus the chaos gate when a fault plan is armed.
+        from ..serve.replicate.bench import run_serve_repl_bench
+
+        # unsupported combinations are REJECTED, not silently dropped —
+        # a user who asked for a mesh or a bounded queue must not get a
+        # run that quietly did neither (delivery pacing belongs to the
+        # broadcast bus in replicated mode; mesh/telemetry/profiling of
+        # the replicated family are future work, see ROADMAP)
+        unsupported = [
+            ("--serve-soak", args.serve_soak is not None),
+            ("--serve-mesh", args.serve_mesh > 1),
+            ("--serve-queue-cap", args.serve_queue_cap > 0),
+            ("--serve-status", args.serve_status is not None),
+            ("--serve-timeseries", args.serve_timeseries is not None),
+            ("--serve-trace", args.serve_trace is not None),
+            ("--serve-profile", args.serve_profile > 0),
+        ]
+        bad = [flag for flag, hit in unsupported if hit]
+        if bad:
+            print(
+                f"{', '.join(bad)} not supported with --serve-writers "
+                "(the replicated family verifies the FULL fleet; "
+                "delivery pacing is the broadcast bus's)",
+                file=sys.stderr,
+            )
+            return 2
+        r, info = run_serve_repl_bench(
+            mix=args.serve_mix,
+            n_docs=args.serve_docs,
+            writers=args.serve_writers,
+            batch=args.serve_batch,
+            classes=args.serve_classes,
+            slots=args.serve_slots,
+            seed=args.serve_seed,
+            arrival_span=args.serve_arrival_span,
+            macro_k=args.serve_macro,
+            batch_chars=args.serve_batch_chars,
+            serve_kernel=args.serve_kernel,
+            turn_ops=args.serve_turn_ops,
+            journal_dir=args.serve_journal,
+            snapshot_every=args.serve_snapshot_every,
+            faults=args.serve_faults,
+            save_name=args.serve_save_name,
+            log=lambda m: print(m, file=sys.stderr),
+        )
+        rb = r.extra["replication"]
+        conv = r.extra["convergence"]
+        print(
+            f"{r.bench_id}: {r.extra['patches_per_sec']:,.0f} "
+            f"replica-patches/s, merge "
+            f"{r.extra['merge_unit_ops_per_sec']:,.0f} unit-ops/s, "
+            f"broadcast {rb['broadcast_bytes'] / 1024:.1f} KiB, "
+            f"divergence max {rb['divergence_depth_max']} blocks, "
+            f"converged {conv['replicas_checked']} replicas "
+            f"(RA axioms {'ok' if conv['ra_ok'] else 'VIOLATED'})"
+        )
+        ok = info["verify_ok"] and info["ra_ok"] and info["faults_ok"]
+        return 0 if ok else 1
+
     mesh_devices = ensure_virtual_devices(args.serve_mesh)
     common = dict(
         mix=args.serve_mix,
@@ -839,6 +903,17 @@ def main(argv=None) -> int:
                     help="stuck-round watchdog threshold for soak "
                          "mode (0 = auto: 25x the rolling median "
                          "steady-round latency, floored at 1s)")
+    ap.add_argument("--serve-writers", type=int, default=0, metavar="W",
+                    help="replicate every served doc across W writer "
+                         "replicas (serve/replicate/): bench ids "
+                         "become serve/repl/<mix>/<fleet>x<W>, the run "
+                         "gates on full-fleet convergence + the "
+                         "RA-linearizability checker (0/1 = the plain "
+                         "single-writer family)")
+    ap.add_argument("--serve-turn-ops", type=int, default=64,
+                    metavar="N",
+                    help="coalesced ops per writer turn block (the "
+                         "replication authorship/broadcast unit)")
     ap.add_argument("--serve-seed", type=int, default=0)
     ap.add_argument("--serve-arrival-span", type=int, default=8)
     ap.add_argument("--serve-verify-sample", type=int, default=8,

@@ -1,0 +1,92 @@
+"""Batched downstream merge for the document fleet: remote-apply rows.
+
+The serve engine's macro scan body — resolve one round of per-row RANGE
+ops against the running visible counts, then apply them to the packed
+row states — IS the fleet's downstream-merge primitive: when a row is a
+*replica* of a document whose writer lives elsewhere (serve/replicate/),
+the ops staged into that row's lanes are **remote** ops delivered by the
+broadcast bus, and this body integrates them exactly like the reference's
+``apply_update`` path (engine/downstream.py) integrates pre-resolved
+updates.  This module makes that primitive a first-class engine entry
+point instead of an anonymous closure duplicated across the pool's scan
+kernel and the recovery replayer:
+
+- :func:`merge_rows_body` — the traceable body (resolve + apply for one
+  round over R rows).  ``serve/pool.py _build_macro_fn`` scans it for
+  the ``--serve-kernel scan`` form, and ``serve/journal.py _replayer``
+  replays recovery intervals through it, so the scan serve kernel, the
+  crash-recovery path, and the replication merge are ONE code path.
+  The ``--serve-kernel fused`` form is the accelerated twin of the same
+  semantics (``ops/serve_fused.py`` detaches the resolve recurrence
+  from the apply); fused-vs-scan byte parity is pinned by
+  tests/test_serve_macro.py and tests/test_serve_fused.py, which is
+  what licenses routing replication through either kernel.
+- :func:`merge_rows_round` / :func:`merge_rows_macro` — the public
+  jitted ``@boundary`` entry points (one round / K scanned rounds) for
+  direct engine users; tests/test_serve_replicate.py pins BOTH against
+  the sequential-interleaving oracle (a writer group's assembled
+  broadcast stream replayed through them equals the oracle replay
+  byte-for-byte, and round-by-round equals the K-scanned form).
+
+Commutativity note (the ``merge_reorder`` chaos fault relies on this):
+remote batches are sequenced by the broadcast bus — each replica
+assembles blocks by sequence number before any op reaches these
+kernels — so *delivery* order is free to permute while the *applied*
+stream stays the arbitration order.  The merge itself is deterministic
+in that assembled order; the commutation happens at the reassembly
+layer, the same split diamond-types makes between transport and
+integration.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ..lint.boundary import boundary
+from ..ops.apply_range import apply_range_batch
+from ..ops.resolve_range_scan import resolve_ranges_rows
+
+
+def merge_rows_body(state, kind, pos, rlen, slot0, *, nbits: int):
+    """One round's batched merge for R rows — resolve each row's range
+    batch against its running visible count, apply on the packed state.
+    Traceable (no jit of its own): the pool's scan kernel and the
+    recovery replayer inline it into their own executables."""
+    tokens, dints, _ = resolve_ranges_rows(kind, pos, rlen, slot0, state.nvis)
+    return apply_range_batch(state, tokens, dints, nbits=nbits)
+
+
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32"),
+    shapes=(None, "R B", "R B", "R B", "R B"),
+    donates=(0,),
+)
+@partial(jax.jit, static_argnames=("nbits",), donate_argnums=(0,))
+def merge_rows_round(state, kind, pos, rlen, slot0, *, nbits: int):
+    """Jitted single-round merge: integrate one (R, B) broadcast batch
+    into R replica rows (row r = the next batch for the doc/replica in
+    row r; ``kind == PAD`` lanes are no-ops end to end)."""
+    return merge_rows_body(state, kind, pos, rlen, slot0, nbits=nbits)
+
+
+@boundary(
+    dtypes=(None, "int32", "int32", "int32", "int32"),
+    shapes=(None, "K R B", "K R B", "K R B", "K R B"),
+    donates=(0,),
+)
+@partial(jax.jit, static_argnames=("nbits",), donate_argnums=(0,))
+def merge_rows_macro(state, kind, pos, rlen, slot0, *, nbits: int):
+    """K scanned rounds of :func:`merge_rows_round` in one dispatch —
+    the engine-level form of ``DocPool.macro_step``'s scan kernel: an
+    assembled broadcast stream replayed through it over a fresh replica
+    row is the sequential-interleaving oracle's device twin
+    (differentially pinned in tests/test_serve_replicate.py)."""
+
+    def body(st, sl):
+        k, p, ln, s0 = sl
+        return merge_rows_body(st, k, p, ln, s0, nbits=nbits), None
+
+    out, _ = jax.lax.scan(body, state, (kind, pos, rlen, slot0))
+    return out

@@ -1,4 +1,4 @@
-"""Mesh-aware per-shard serve metrics.
+"""Mesh-aware per-shard serve metrics (+ per-replica merge series).
 
 Every fleet number the registry carried before this module was a
 *fleet-wide* aggregate: under ``--serve-mesh`` the run could be pinned
@@ -114,3 +114,83 @@ class ShardMetrics:
         for s, ms in enumerate(device_memory_stats(self.n_sh)):
             if ms is not None and "bytes_in_use" in ms:
                 self._mem[s].set(float(ms["bytes_in_use"]))
+
+
+def class_labeled(base: str, cls: int) -> str:
+    """Registry key for a capacity-class-labeled series."""
+    return f'{base}{{doc_class="{cls}"}}'
+
+
+class ReplicaMetrics:
+    """Replication-fleet series over one drain's registry
+    (serve/replicate/): the remote-merge load split by the capacity
+    class it landed in, plus the bus-level health signals.
+
+    - ``serve.replica.merged_ops{doc_class="c"}`` /
+      ``serve.replica.merged_unit_ops{...}`` — remote (broadcast) range
+      ops / unit-op equivalents merged into replica rows of class
+      ``c``; **sum parity is the contract** (tested, the same
+      discipline as the per-shard series): the per-class counters
+      partition the drain's total merged-op count — remote-merge
+      attribution is a partition of the merge work, never a second
+      accounting;
+    - ``serve.replica.local_ops`` — the upstream half (a writer's own
+      ops applied to its own replica), so local + merged partition the
+      fleet's total applied ops;
+    - ``serve.replica.divergence_depth`` — gauge: the deepest
+      per-replica broadcast lag this round, in turn blocks (published
+      head minus the replica's assembled prefix);
+    - ``serve.replica.broadcast_bytes`` / ``broadcast_blocks`` — packed
+      op-lane bytes / turn blocks actually delivered to REMOTE replicas
+      (the fan-out cost of the writer group; local self-delivery is
+      free and not counted).
+
+    All series are pre-registered here, at bind time — the per-round
+    path only touches held references (graftlint G013)."""
+
+    def __init__(self, registry: MetricsRegistry, classes):
+        self._merged = {
+            c: registry.counter(class_labeled(
+                "serve.replica.merged_ops", c
+            ))
+            for c in classes
+        }
+        self._merged_units = {
+            c: registry.counter(class_labeled(
+                "serve.replica.merged_unit_ops", c
+            ))
+            for c in classes
+        }
+        self.local_ops = registry.counter("serve.replica.local_ops")
+        self.divergence = registry.gauge("serve.replica.divergence_depth")
+        self.broadcast_bytes = registry.counter(
+            "serve.replica.broadcast_bytes"
+        )
+        self.broadcast_blocks = registry.counter(
+            "serve.replica.broadcast_blocks"
+        )
+
+    # ---- hot path (pre-registered references only) ----
+
+    def note_merged(self, cls: int, ops: int, unit_ops: int) -> None:
+        """Remote ops merged into a class-``cls`` replica row."""
+        self._merged[cls].inc(ops)
+        self._merged_units[cls].inc(unit_ops)
+
+    def note_local(self, ops: int) -> None:
+        self.local_ops.inc(ops)
+
+    def note_divergence(self, depth_blocks: int) -> None:
+        self.divergence.set(float(depth_blocks))
+
+    def note_broadcast(self, nbytes: int, blocks: int = 1) -> None:
+        self.broadcast_bytes.inc(nbytes)
+        self.broadcast_blocks.inc(blocks)
+
+    def merged_total(self) -> tuple[int, int]:
+        """(ops, unit_ops) summed over every class label — the parity
+        side the tests compare against the scheduler's totals."""
+        return (
+            sum(c.value for c in self._merged.values()),
+            sum(c.value for c in self._merged_units.values()),
+        )

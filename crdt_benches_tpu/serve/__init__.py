@@ -33,7 +33,13 @@ arxiv 2310.18220):
 - :mod:`.bench`      — the ``serve`` bench family (fleet patches/sec +
   p50/p95/p99 per-batch latency, recovery metrics in chaos mode), wired
   into ``bench/runner.py`` under ``--family serve`` with bench ids
-  ``serve/<mix>/<fleet-size>``.
+  ``serve/<mix>/<fleet-size>``;
+- :mod:`.replicate`  — multi-writer replication: every doc becomes a
+  writer GROUP of N replica rows fed by a broadcast bus (paced publish,
+  lagged sequence-keyed delivery, partition/reorder chaos), remote ops
+  merged through the same macro dispatch as local ones, verified by a
+  convergence + RA-linearizability checker tier; bench ids
+  ``serve/repl/<mix>/<fleet>x<writers>`` (``--serve-writers``).
 
 Correctness gate: sampled docs from every capacity bucket finish
 byte-identical to ``oracle/text_oracle.py`` replaying the same per-doc
@@ -45,8 +51,9 @@ tests/test_serve_faults.py).
 from .faults import FaultInjector, FaultPlan
 from .journal import OpJournal, RecoveryReport, recover_fleet
 from .pool import DocPool
+from .replicate import ReplicatedScheduler, build_writer_groups
 from .scheduler import FleetScheduler, ServeStats, prepare_streams
-from .workload import BANDS, MIXES, build_fleet
+from .workload import BANDS, MIXES, build_fleet, split_turns
 
 __all__ = [
     "DocPool",
@@ -55,9 +62,12 @@ __all__ = [
     "FleetScheduler",
     "OpJournal",
     "RecoveryReport",
+    "ReplicatedScheduler",
     "ServeStats",
+    "build_writer_groups",
     "prepare_streams",
     "recover_fleet",
+    "split_turns",
     "BANDS",
     "MIXES",
     "build_fleet",

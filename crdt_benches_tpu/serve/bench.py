@@ -54,7 +54,7 @@ from ..obs.profiler import DeviceProfiler
 from ..obs.status import StatusServer
 from ..obs.timeseries import ServeTelemetry, TimeseriesRecorder
 from ..oracle.text_oracle import replay_trace
-from .faults import FaultInjector, FaultPlan
+from .faults import REPLICATION_KINDS, FaultInjector, FaultPlan
 from .journal import OpJournal
 from .pool import DocPool
 from .scheduler import FleetScheduler, prepare_streams
@@ -217,6 +217,15 @@ def run_serve_bench(
         plan = faults if isinstance(faults, FaultPlan) else (
             FaultPlan.from_spec(faults)
         )
+        repl_kinds = sorted({
+            e.kind for e in plan.events if e.kind in REPLICATION_KINDS
+        })
+        if repl_kinds:
+            raise ValueError(
+                f"fault kinds {repl_kinds} need a replicated fleet "
+                "(--serve-writers >= 2, serve/replicate/); a plain "
+                "serve drain never polls them"
+            )
         if queue_cap <= 0 and any(
             e.kind == "queue_overflow" for e in plan.events
         ):

@@ -34,6 +34,16 @@ Spec grammar (comma-separated ``key=value`` tokens)::
                      forcing an explicit shed/defer decision
   ``poison_rebuild`` make the targeted doc's rebuild fail (tests the
                      quarantine path; normally test-constructed)
+  ``replica_partition`` drop one replica's broadcast deliveries for a
+                     span of rounds (serve/replicate/ only): the
+                     replica's divergence window grows while its
+                     writer-group peers advance, and the bus's
+                     heal-time backlog flush must reconverge it
+                     (``param`` = partition span in rounds, default 3)
+  ``merge_reorder``  deliver one round's remote broadcast batches in a
+                     permuted writer order (serve/replicate/ only);
+                     sequence-keyed reassembly makes delivery order
+                     commute, so byte-verify must stay green
   =================  ======================================================
 
 Every event records whether it fired and whether the engine recovered
@@ -58,7 +68,16 @@ KINDS = (
     "stall",
     "queue_overflow",
     "poison_rebuild",
+    "replica_partition",
+    "merge_reorder",
 )
+
+#: Kinds only the replicated scheduler (serve/replicate/) polls.  A
+#: plain serve drain never fires them, so ``run_serve_bench`` rejects a
+#: spec that arms them without ``--serve-writers`` up front — a loud
+#: configuration error instead of a whole drain ending in a confusing
+#: not_fired chaos-gate failure.
+REPLICATION_KINDS = ("replica_partition", "merge_reorder")
 
 
 @dataclass
@@ -240,6 +259,16 @@ class FaultInjector:
 
     def spool_event(self, rnd: int) -> FaultEvent | None:
         return self._pending(rnd, "spool_corrupt", "spool_truncate")
+
+    def partition_event(self, rnd: int) -> FaultEvent | None:
+        """A replica's broadcast link drops for a span (polled by the
+        replicated scheduler's bus tick; ``param`` = span rounds)."""
+        return self._pending(rnd, "replica_partition")
+
+    def reorder_event(self, rnd: int) -> FaultEvent | None:
+        """One round's remote broadcast batches delivered in permuted
+        writer order (polled by the replicated scheduler's bus tick)."""
+        return self._pending(rnd, "merge_reorder")
 
     def poisoned(self, doc_id: int) -> bool:
         """Fire-once: is this doc's REBUILD poisoned?  (Exercises the

@@ -377,13 +377,11 @@ def _replayer(C: int, B: int, K: int, nbits: int):
     if key not in _REPLAYERS:
         import jax
 
-        from ..ops.apply_range import apply_range_batch
-        from ..ops.resolve_range_scan import resolve_ranges_rows
+        from ..engine.merge_fleet import merge_rows_body
 
         def body(st, sl):
             k, p, ln, s0 = sl
-            tokens, dints, _ = resolve_ranges_rows(k, p, ln, s0, st.nvis)
-            return apply_range_batch(st, tokens, dints, nbits=nbits), None
+            return merge_rows_body(st, k, p, ln, s0, nbits=nbits), None
 
         def fn(state, kind, pos, rlen, slot0):
             out, _ = jax.lax.scan(body, state, (kind, pos, rlen, slot0))

@@ -54,14 +54,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..engine.merge_fleet import merge_rows_body
 from ..lint.boundary import boundary
 from ..lint.sanitizer import fenced
 from ..obs.metrics import Counter
 from ..ops.apply2 import LANE, PackedState, apply_batch3
-from ..ops.apply_range import apply_range_batch
 from ..ops.packing import op_lane_dtypes, widen_ops
 from ..ops.resolve import resolve_batch
-from ..ops.resolve_range_scan import resolve_ranges_rows
 from ..ops.serve_fused import (
     NARROW_RESOLVE_OPS,
     RESOLVE_CHUNK_ROWS,
@@ -575,9 +574,11 @@ class DocPool:
         full = Rt == R
 
         def body(st, sl):
+            # the engine's batched downstream-merge primitive: the scan
+            # serve kernel, the recovery replayer, and the replication
+            # remote-apply are ONE body (engine/merge_fleet.py)
             k, p, ln, s0 = sl
-            tokens, dints, _ = resolve_ranges_rows(k, p, ln, s0, st.nvis)
-            return apply_range_batch(st, tokens, dints, nbits=nbits), None
+            return merge_rows_body(st, k, p, ln, s0, nbits=nbits), None
 
         def fn(state, kind, pos, rlen, slot0):
             # staged lanes arrive in the pool's narrow dtypes

@@ -1,0 +1,60 @@
+"""serve/replicate/ — multi-writer replication over the document fleet.
+
+The reference paper benchmarks two op families: *upstream* (local
+edits) and *downstream* (remote-update apply).  The serve engine only
+ever exercised the upstream shape — one patch stream per doc, so
+"millions of users" meant concurrent *documents*.  This package turns
+every served document into a **writer group**: N writer replicas per
+doc, each a real pool row, each consuming its own authored slice of the
+workload stream, with op broadcast and batched downstream merge routed
+through the existing engine merge paths INSIDE the macro-round scan —
+concurrent *editors*, device-resident end to end.
+
+- :mod:`.group`     — writer groups: the round-robin turn-block
+  authorship split (``serve/workload.py split_turns``), dense replica
+  doc ids, local/remote op attribution;
+- :mod:`.broadcast` — the broadcast bus: paced publish, lagged remote
+  delivery, sequence-keyed reassembly (delivery order commutes),
+  partition backlogs + heal, journaled ``bcast`` records for crash
+  recovery, sampled per-replica delivery histories;
+- :mod:`.scheduler` — ``ReplicatedScheduler``: the fleet scheduler
+  with bus-owned delivery; remote ops merge through the same macro
+  dispatch as local ones (``engine/merge_fleet.py`` scan body / its
+  parity-pinned fused twin), replica rows evict/promote/recover like
+  any pool row;
+- :mod:`.checker`   — the new verification tier: full-fleet byte
+  convergence against the sequential oracle AND the
+  RA-linearizability visibility axioms (arXiv 1903.06560) over sampled
+  broadcast histories;
+- :mod:`.bench`     — bench family ``serve/repl/<mix>/<fleet>x<writers>``
+  with merge-throughput / broadcast-fan-out / divergence-window /
+  convergence-round artifact blocks, gated on the checker.
+"""
+
+from .broadcast import BroadcastBus, replay_journal_broadcasts
+from .checker import (
+    ConvergenceReport,
+    check_convergence,
+    check_ra_linearizability,
+)
+from .group import (
+    GroupTable,
+    ReplicaGroup,
+    attach_turn_blocks,
+    build_writer_groups,
+)
+from .scheduler import ReplicatedScheduler, recover_replicated_fleet
+
+__all__ = [
+    "BroadcastBus",
+    "ConvergenceReport",
+    "GroupTable",
+    "ReplicaGroup",
+    "ReplicatedScheduler",
+    "attach_turn_blocks",
+    "build_writer_groups",
+    "check_convergence",
+    "check_ra_linearizability",
+    "recover_replicated_fleet",
+    "replay_journal_broadcasts",
+]

@@ -1,0 +1,254 @@
+"""The ``serve/repl`` bench family: writer groups at fleet scale.
+
+Bench id grammar: ``serve/repl/<mix>/<fleet>x<writers>`` — ``fleet``
+logical documents, each served by ``writers`` concurrent writer
+replicas (so the pool hosts ``fleet * writers`` rows).  Reported on top
+of the plain serve surface:
+
+- **merge throughput** — remote (broadcast) unit ops merged into
+  replica rows per second of drain wall time: the paper's *downstream*
+  family at serve scale;
+- **broadcast fan-out** — packed op-lane bytes delivered to remote
+  replicas (the replication tax the wire would carry);
+- **divergence window** — deepest per-replica broadcast lag observed,
+  in turn blocks, plus the convergence window (rounds from last publish
+  to full assembly everywhere);
+- the ``replication`` artifact block with the full topology + counters
+  (``ReplicatedScheduler.replication_block``).
+
+The exit gate is the new verification tier, not just byte parity: after
+drain (1) EVERY replica of every logical doc must decode byte-identical
+to the sequential oracle replay — convergence — and (2) the sampled
+per-doc broadcast histories must satisfy the RA-linearizability
+visibility axioms (serve/replicate/checker.py).  Chaos mode wires the
+two replication fault kinds (``replica_partition`` / ``merge_reorder``)
+through the same seeded FaultPlan grammar as the plain family.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+
+from ...bench.harness import BenchResult, save_results
+from ..bench import _parse_int_tuple
+from ..faults import FaultInjector, FaultPlan
+from ..journal import OpJournal
+from ..pool import DocPool
+from ..scheduler import prepare_streams
+from ..workload import build_fleet
+from .checker import (
+    ConvergenceReport,
+    check_convergence,
+    check_ra_linearizability,
+)
+from .group import build_writer_groups
+from .scheduler import ReplicatedScheduler
+
+
+def run_serve_repl_bench(
+    mix="mixed",
+    n_docs: int = 512,
+    writers: int = 4,
+    batch: int = 64,
+    classes=(256, 1024, 4096, 8192, 49152),
+    slots=(2048, 512, 128, 32, 16),
+    seed: int = 0,
+    arrival_span: int = 8,
+    bands: dict | None = None,
+    macro_k: int = 8,
+    batch_chars: int = 256,
+    serve_kernel: str = "fused",
+    turn_ops: int = 64,
+    remote_lag: int = 1,
+    history_sample: int = 16,
+    spool_dir: str | None = None,
+    journal_dir: str | None = None,
+    snapshot_every: int = 32,
+    faults=None,
+    results_dir: str | None = None,
+    save_name: str | None = None,
+    log=print,
+) -> tuple[BenchResult, dict]:
+    """Build a replicated fleet, drain it, run the convergence +
+    RA-linearizability verification tier, persist the artifact.
+    Returns (BenchResult, info) with ``info["verify_ok"]`` (all
+    replicas byte-identical to the oracle), ``info["ra_ok"]`` and, in
+    chaos mode, ``info["faults_ok"]``."""
+    if writers < 1:
+        raise ValueError(f"writers must be >= 1, got {writers}")
+    classes = _parse_int_tuple(classes)
+    slots = _parse_int_tuple(slots)
+    mix_name = mix if isinstance(mix, str) else "custom"
+
+    plan = None
+    if faults is not None:
+        plan = faults if isinstance(faults, FaultPlan) else (
+            FaultPlan.from_spec(faults)
+        )
+        if any(e.kind == "queue_overflow" for e in plan.events):
+            # the mirror of run_serve_bench's REPLICATION_KINDS guard:
+            # the replicated family has no bounded producer queue (the
+            # broadcast bus owns delivery pacing), so the event could
+            # never fire — reject up front instead of failing the chaos
+            # gate with "never fired" after a whole drain
+            raise ValueError(
+                "queue_overflow needs the plain family's bounded queue "
+                "(--serve-queue-cap); the replicated family's delivery "
+                "pacing is the broadcast bus's"
+            )
+    owns_journal = journal_dir == "auto"
+    if owns_journal:
+        journal_dir = tempfile.mkdtemp(prefix="crdt_repl_journal_")
+    journal = OpJournal(journal_dir) if journal_dir else None
+
+    pool = None
+    try:
+        log(
+            f"serve/repl: building fleet n_docs={n_docs} x "
+            f"writers={writers} mix={mix_name} seed={seed}"
+        )
+        sessions = build_fleet(
+            n_docs, mix=mix, seed=seed, arrival_span=arrival_span,
+            bands=bands,
+        )
+        replica_sessions, table = build_writer_groups(sessions, writers)
+        pool = DocPool(classes=classes, slots=slots,
+                       spool_dir=spool_dir, serve_kernel=serve_kernel)
+        streams = prepare_streams(
+            replica_sessions, pool, batch=batch, batch_chars=batch_chars
+        )
+        total_ops = sum(s.remaining for s in streams.values())
+        log(
+            f"serve/repl: {len(table)} groups, "
+            f"{len(replica_sessions)} replica rows, {total_ops} range "
+            f"ops staged fleet-wide, turn_ops={turn_ops} "
+            f"lag={remote_lag} K={macro_k} kernel={serve_kernel}"
+        )
+        sched = ReplicatedScheduler(
+            pool, streams, table,
+            turn_ops=turn_ops, remote_lag=remote_lag,
+            history_sample=history_sample, seed=seed,
+            batch=batch, macro_k=macro_k, batch_chars=batch_chars,
+            faults=FaultInjector(plan) if plan else None,
+            journal=journal, snapshot_every=snapshot_every,
+            warm_start=True,
+        )
+        stats = sched.run()
+        assert sched.done, "replicated scheduler stopped with pending work"
+        throughput = stats.patches / stats.wall_time
+        merge_tput = sched.merged_unit_ops / stats.wall_time
+        lat = stats.latency_quantiles()
+        log(
+            f"serve/repl: drained in {stats.wall_time:.2f}s over "
+            f"{stats.rounds} macro-rounds -> {throughput:,.0f} "
+            f"replica-patches/s, merge {merge_tput:,.0f} unit-ops/s "
+            f"({sched.merged_ops} remote / {sched.local_ops} local "
+            f"range ops), broadcast "
+            f"{sched.bus.bytes_broadcast / 1024:.1f} KiB over "
+            f"{sched.bus.blocks_delivered_remote} deliveries, "
+            f"divergence max {sched.bus.divergence_max} blocks"
+        )
+
+        # ---- the verification tier: convergence + RA-linearizability
+        report = ConvergenceReport()
+        check_convergence(pool, table, sessions, streams, report)
+        check_ra_linearizability(sched.bus, table, report)
+        log(
+            f"serve/repl: convergence — {report.replicas_checked} "
+            f"replicas across {report.groups_checked} groups "
+            + ("all byte-identical to oracle" if report.converged
+               else f"MISMATCH x{len(report.byte_mismatches)}: "
+                    f"{report.byte_mismatches[:4]}")
+            + (f" ({len(report.lossy_groups)} lossy groups excluded)"
+               if report.lossy_groups else "")
+        )
+        log(
+            f"serve/repl: RA-linearizability — "
+            f"{report.ra_groups_checked} sampled histories "
+            + ("all axioms hold" if report.ra_ok
+               else f"VIOLATIONS: {report.ra_violations[:4]}")
+        )
+
+        fault_summary = plan.summary() if plan is not None else None
+        faults_ok = fault_summary is None or (
+            fault_summary["unrecovered"] == 0
+            and fault_summary["not_fired"] == 0
+        )
+        if fault_summary is not None and not faults_ok:
+            log(
+                f"serve/repl: FAULTS NOT CLEARED — "
+                f"{fault_summary['unrecovered']} unrecovered, "
+                f"{fault_summary['not_fired']} never fired"
+            )
+
+        r = BenchResult(
+            group="serve/repl",
+            trace=mix_name,
+            backend=f"{n_docs}x{writers}",
+            elements=stats.patches,
+            samples=[stats.wall_time],
+            replicas=writers,
+            extra={
+                "family": "serve-repl",
+                "fleet_docs": n_docs,
+                "writers": writers,
+                "replica_rows": n_docs * writers,
+                "batch": batch,
+                "batch_chars": batch_chars,
+                "macro_k": macro_k,
+                "kernel": serve_kernel,
+                "classes": list(classes),
+                "slots": list(slots),
+                "rounds": stats.rounds,
+                "range_ops": stats.ops,
+                "unit_ops": stats.unit_ops,
+                "patches_per_sec": throughput,
+                "merge_unit_ops_per_sec": merge_tput,
+                "batch_latency": lat,
+                "compile_time": stats.compile_time,
+                "compile_rounds": stats.compile_rounds,
+                "steady_rounds": stats.steady_rounds,
+                "occupancy_mean": stats.occupancy.mean,
+                "evictions": stats.evictions,
+                "restores": stats.restores,
+                "promotions": stats.promotions,
+                "coalesce_ratio": stats.coalesce_ratio,
+                "pad_fraction": stats.pad_fraction,
+                "replication": sched.replication_block(),
+                "convergence": report.to_dict(),
+                "faults": fault_summary,
+                "journal": None if journal is None else {
+                    "records": journal.records,
+                    "bytes": journal.bytes_written,
+                    "snapshots": stats.snapshots,
+                    "snapshot_every": snapshot_every,
+                },
+                "metrics": stats.metrics.to_dict(),
+                "verify_ok": report.converged,
+                "ra_ok": report.ra_ok,
+            },
+        )
+        kw = {"results_dir": results_dir} if results_dir else {}
+        path = save_results(
+            [r],
+            save_name or f"serve_repl_{mix_name}_{n_docs}x{writers}",
+            **kw,
+        )
+        log(f"serve/repl: wrote {path}")
+        return r, {
+            "verify_ok": report.converged,
+            "ra_ok": report.ra_ok,
+            "faults_ok": faults_ok,
+            "path": path,
+            "stats": stats,
+            "report": report,
+            "scheduler": sched,
+        }
+    finally:
+        if journal is not None:
+            journal.close()
+        if owns_journal:
+            shutil.rmtree(journal_dir, ignore_errors=True)
+        if pool is not None:
+            pool.close()

@@ -1,0 +1,395 @@
+"""The broadcast bus: op fan-out + sequence-keyed reassembly per group.
+
+Every writer group replicates through this host-side bus.  Each tick
+(one scheduler macro-round):
+
+1. **publish** — the next turn blocks of the group's arbitration order
+   (ascending block sequence) are published, paced at ``pub_ops``
+   coalesced ops per group per tick so a group's producers feed the
+   fleet at roughly the rate one scheduled replica can consume
+   (``K * batch`` ops per macro-round).  A published block is journaled
+   BEFORE any replica may consume it (``bcast`` records — the WAL's
+   CRC-valid-prefix property then guarantees a surviving lane record
+   implies its broadcast records survived too, which is what lets
+   ``recover_fleet`` + :func:`replay_journal_broadcasts` resume to a
+   convergent state);
+2. **deliver** — the authoring writer's own replica receives its block
+   immediately (read-your-writes); remote replicas receive it
+   ``remote_lag`` ticks later, modeling propagation.  Delivery inserts
+   the block into the replica's **sequence-keyed reassembly buffer**;
+   the replica's *assembled prefix* (the ops the scheduler may stage)
+   advances only over contiguous sequences.  Delivery order therefore
+   COMMUTES: permuting a round's remote batches (the ``merge_reorder``
+   chaos fault) cannot change any replica's assembled stream — the same
+   transport/integration split diamond-types makes, and the reason the
+   downstream merge stays verify-green under reordering;
+3. **faults** — a partitioned replica (``replica_partition``) has its
+   remote deliveries buffered in a per-replica backlog; at heal the
+   backlog flushes in sequence order and the replica's divergence
+   window (published head minus assembled prefix, in blocks) collapses
+   back to the steady lag.
+
+The bus also records the **per-replica delivery histories** for a
+sampled set of groups — the raw material the RA-linearizability checker
+(serve/replicate/checker.py) validates after drain — and accounts
+broadcast fan-out (packed op-lane bytes delivered to remote replicas)
+through ``obs/shard.py ReplicaMetrics``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .group import GroupTable, ReplicaGroup
+
+
+@dataclass
+class _GroupState:
+    """Per-group bus state; index ``w`` = writer ``w``'s replica."""
+
+    group: ReplicaGroup
+    published: int = 0  # blocks published (a prefix of the sequence)
+    last_publish_round: int = -1
+    converged_round: int = -1  # every replica fully assembled
+    delivered: list[list[bool]] = field(default_factory=list)
+    prefix: list[int] = field(default_factory=list)  # contiguous blocks
+    pending: list[tuple[int, int, int]] = field(default_factory=list)
+    # pending: (ready_round, seq, dst_writer) remote deliveries in flight
+    backlog: list[list[int]] = field(default_factory=list)  # per replica
+
+    def __post_init__(self):
+        W = self.group.writers
+        n = self.group.n_blocks
+        self.delivered = [[False] * n for _ in range(W)]
+        self.prefix = [0] * W
+        self.backlog = [[] for _ in range(W)]
+
+    def advance_prefix(self, w: int) -> None:
+        d = self.delivered[w]
+        p = self.prefix[w]
+        n = len(d)
+        while p < n and d[p]:
+            p += 1
+        self.prefix[w] = p
+
+
+class BroadcastBus:
+    """Publish/deliver engine over a :class:`GroupTable` (see module
+    docstring).  Host-only: no device arrays anywhere — the bus never
+    syncs, so it lives inside the scheduler's sanitized hot scope
+    without a fence."""
+
+    def __init__(
+        self,
+        table: GroupTable,
+        *,
+        pub_ops: int,
+        op_nbytes: int,
+        remote_lag: int = 1,
+        journal=None,
+        metrics=None,
+        history_groups: set[int] | None = None,
+    ):
+        self.table = table
+        self.pub_ops = max(1, pub_ops)
+        self.op_nbytes = op_nbytes
+        self.remote_lag = max(0, remote_lag)
+        self.journal = journal
+        self.metrics = metrics  # obs/shard.py ReplicaMetrics (or None)
+        self._gs = {g.logical_id: _GroupState(g) for g in table}
+        # RA-checker material, recorded only for the sampled groups:
+        # per replica the (round, seq) delivery order, per group the
+        # (round, seq) publish order.
+        self.history_groups = set(history_groups or ())
+        self.histories: dict[int, list[list[tuple[int, int]]]] = {}
+        self.publish_log: dict[int, list[tuple[int, int]]] = {}
+        for g in table:
+            if g.logical_id in self.history_groups:
+                self.histories[g.logical_id] = [
+                    [] for _ in range(g.writers)
+                ]
+                self.publish_log[g.logical_id] = []
+        # faults: (gid, writer) -> (heal_round, FaultEvent|None)
+        self._partitions: dict[tuple[int, int], tuple[int, object]] = {}
+        self._healed_waiting: list[tuple[int, int, object]] = []
+        self._reorder: tuple[object, object] | None = None  # (rng, event)
+        # cumulative accounting (artifact surface)
+        self.blocks_published = 0
+        self.blocks_delivered_remote = 0
+        self.bytes_broadcast = 0
+        self.divergence_max = 0
+        self.partitions_healed = 0
+        self.reordered_rounds = 0
+
+    # ---- fault arming (called by the replicated scheduler) ----
+
+    def start_partition(self, gid: int, writer: int, heal_round: int,
+                        event=None) -> None:
+        self._partitions[(gid, writer)] = (heal_round, event)
+
+    def partitioned(self, gid: int, writer: int) -> bool:
+        return (gid, writer) in self._partitions
+
+    def arm_reorder(self, rng, event=None) -> None:
+        """Permute the NEXT tick's remote deliveries across writers
+        (per-writer sequence order preserved — authors still emit in
+        order; only the interleave is adversarial)."""
+        self._reorder = (rng, event)
+
+    def live_partition_targets(self) -> list[tuple[int, int]]:
+        """(gid, writer) pairs a partition could meaningfully hit: the
+        group still has undelivered future (so the divergence window
+        will actually grow and the heal is observable)."""
+        out = []
+        for gid in sorted(self._gs):
+            gs = self._gs[gid]
+            if gs.group.writers < 2:
+                continue
+            for w in range(gs.group.writers):
+                if (gs.prefix[w] < gs.group.n_blocks
+                        and (gid, w) not in self._partitions):
+                    out.append((gid, w))
+        return out
+
+    # ---- the tick (host-only; runs inside the hot scope) ----
+
+    def _record(self, gid: int, w: int, rnd: int, seq: int) -> None:
+        h = self.histories.get(gid)
+        if h is not None:
+            h[w].append((rnd, seq))
+
+    def _deliver(self, gs: _GroupState, w: int, seq: int, rnd: int,
+                 remote: bool) -> None:
+        gid = gs.group.logical_id
+        if remote and (gid, w) in self._partitions:
+            gs.backlog[w].append(seq)
+            return
+        if gs.delivered[w][seq]:
+            return  # duplicate delivery: reassembly is idempotent
+        gs.delivered[w][seq] = True
+        gs.advance_prefix(w)
+        self._record(gid, w, rnd, seq)
+        if remote:
+            lo, hi = gs.group.span(seq)
+            nbytes = (hi - lo) * self.op_nbytes
+            self.blocks_delivered_remote += 1
+            self.bytes_broadcast += nbytes
+            if self.metrics is not None:
+                self.metrics.note_broadcast(nbytes)
+
+    def _heal_due(self, rnd: int) -> None:
+        for key in sorted(self._partitions):
+            heal_round, event = self._partitions[key]
+            if rnd < heal_round:
+                continue
+            gid, w = key
+            gs = self._gs[gid]
+            del self._partitions[key]
+            for seq in sorted(gs.backlog[w]):
+                self._deliver(gs, w, seq, rnd, remote=True)
+            gs.backlog[w] = []
+            self.partitions_healed += 1
+            if event is not None:
+                # recovered once the replica's assembled prefix is back
+                # at the published head (usually immediately: the
+                # backlog flush IS the catch-up)
+                self._healed_waiting.append((gid, w, event))
+
+    def _deliver_due(self, rnd: int) -> None:
+        reordered = False
+        for gid in sorted(self._gs):
+            gs = self._gs[gid]
+            due = [p for p in gs.pending if p[0] <= rnd]
+            if not due:
+                continue
+            gs.pending = [p for p in gs.pending if p[0] > rnd]
+            if self._reorder is not None:
+                rng, event = self._reorder
+                # permute the WRITER interleave, preserving each
+                # writer's own sequence order (authors emit in order)
+                by_dst_writer: dict[tuple[int, int], list] = {}
+                for ready, seq, w in due:
+                    by_dst_writer.setdefault(
+                        (w, gs.group.owner(seq)), []
+                    ).append((ready, seq, w))
+                keys = sorted(by_dst_writer)
+                perm = rng.permutation(len(keys))
+                due = [
+                    item
+                    for i in perm
+                    for item in sorted(by_dst_writer[keys[int(i)]],
+                                       key=lambda p: p[1])
+                ]
+                reordered = True
+                if event is not None and not event.fired:
+                    event.fire(rnd, group=gid, batches=len(due))
+                    event.recover(commuted=True)
+            else:
+                due.sort(key=lambda p: p[1])
+            for _ready, seq, w in due:
+                self._deliver(gs, w, seq, rnd, remote=True)
+        # one round only: the permutation is a delivery-order fault,
+        # not a mode
+        if reordered:
+            self.reordered_rounds += 1
+            self._reorder = None
+
+    def _publish(self, gs: _GroupState, rnd: int) -> None:
+        g = gs.group
+        budget = self.pub_ops
+        while gs.published < g.n_blocks and budget > 0:
+            seq = gs.published
+            lo, hi, owner = g.blocks[seq]
+            budget -= hi - lo
+            gs.published = seq + 1
+            gs.last_publish_round = rnd
+            self.blocks_published += 1
+            if g.logical_id in self.publish_log:
+                self.publish_log[g.logical_id].append((rnd, seq))
+            if self.journal is not None:
+                self.journal.event(
+                    "bcast", r=rnd, g=g.logical_id, w=owner, s=seq,
+                    lo=lo, hi=hi,
+                )
+            # read-your-writes: the author's replica sees its own block
+            # the moment it is published, partition or not (a partition
+            # cuts the NETWORK, not the local log)
+            self._deliver(gs, owner, seq, rnd, remote=False)
+            for w in range(g.writers):
+                if w == owner:
+                    continue
+                if self.remote_lag == 0:
+                    self._deliver(gs, w, seq, rnd, remote=True)
+                else:
+                    gs.pending.append((rnd + self.remote_lag, seq, w))
+
+    def tick(self, rnd: int) -> None:
+        """One bus round: heal due partitions, deliver due remote
+        blocks, publish the next paced blocks."""
+        self._heal_due(rnd)
+        self._deliver_due(rnd)
+        for gid in sorted(self._gs):
+            gs = self._gs[gid]
+            if gs.published < gs.group.n_blocks:
+                self._publish(gs, rnd)
+            if (gs.converged_round < 0 and gs.group.n_blocks
+                    and all(p == gs.group.n_blocks for p in gs.prefix)):
+                gs.converged_round = rnd
+        # partition events recover once the healed replica caught up
+        still = []
+        for gid, w, event in self._healed_waiting:
+            gs = self._gs[gid]
+            if gs.prefix[w] >= gs.published:
+                event.recover(healed_round=rnd)
+            else:
+                still.append((gid, w, event))
+        self._healed_waiting = still
+        d = self.divergence_depth()
+        if d > self.divergence_max:
+            self.divergence_max = d
+        if self.metrics is not None:
+            self.metrics.note_divergence(d)
+
+    # ---- recovery (force-marking outside the live tick) ----
+
+    def force_delivered(self, gid: int, seq: int,
+                        writer: int | None = None) -> None:
+        """Mark block ``seq`` published and delivered (to ``writer``,
+        or to every replica when None) WITHOUT the live delivery path's
+        lag/partition/fan-out accounting — the recovery primitive
+        shared by :func:`replay_journal_broadcasts` and the
+        scheduler's torn-tail ``resync_delivery`` fallback.  Records
+        the delivery into any sampled history at round ``-1`` (the
+        pre-crash marker), so the RA checker still sees a complete
+        arbitration prefix.  Idempotent; callers advance prefixes via
+        :meth:`settle_prefixes` once a batch of marks is done."""
+        gs = self._gs[gid]
+        gs.published = max(gs.published, seq + 1)
+        targets = range(gs.group.writers) if writer is None else (writer,)
+        for w in targets:
+            if not gs.delivered[w][seq]:
+                gs.delivered[w][seq] = True
+                self._record(gid, w, -1, seq)
+
+    def settle_prefixes(self) -> None:
+        """Re-derive every replica's assembled prefix after a batch of
+        :meth:`force_delivered` marks."""
+        for gs in self._gs.values():
+            for w in range(gs.group.writers):
+                gs.advance_prefix(w)
+
+    # ---- queries (scheduler-facing) ----
+
+    def delivered_ops(self, replica_id: int) -> int:
+        """The replica's assembled prefix in coalesced ops — the
+        delivery point the scheduler may stage up to."""
+        g, w = self.table.group_of(replica_id)
+        gs = self._gs[g.logical_id]
+        return g.prefix_ops(gs.prefix[w])
+
+    def divergence_depth(self) -> int:
+        """Deepest replica lag right now, in turn blocks (published
+        head minus assembled prefix, maxed over every replica)."""
+        depth = 0
+        for gs in self._gs.values():
+            for p in gs.prefix:
+                lag = gs.published - p
+                if lag > depth:
+                    depth = lag
+        return depth
+
+    def pending_work(self) -> bool:
+        """True while a future tick can still move ops toward a replica
+        (unpublished blocks, in-flight deliveries, partition backlogs,
+        or an assembled prefix behind the published head)."""
+        for gs in self._gs.values():
+            if gs.published < gs.group.n_blocks or gs.pending:
+                return True
+            if any(gs.backlog):
+                return True
+            if any(p < gs.published for p in gs.prefix):
+                return True
+        return False
+
+    def convergence_rounds(self) -> list[int]:
+        """Per converged group: rounds from its last publish to full
+        assembly on every replica (the bus-level convergence window)."""
+        return [
+            gs.converged_round - gs.last_publish_round
+            for gs in self._gs.values()
+            if gs.converged_round >= 0 and gs.last_publish_round >= 0
+        ]
+
+    def group_state(self, gid: int) -> _GroupState:
+        return self._gs[gid]
+
+
+def replay_journal_broadcasts(bus: BroadcastBus, records: list[dict]
+                              ) -> int:
+    """Rebuild bus delivery state from journaled ``bcast`` records
+    (crash recovery): every journaled block is re-published and
+    delivered to EVERY replica of its group — re-delivery is safe
+    because the scheduler's cursor is the idempotence high-water mark
+    (``DocStream.clamp_redelivery``), and the WAL's valid-prefix
+    property guarantees any lane record that survived is covered by
+    surviving broadcast records, so every restored cursor is within the
+    re-assembled prefix.  Replayed deliveries are recorded into the
+    sampled histories at round ``-1`` (the pre-crash marker) so the
+    RA-linearizability checker still sees a complete, gap-free
+    arbitration prefix on a recovered fleet instead of reporting
+    phantom A4/A5 violations.  Returns the number of blocks replayed."""
+    n = 0
+    for rec in records:
+        if rec.get("t") != "bcast":
+            continue
+        gid = int(rec["g"])
+        gs = bus._gs.get(gid)
+        if gs is None:
+            continue
+        seq = int(rec["s"])
+        if seq >= gs.group.n_blocks:
+            continue
+        bus.force_delivered(gid, seq)
+        n += 1
+    bus.settle_prefixes()
+    return n

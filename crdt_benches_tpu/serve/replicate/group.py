@@ -1,0 +1,140 @@
+"""Writer groups: the replica topology of the replicated fleet.
+
+One logical document served by W concurrent writers becomes a **writer
+group**: W replica documents (each a real pool row with its own
+capacity-class residency, spool checkpoints, and journal lanes), one per
+writer, plus a deterministic authorship split of the doc's op stream
+into round-robin **turn blocks** (``serve/workload.py split_turns``).
+Block ``j`` is authored by writer ``j % W``; ascending block sequence is
+the group's **arbitration order**, and it concatenates back to exactly
+the original stream — so the sequential oracle replay of the logical
+doc is the converged state every replica must reach byte-for-byte.
+
+Replica doc ids are dense: logical doc ``d``'s replica for writer ``w``
+is ``d * W + w``.  Replicas share the logical session's trace object
+(``workload.replicate_sessions``), so ``prepare_streams`` tensorizes
+each stream once; the per-replica state that differs is cursor/delivery
+bookkeeping, which is exactly what the broadcast bus owns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..workload import Session, replicate_sessions, split_turns
+
+
+@dataclass
+class ReplicaGroup:
+    """One logical document's writer group."""
+
+    logical_id: int
+    writers: int
+    replica_ids: tuple[int, ...]  # replica_ids[w] = writer w's pool doc
+    blocks: list[tuple[int, int, int]] = field(default_factory=list)
+    n_ops: int = 0  # coalesced range ops in the logical stream
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.blocks)
+
+    def owner(self, seq: int) -> int:
+        return self.blocks[seq][2]
+
+    def span(self, seq: int) -> tuple[int, int]:
+        lo, hi, _w = self.blocks[seq]
+        return lo, hi
+
+    def prefix_ops(self, n_blocks: int) -> int:
+        """Ops covered by the first ``n_blocks`` blocks (the assembled
+        delivery prefix in op units)."""
+        if n_blocks <= 0:
+            return 0
+        return self.blocks[min(n_blocks, len(self.blocks)) - 1][1]
+
+    def remote_intervals(self, writer: int, lo: int,
+                         hi: int) -> list[tuple[int, int]]:
+        """Sub-intervals of ``[lo, hi)`` authored by writers OTHER than
+        ``writer`` — the remote (downstream-merge) share of a staged
+        slice.  Host arithmetic over the few blocks a slice spans
+        (blocks are uniform ``turn_ops`` wide except the last)."""
+        out: list[tuple[int, int]] = []
+        if hi <= lo or not self.blocks:
+            return out
+        turn = self.blocks[0][1] - self.blocks[0][0]
+        seq = min(lo // turn, len(self.blocks) - 1)
+        while seq < len(self.blocks):
+            blo, bhi, w = self.blocks[seq]
+            if blo >= hi:
+                break
+            a, b = max(lo, blo), min(hi, bhi)
+            if b > a and w != writer:
+                if out and out[-1][1] == a:
+                    out[-1] = (out[-1][0], b)
+                else:
+                    out.append((a, b))
+            seq += 1
+        return out
+
+    def split_local_remote(self, writer: int, lo: int,
+                           hi: int) -> tuple[int, int]:
+        """(local, remote) op counts of ``[lo, hi)`` for ``writer`` —
+        local = ops in blocks this writer authored (the upstream half),
+        remote = everything merged from its peers' broadcasts."""
+        if hi <= lo:
+            return 0, 0
+        rem = sum(b - a for a, b in self.remote_intervals(writer, lo, hi))
+        return (hi - lo) - rem, rem
+
+
+class GroupTable:
+    """The fleet's replica topology: groups plus the replica -> (group,
+    writer) inverse, built once at fleet construction."""
+
+    def __init__(self, groups: list[ReplicaGroup]):
+        self.groups = groups
+        self.by_replica: dict[int, tuple[ReplicaGroup, int]] = {}
+        for g in groups:
+            for w, rid in enumerate(g.replica_ids):
+                self.by_replica[rid] = (g, w)
+
+    def __iter__(self):
+        return iter(self.groups)
+
+    def __len__(self) -> int:
+        return len(self.groups)
+
+    def group_of(self, replica_id: int) -> tuple[ReplicaGroup, int]:
+        return self.by_replica[replica_id]
+
+
+def build_writer_groups(
+    sessions: list[Session], writers: int,
+) -> tuple[list[Session], GroupTable]:
+    """Expand logical sessions into replica sessions and the group
+    table.  Blocks are attached later (:func:`attach_turn_blocks`) —
+    the turn split needs the COALESCED op count, which only exists
+    after ``prepare_streams`` tensorizes the traces."""
+    replica_sessions = replicate_sessions(sessions, writers)
+    groups = [
+        ReplicaGroup(
+            logical_id=s.doc_id,
+            writers=writers,
+            replica_ids=tuple(
+                s.doc_id * writers + w for w in range(writers)
+            ),
+        )
+        for s in sessions
+    ]
+    return replica_sessions, GroupTable(groups)
+
+
+def attach_turn_blocks(table: GroupTable, streams, turn_ops: int) -> None:
+    """Compute every group's turn split from the tensorized stream
+    lengths (identical across a group's replicas — they share the
+    trace).  Deterministic: recovery rebuilds the same split from the
+    workload alone."""
+    for g in table.groups:
+        st = streams[g.replica_ids[0]]
+        g.n_ops = st.n_total
+        g.blocks = split_turns(g.n_ops, g.writers, turn_ops)

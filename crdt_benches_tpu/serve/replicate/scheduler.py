@@ -1,0 +1,272 @@
+"""The replicated fleet scheduler: macro-rounds over writer groups.
+
+``ReplicatedScheduler`` is the :class:`serve.scheduler.FleetScheduler`
+with one substitution: **delivery is owned by the broadcast bus**.  A
+replica's stream is the group's full assembled op sequence (shared
+arrays), but the scheduler may only stage ops up to the replica's
+sequence-keyed assembled prefix — the bus's delivery point — so a
+partitioned or lagging replica simply waits while its writer-group
+peers keep serving, and catches up when the backlog flushes.  Remote
+(peer-authored) ops reach the device through the SAME macro dispatch as
+local ones — the batched downstream merge happens inside the macro
+scan (``engine/merge_fleet.py merge_rows_body`` for the scan kernel,
+its parity-pinned fused twin otherwise), so remote-apply stays
+device-resident and never adds a sync boundary: the bus is pure host
+bookkeeping inside the sanitized hot scope.
+
+Everything else — capacity classes, promotion, eviction/restore through
+the checkpoint spool, the WAL, snapshot barriers, chaos recovery,
+degradation — applies to replica rows unchanged: **replica rows are
+pool rows**.  The scheduler adds the replication telemetry on top:
+per-class remote-merge counters, the divergence-depth gauge, broadcast
+fan-out accounting (``obs/shard.py ReplicaMetrics``), and the two
+replication chaos hooks (``replica_partition`` / ``merge_reorder``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...obs.shard import ReplicaMetrics
+from ..scheduler import FleetScheduler, _Plan
+from .broadcast import BroadcastBus
+from .group import GroupTable, attach_turn_blocks
+
+#: Idle-round safety bound: the planner advances the round clock while
+#: waiting on bus delivery (partition spans, in-flight lag); a backlog
+#: that never drains within this many consecutive idle rounds is a bug,
+#: not a wait.
+IDLE_ROUND_LIMIT = 100_000
+
+#: Default partition span (rounds until heal) when the fault event
+#: carries no explicit ``param``.
+DEFAULT_PARTITION_SPAN = 3
+
+
+class ReplicatedScheduler(FleetScheduler):
+    def __init__(
+        self,
+        pool,
+        streams,
+        table: GroupTable,
+        *,
+        turn_ops: int = 64,
+        pub_ops: int | None = None,
+        remote_lag: int = 1,
+        history_sample: int = 16,
+        seed: int = 0,
+        **kw,
+    ):
+        super().__init__(pool, streams, **kw)
+        self.table = table
+        self.turn_ops = turn_ops
+        attach_turn_blocks(table, streams, turn_ops)
+        # RA-checker history sampling: a seeded spread over the logical
+        # docs (recording every group's history would hold one event
+        # per delivered block per replica — sampled is the contract)
+        gids = sorted(g.logical_id for g in table)
+        rng = np.random.default_rng(seed + 2)
+        n_hist = min(history_sample, len(gids))
+        sample = {
+            int(g) for g in rng.choice(gids, size=n_hist, replace=False)
+        } if n_hist else set()
+        self.replica_metrics = ReplicaMetrics(
+            self.stats.metrics, pool.classes
+        )
+        self.bus = BroadcastBus(
+            table,
+            pub_ops=pub_ops or self.batch * self.macro_k,
+            op_nbytes=sum(dt.itemsize for dt in pool.op_dtypes),
+            remote_lag=remote_lag,
+            journal=self.journal,
+            metrics=self.replica_metrics,
+            history_groups=sample,
+        )
+        # bus-owned delivery: every replica starts with an empty
+        # assembled prefix, whatever queue_cap said
+        for st in streams.values():
+            st.delivered = 0
+        self.merged_ops = 0
+        self.merged_unit_ops = 0
+        self.local_ops = 0
+        self._idle_rounds = 0
+
+    # ---- bus integration ----
+
+    def _fire_replication_faults(self) -> None:
+        """Poll the two replication chaos hooks at the bus tick (the
+        same fixed-point discipline as the other injector hooks)."""
+        ev = self.faults.partition_event(self.round)
+        if ev is not None:
+            targets = self.bus.live_partition_targets()
+            if targets:
+                gid, w = targets[
+                    self.faults.pick(list(range(len(targets))))
+                ]
+                span = ev.param or DEFAULT_PARTITION_SPAN
+                heal = self.round + span
+                self.bus.start_partition(gid, w, heal, event=ev)
+                ev.fire(self.round, group=gid, writer=w,
+                        heal_round=heal)
+                self.stats.faults_injected += 1
+                self._note_fault()
+        ev = self.faults.reorder_event(self.round)
+        if ev is not None and self.bus._reorder is None:
+            # armed now, fires at the next tick that actually delivers
+            # remote batches (the permutation needs traffic to permute)
+            self.bus.arm_reorder(self.faults.rng, ev)
+            self.stats.faults_injected += 1
+            self._note_fault()
+
+    def _deliver(self, st) -> None:
+        """Bus-owned delivery: the replica's schedulable window is its
+        assembled broadcast prefix (monotone by construction)."""
+        got = self.bus.delivered_ops(st.doc_id)
+        if st.delivered is None or got > st.delivered:
+            st.delivered = got
+
+    def _plan(self) -> _Plan | None:
+        """The base planner with the bus tick folded into the round
+        loop: publish/deliver for this round, select, and — when no
+        lane could be staged — advance the clock over arrival gaps AND
+        bus waits (in-flight deliveries, partition spans)."""
+        while True:
+            self._k_round = self.effective_k
+            self._planned_degraded = self._degrade_left > 0
+            if self.faults is not None:
+                self._fire_replication_faults()
+            self.bus.tick(self.round)
+            plan = _Plan(base_round=self.round)
+            self._select(plan)
+            if plan.lanes:
+                self._idle_rounds = 0
+                self._place(plan)
+                return plan
+            pending = [
+                s.arrival for s in self.streams.values()
+                if s.remaining and s.arrival > self.round
+            ]
+            if pending:
+                self.round = min(pending)
+                continue
+            if self.bus.pending_work():
+                self._idle_rounds += 1
+                if self._idle_rounds > IDLE_ROUND_LIMIT:
+                    raise RuntimeError(
+                        "replicated scheduler: broadcast backlog never "
+                        f"drained after {IDLE_ROUND_LIMIT} idle rounds"
+                    )
+                self.round += 1
+                continue
+            return None
+
+    def _advance(self, plan: _Plan) -> None:
+        """Remote-merge attribution BEFORE the base class advances the
+        cursors: every staged slice's ops split into the writer's own
+        (upstream) share and the peers' broadcast (downstream-merge)
+        share, counted under the landing capacity class."""
+        for cls, lanes in plan.lanes.items():
+            for lane in lanes:
+                st = lane.stream
+                if st.doc_id in self._dead_lanes:
+                    continue
+                g, w = self.table.group_of(st.doc_id)
+                rem_ops = 0
+                rem_units = 0
+                for a, b in g.remote_intervals(w, st.cursor, lane.end):
+                    rem_ops += b - a
+                    rem_units += (
+                        st.units_before(b) - st.units_before(a)
+                    )
+                loc = (lane.end - st.cursor) - rem_ops
+                if rem_ops:
+                    self.replica_metrics.note_merged(
+                        cls, rem_ops, rem_units
+                    )
+                    self.merged_ops += rem_ops
+                    self.merged_unit_ops += rem_units
+                if loc:
+                    self.replica_metrics.note_local(loc)
+                    self.local_ops += loc
+        super()._advance(plan)
+
+    def resync_delivery(self) -> None:
+        """Re-derive every replica's delivery point from the bus (used
+        after crash recovery replays journaled broadcasts): the
+        assembled prefix must cover the restored cursor, and the
+        schedulable window resumes from it."""
+        for rid, st in self.streams.items():
+            g, _w = self.table.group_of(rid)
+            if st.cursor > 0 and g.blocks:
+                turn = g.blocks[0][1] - g.blocks[0][0]
+                need = min(-(-st.cursor // turn), g.n_blocks)
+                # the WAL ordering guarantees surviving lane records
+                # are covered by surviving bcast records; covering the
+                # cursor from the split directly is the torn-tail
+                # fallback (the split is deterministic workload data).
+                # A block forced below ``published`` here was published
+                # pre-crash, so it must reach EVERY replica — marking
+                # only the cursor's writer would strand its peers below
+                # the head forever (nothing ever re-publishes a block
+                # below ``published``) and livelock the resumed drain.
+                for seq in range(need):
+                    self.bus.force_delivered(g.logical_id, seq)
+        self.bus.settle_prefixes()
+        for rid, st in self.streams.items():
+            st.delivered = self.bus.delivered_ops(rid)
+
+    # ---- reporting ----
+
+    def replication_block(self) -> dict:
+        """The artifact's ``replication`` block: topology, merge load,
+        fan-out, divergence and convergence-window numbers."""
+        conv = self.bus.convergence_rounds()
+        return {
+            "version": 1,
+            "writers": self.table.groups[0].writers if len(self.table)
+            else 0,
+            "groups": len(self.table),
+            "turn_ops": self.turn_ops,
+            "remote_lag": self.bus.remote_lag,
+            "pub_ops": self.bus.pub_ops,
+            "merged_ops": self.merged_ops,
+            "merged_unit_ops": self.merged_unit_ops,
+            "local_ops": self.local_ops,
+            "broadcast_blocks": self.bus.blocks_published,
+            "broadcast_deliveries": self.bus.blocks_delivered_remote,
+            "broadcast_bytes": self.bus.bytes_broadcast,
+            "divergence_depth_max": self.bus.divergence_max,
+            "partitions_healed": self.bus.partitions_healed,
+            "reordered_rounds": self.bus.reordered_rounds,
+            "convergence_rounds_max": max(conv) if conv else 0,
+            "convergence_rounds_mean": (
+                sum(conv) / len(conv) if conv else 0.0
+            ),
+            "history_groups": sorted(self.bus.histories),
+        }
+
+
+def recover_replicated_fleet(
+    pool, streams, table: GroupTable, journal_dir: str, *,
+    journal=None, **sched_kw,
+):
+    """Crash recovery for a replicated fleet: restore pool/cursor state
+    from the newest intact snapshot + WAL tail (``journal.recover_fleet``
+    — replica rows ARE pool rows, so the plain recovery applies
+    verbatim), rebuild the broadcast bus from the journaled ``bcast``
+    records, and return a fresh :class:`ReplicatedScheduler` whose
+    resumed drain replays the redo tail through the normal macro path
+    to a CONVERGENT state.  Returns ``(scheduler, recovery_report,
+    blocks_replayed)``."""
+    from ..journal import read_journal, recover_fleet
+    from .broadcast import replay_journal_broadcasts
+
+    report = recover_fleet(pool, streams, journal_dir)
+    records, _ = read_journal(journal_dir)
+    sched = ReplicatedScheduler(
+        pool, streams, table, journal=journal,
+        start_round=report.resume_round, **sched_kw,
+    )
+    replayed = replay_journal_broadcasts(sched.bus, records)
+    sched.resync_delivery()
+    return sched, report, replayed

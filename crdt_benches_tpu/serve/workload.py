@@ -103,6 +103,64 @@ class Session:
     burst: int | None = None  # producer delivery rate (ops/round)
 
 
+# ---- multi-writer splitting (serve/replicate/) -----------------------------
+
+
+def split_turns(n_ops: int, writers: int,
+                turn_ops: int) -> list[tuple[int, int, int]]:
+    """Partition a doc's op stream ``[0, n_ops)`` into contiguous
+    **turn blocks** of up to ``turn_ops`` coalesced range ops, block
+    ``j`` owned by writer ``j % writers`` — the round-robin authorship
+    rotation the replication subsystem uses to turn one workload stream
+    into W concurrent writers.  Returns ``[(lo, hi, writer), ...]`` in
+    **sequence order**: block ``j`` covers ops ``[lo, hi)`` and the
+    blocks concatenate back to exactly the original stream, so the
+    group's arbitration order (ascending block sequence) reproduces the
+    sequential oracle interleaving byte-for-byte.
+
+    Deterministic and purely arithmetic: the same (n_ops, writers,
+    turn_ops) always yields the same split — which is what makes a
+    crashed replicated fleet recoverable from the workload alone."""
+    if writers < 1:
+        raise ValueError(f"writers must be >= 1, got {writers}")
+    if turn_ops < 1:
+        raise ValueError(f"turn_ops must be >= 1, got {turn_ops}")
+    blocks: list[tuple[int, int, int]] = []
+    lo = 0
+    seq = 0
+    while lo < n_ops:
+        hi = min(lo + turn_ops, n_ops)
+        blocks.append((lo, hi, seq % writers))
+        lo = hi
+        seq += 1
+    return blocks
+
+
+def replicate_sessions(
+    sessions: "list[Session]", writers: int,
+) -> "list[Session]":
+    """Expand every logical session into ``writers`` replica sessions —
+    one pool document per replica, dense doc ids ``logical * W + w``
+    (writer ``w``'s replica of logical doc ``logical``).  Replicas
+    share the SAME trace object, so ``prepare_streams``'s per-trace
+    cache tensorizes each stream once and the replicas differ only in
+    cursor/delivery state; they also share the logical session's
+    arrival round (a writer group joins the fleet together).  The
+    producer ``burst`` is dropped — delivery pacing belongs to the
+    broadcast bus in replicated mode, not the banded producer model."""
+    if writers < 1:
+        raise ValueError(f"writers must be >= 1, got {writers}")
+    out: list[Session] = []
+    for s in sessions:
+        for w in range(writers):
+            out.append(Session(
+                doc_id=s.doc_id * writers + w,
+                band=s.band, source=s.source, trace=s.trace,
+                arrival=s.arrival, burst=None,
+            ))
+    return out
+
+
 @functools.lru_cache(maxsize=8)
 def _full_trace(name: str) -> TestData:
     return load_testing_data(name)

@@ -1,0 +1,36 @@
+"""Seeded G002: the replicated merge dispatch's sync discipline.
+
+``replicated_round`` is the hot root (the serve/replicate/ macro-round
+shape: bus tick -> stage -> one merge dispatch).  The broadcast bus is
+HOST bookkeeping — reading a device counter inside the tick
+(``.item()``) or snapshotting replica state during staging
+(``np.asarray``) is exactly the stray sync that would break the PR 2/
+PR 8 fence model when remote-apply joined the scan.  The declared
+``_drain_fence`` shows the sanctioned boundary: syncs live behind a
+``# graftlint: fence`` function, nowhere else.
+"""
+
+import numpy as np
+
+
+def _bus_tick(bus, nvis):
+    head = bus.published
+    depth = nvis.sum().item()  # expect: G002
+    return head - depth
+
+
+def _stage_remote(state, lanes):
+    view = np.asarray(state.doc)  # expect: G002
+    return lanes, view
+
+
+def _drain_fence(state):  # graftlint: fence
+    # the sanctioned boundary: the final fence after the merge dispatch
+    state.doc.block_until_ready()
+
+
+def replicated_round(bus, state, lanes):  # graftlint: hot-path
+    lag = _bus_tick(bus, state.nvis)
+    staged, view = _stage_remote(state, lanes)
+    _drain_fence(state)
+    return lag, staged, view

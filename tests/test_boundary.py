@@ -151,6 +151,7 @@ def test_registry_covers_the_public_entry_points():
     import crdt_benches_tpu.engine.downstream  # noqa: F401
     import crdt_benches_tpu.engine.downstream_range  # noqa: F401
     import crdt_benches_tpu.engine.merge  # noqa: F401
+    import crdt_benches_tpu.engine.merge_fleet  # noqa: F401
     import crdt_benches_tpu.engine.merge_range  # noqa: F401
     import crdt_benches_tpu.engine.replay  # noqa: F401
     import crdt_benches_tpu.engine.replay_range  # noqa: F401
@@ -159,6 +160,8 @@ def test_registry_covers_the_public_entry_points():
     expected = {
         "crdt_benches_tpu.serve.pool.fleet_step",
         "crdt_benches_tpu.serve.pool.DocPool.macro_step",
+        "crdt_benches_tpu.engine.merge_fleet.merge_rows_round",
+        "crdt_benches_tpu.engine.merge_fleet.merge_rows_macro",
         "crdt_benches_tpu.ops.apply2.apply_batch3",
         "crdt_benches_tpu.ops.apply_range.apply_range_batch",
         "crdt_benches_tpu.ops.resolve.resolve_batch",

@@ -74,6 +74,22 @@ def test_fixture_flagged_exactly(path: Path):
     )
 
 
+def test_replicate_merge_dispatch_fixture_covers_g002():
+    """The replicated-merge-dispatch fixture (the serve/replicate/
+    macro-round shape: bus tick -> stage -> merge dispatch) must seed
+    exactly two G002 host syncs — a device read inside the bus tick and
+    a state snapshot during remote staging — while the declared
+    ``_drain_fence`` stays clean.  Guards the new subsystem's "the bus
+    is host-only, syncs live behind fences" invariant at the rule
+    level."""
+    path = FIXTURES / "serve" / "g002_replicate.py"
+    findings = run_lint([str(path)])
+    got = {(f.rule, f.line) for f in findings}
+    assert got == expected_markers(path)
+    assert {f.rule for f in findings} == {"G002"}
+    assert len(findings) == 2
+
+
 def test_serve_fused_kernel_fixture_covers_both_pallas_rules():
     """The fused-serve-kernel fixture (a minimized copy of
     ops/serve_fused.py serve_macro_fused's launch geometry) must seed

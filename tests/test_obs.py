@@ -427,3 +427,27 @@ def test_bench_compare_skips_missing_blocks(tmp_path):
     bogus = tmp_path / "bogus.json"
     bogus.write_text(json.dumps([{"group": "upstream", "extra": {}}]))
     assert bc.main([str(bogus), base]) == 2
+
+
+def test_bench_compare_tolerates_replication_blocks(tmp_path, capsys):
+    """A serve/replicate/ artifact (family serve-repl, with the
+    replication + convergence blocks) diffed against a pre-replication
+    baseline must report the one-sided blocks as skip-with-note and
+    NEVER exit 2 — a new baseline is not required to start
+    replicating."""
+    bc = _bench_compare()
+    base = _artifact(tmp_path, "base.json")
+    repl = json.loads(Path(base).read_text())
+    repl[0]["extra"]["family"] = "serve-repl"
+    repl[0]["extra"]["replication"] = {
+        "version": 1, "writers": 4, "merged_ops": 123,
+        "broadcast_bytes": 4096,
+    }
+    repl[0]["extra"]["convergence"] = {"converged": True, "ra_ok": True}
+    p = tmp_path / "repl.json"
+    p.write_text(json.dumps(repl))
+    assert bc.main([str(p), base]) == 0
+    out = capsys.readouterr().out
+    assert "replication block" in out and "SKIP" in out
+    # and symmetric: plain new run vs a replicated baseline
+    assert bc.main([base, str(p)]) == 0

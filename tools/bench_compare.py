@@ -77,7 +77,9 @@ def load_serve_extra(path: str) -> dict:
         raise ValueError(f"{path}: not a bench result list")
     for entry in data:
         extra = entry.get("extra") if isinstance(entry, dict) else None
-        if isinstance(extra, dict) and extra.get("family") == "serve":
+        if isinstance(extra, dict) and extra.get("family") in (
+            "serve", "serve-repl",
+        ):
             return extra
     raise ValueError(f"{path}: no serve-family result found")
 
@@ -118,7 +120,11 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: Artifact blocks newer runs may carry that older baselines will not
 #: (obs/ v2).  One-sided presence is a schema difference, not a
 #: regression: it becomes a "skip" line with a note, never an error.
-_OPTIONAL_BLOCKS = ("timeseries", "anomalies")
+#: ``replication`` / ``convergence`` are the serve/replicate/ blocks —
+#: a replicated run diffed against a pre-replication baseline (or a
+#: plain run against a replicated one) must also diff cleanly.
+_OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
+                    "convergence")
 
 
 def _window_floor(extra: dict) -> float | None:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve|serve-faults|serve-soak]   (repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak]   (repo root)
 #
 # The serve family (the default) drains a tiny document fleet through the
 # macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
@@ -137,6 +137,45 @@ case "$family" in
       bench_results/serve_smoke_telemetry.json bench_results/serve_smoke.json \
       --max-throughput-regress 15
     ;;
+  serve-repl)
+    # Replication smoke: a small fleet of 2-writer groups drained
+    # through the broadcast bus + batched downstream merge.  The runner
+    # exits NONZERO when any replica diverges from the oracle (full-
+    # fleet convergence, not a sample) or when the RA-linearizability
+    # checker finds a visibility-axiom violation — the new verification
+    # tier IS the gate.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 12 --serve-writers 2 --serve-mix mixed \
+        --serve-batch 16 --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-turn-ops 16 \
+        --serve-save-name serve_repl_smoke
+    # Schema tolerance: the replicated artifact diffed against ITSELF
+    # must pass every check (exit 0, never 2) — and the repl-only
+    # blocks (replication / convergence) ride the same skip-with-note
+    # path bench_compare gives obs/ v2 blocks, so a plain pre-
+    # replication baseline also diffs cleanly (covered by tests).
+    python tools/bench_compare.py \
+      bench_results/serve_repl_smoke.json \
+      bench_results/serve_repl_smoke.json
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_repl_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve-repl"]
+x = extras[0]
+rb, conv = x["replication"], x["convergence"]
+assert x["verify_ok"] and x["ra_ok"], (x["verify_ok"], x["ra_ok"])
+assert conv["replicas_checked"] == 2 * x["fleet_docs"], conv
+assert rb["merged_ops"] > 0 and rb["broadcast_bytes"] > 0, rb
+assert rb["divergence_depth_max"] >= 1, rb
+print(f"repl smoke: {conv['replicas_checked']} replicas converged, "
+      f"{rb['merged_ops']} remote ops merged over "
+      f"{rb['broadcast_bytes']} broadcast bytes, RA axioms ok on "
+      f"{conv['ra_groups_checked']} sampled histories")
+PYEOF
+    ;;
   serve-faults)
     # Chaos smoke under the soak detectors: the pinned late-round stall
     # (800ms against a 250ms watchdog) MUST trip the stuck-round
@@ -156,7 +195,7 @@ case "$family" in
         --serve-faults "seed=5,span=5,stall_ms=800,spool_corrupt=1,device_loss=1,queue_overflow=1,dup_batch=1,stall@7=1" \
         --serve-soak 0 --serve-watchdog 0.25 \
         --serve-save-name serve_faults_smoke
-    exec python - <<'PYEOF'
+    python - <<'PYEOF'
 import json
 extras = [e["extra"] for e in json.load(open("bench_results/serve_faults_smoke.json"))
           if e.get("extra", {}).get("family") == "serve"]
@@ -167,6 +206,39 @@ assert all(e["cleared"] for e in stuck), f"watchdog never cleared: {stuck}"
 assert an["uncleared"] == 0, an
 print(f"chaos smoke: stall -> stuck_round at round {stuck[0]['round']} "
       f"-> cleared at round {stuck[0]['cleared_round']}")
+PYEOF
+    # Replicated chaos leg: the two replication fault kinds against a
+    # 2-writer fleet with the WAL + snapshot barriers armed.  A
+    # replica_partition must fire, diverge a replica, and RECONVERGE on
+    # heal; a merge_reorder must deliver a round's remote batches
+    # permuted and stay verify-green (sequence-keyed reassembly
+    # commutes).  The runner exits nonzero on a convergence/RA-checker
+    # failure or any unfired/unrecovered fault.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 12 --serve-writers 2 --serve-mix mixed \
+        --serve-batch 16 --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-turn-ops 16 \
+        --serve-journal auto --serve-snapshot-every 4 \
+        --serve-faults "seed=7,span=4,replica_partition=1,merge_reorder=1" \
+        --serve-save-name serve_repl_faults_smoke
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_repl_faults_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve-repl"]
+x = extras[0]
+f = x["faults"]
+kinds = {e["kind"]: e for e in f["events"]}
+assert kinds["replica_partition"]["fired"] and kinds["replica_partition"]["recovered"], f
+assert kinds["merge_reorder"]["fired"] and kinds["merge_reorder"]["recovered"], f
+assert x["verify_ok"] and x["ra_ok"], (x["verify_ok"], x["ra_ok"])
+assert x["replication"]["partitions_healed"] >= 1, x["replication"]
+assert x["replication"]["reordered_rounds"] >= 1, x["replication"]
+print("repl chaos: partition fired+healed, reorder fired+commuted, "
+      f"divergence max {x['replication']['divergence_depth_max']} blocks, "
+      "all replicas reconverged")
 PYEOF
     ;;
   serve-soak)
@@ -241,7 +313,7 @@ print(f"soak: {ts['drains']} drain(s), {len(ts['windows'])} windows, 0 anomalies
 PYEOF
     ;;
   *)
-    echo "unknown family: $family (expected: serve, serve-faults, serve-soak)" >&2
+    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak)" >&2
     exit 2
     ;;
 esac
