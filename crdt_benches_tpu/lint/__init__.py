@@ -16,8 +16,17 @@ from .boundary import (  # noqa: F401
 from .core import (  # noqa: F401
     Finding,
     format_json,
+    format_sarif,
     format_text,
     run_lint,
+)
+from .race_sanitizer import (  # noqa: F401
+    SharedProxy,
+    UndeclaredCrossThreadAccess,
+    publish_point,
+    published,
+    reveal,
+    share,
 )
 from .sanitizer import (  # noqa: F401
     UndeclaredSyncError,
@@ -31,6 +40,8 @@ __all__ = [
     "REGISTRY",
     "BoundaryContract",
     "BoundaryError",
+    "SharedProxy",
+    "UndeclaredCrossThreadAccess",
     "UndeclaredSyncError",
     "boundary",
     "boundary_table",
@@ -38,9 +49,14 @@ __all__ = [
     "fence",
     "fenced",
     "hot_path",
+    "publish_point",
+    "published",
+    "reveal",
     "sanitizing",
+    "share",
     "Finding",
     "format_json",
+    "format_sarif",
     "format_text",
     "run_lint",
 ]

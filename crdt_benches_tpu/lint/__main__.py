@@ -1,11 +1,15 @@
 """graftlint CLI.
 
-    python -m crdt_benches_tpu.lint [paths...] [--format text|json]
+    python -m crdt_benches_tpu.lint [paths...] [--format text|json|sarif]
                                     [--select G001,G002] [--boundaries]
                                     [--changed] [--fix]
                                     [--sync-artifact bench.json]
+                                    [--thread-artifact bench.json]
 
-Exits nonzero when any finding survives suppression (CI gates on this).
+Exits nonzero when any finding survives suppression (CI gates on this);
+``--format sarif`` emits SARIF 2.1.0 for CI annotation surfaces with
+the SAME exit-code semantics (a reporter changes the rendering, never
+the gate).
 
 ``--changed`` lints only the .py files touched in the working tree
 (``git diff --name-only HEAD`` + untracked), the pre-commit fast path —
@@ -20,6 +24,12 @@ still fail the gate.
 ``boundary_syncs`` block is the runtime fence ground truth (dead
 declared fences / unattributed runtime fences become findings).
 
+``--thread-artifact`` is G017's twin: the artifact's
+``thread_crossings`` block (the race sanitizer's publish-point and
+cross-thread-access counters) is cross-checked against the static
+``# graftlint: publish`` markers — usually the same artifact file as
+``--sync-artifact``.
+
 ``--boundaries`` dumps the jit-boundary contract registry as JSON by
 importing the package modules that declare them (the only mode that
 imports anything heavy; plain linting is pure-AST and jax-free).
@@ -33,7 +43,7 @@ import os
 import subprocess
 import sys
 
-from .core import format_json, format_text, run_lint
+from .core import format_json, format_sarif, format_text, run_lint
 
 
 def changed_py_files() -> list[str] | None:
@@ -88,7 +98,9 @@ def main(argv: list[str] | None = None) -> int:
         "paths", nargs="*", default=["crdt_benches_tpu"],
         help="files or directories to lint (default: the package)",
     )
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+    )
     ap.add_argument(
         "--select", default="",
         help="comma-separated rule ids to run (default: all)",
@@ -104,6 +116,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--sync-artifact", default=None, metavar="JSON",
         help="serve bench artifact for the G011 fence-cost cross-check",
+    )
+    ap.add_argument(
+        "--thread-artifact", default=None, metavar="JSON",
+        help="serve bench artifact for the G017 publish-point "
+             "cross-check (thread_crossings block)",
     )
     ap.add_argument(
         "--boundaries", action="store_true",
@@ -156,10 +173,12 @@ def main(argv: list[str] | None = None) -> int:
         s.strip() for s in args.select.split(",") if s.strip()
     } or None
     findings = run_lint(
-        paths, select=select, sync_artifact=args.sync_artifact
+        paths, select=select, sync_artifact=args.sync_artifact,
+        thread_artifact=args.thread_artifact,
     )
     out = (
         format_json(findings) if args.format == "json"
+        else format_sarif(findings) if args.format == "sarif"
         else format_text(findings)
     )
     print(out)

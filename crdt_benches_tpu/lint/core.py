@@ -23,6 +23,22 @@ Marker comments (on the ``def`` line):
   (e.g. the scheduler's boundary bucket pulls): G002 does not descend
   into it.  Fences are the allowlist — a new sync belongs behind one, or
   it is a bug.
+- ``# graftlint: thread=<name>`` — the function (or, on a ``class``
+  line, every method of the class) is OWNED by that host thread
+  (``hot`` / ``status`` / ``bus`` / ``journal`` are the canonical
+  roots).  The thread-confinement rules (G014/G015, lint/threads.py)
+  propagate ownership along the call graph from these declarations;
+  a mutable object shared across two owners must cross at a publish
+  point.
+- ``# graftlint: publish`` (optionally ``publish=<tag>``) — the
+  function is a DECLARED cross-thread publish point: an atomic
+  reference swap (or lock-guarded section) that hands an object from
+  its owning thread to a reader thread.  The runtime twin
+  (lint/race_sanitizer.py ``@published``) counts its entries; G017
+  cross-validates the two like G011 does for fences.  A tag names the
+  armed surface the point rides (``publish=status`` crosses only when
+  the live status server runs) and scopes the dead-point accounting
+  to artifacts whose run armed it.
 
 Fence tags (``# graftlint: fence=<tag>``) scope the G011 dead-fence
 accounting against serve bench artifacts:
@@ -89,7 +105,8 @@ _SUPPRESS_FILE_RE = re.compile(
     r"#\s*graftlint:\s*disable-file=([A-Z0-9,\s]+)"
 )
 _MARKER_RE = re.compile(
-    r"#\s*graftlint:\s*(hot-path|fence)(?:=([a-z-]+))?\b"
+    r"#\s*graftlint:\s*(hot-path|fence|publish|thread)"
+    r"(?:=([a-zA-Z0-9_-]+))?\b"
 )
 
 #: Recognized ``fence=<tag>`` spellings (see module docstring).
@@ -136,6 +153,9 @@ class FuncInfo:
     hot: bool = False
     fence: bool = False
     fence_tag: str | None = None  # None | "chaos" | "journal" | "cold"
+    publish: bool = False  # declared cross-thread publish point
+    publish_tag: str | None = None  # armed-surface tag (e.g. "status")
+    thread: str | None = None  # declared owning thread (or class's)
 
     @property
     def params(self) -> list[str]:
@@ -157,6 +177,8 @@ class ModuleInfo:
         self.random_aliases: set[str] = set()  # stdlib random module
         self.imports: dict[str, str] = {}  # local name -> dotted source
         self.functions: dict[str, FuncInfo] = {}
+        self.class_threads: dict[str, str] = {}  # class -> thread marker
+        self.class_bases: dict[str, list[str]] = {}  # class -> base names
         self._scan_comments()
         self._scan_imports()
         self._scan_functions()
@@ -188,9 +210,14 @@ class ModuleInfo:
                     r.strip() for r in m.group(1).split(",") if r.strip()
                 )
 
-    def _marker(self, lineno: int) -> tuple[str, str | None] | None:
-        m = _MARKER_RE.search(self.comments.get(lineno, ""))
-        return (m.group(1), m.group(2)) if m else None
+    def _markers(self, lineno: int) -> list[tuple[str, str | None]]:
+        """All ``# graftlint: <marker>`` directives on one line (a def
+        line may carry several, e.g. ``publish=status`` + ``thread=hot``
+        — each with its own ``graftlint:`` prefix)."""
+        return [
+            (m.group(1), m.group(2))
+            for m in _MARKER_RE.finditer(self.comments.get(lineno, ""))
+        ]
 
     # -- imports -----------------------------------------------------------
 
@@ -222,6 +249,13 @@ class ModuleInfo:
         def visit(node, cls: str | None):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.ClassDef):
+                    self.class_bases[child.name] = [
+                        b for b in (dotted(e) for e in child.bases)
+                        if b is not None
+                    ]
+                    for kind, tag in self._markers(child.lineno):
+                        if kind == "thread" and tag:
+                            self.class_threads[child.name] = tag
                     visit(child, child.name)
                 elif isinstance(
                     child, (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -239,12 +273,19 @@ class ModuleInfo:
 
     def _func_info(self, node, qual: str, cls: str | None) -> FuncInfo:
         fi = FuncInfo(qualname=qual, node=node, module=self, cls=cls)
-        marker = self._marker(node.lineno)
-        if marker is not None:
-            kind, tag = marker
-            fi.hot = kind == "hot-path"
-            fi.fence = kind == "fence"
-            fi.fence_tag = tag if fi.fence else None
+        for kind, tag in self._markers(node.lineno):
+            if kind == "hot-path":
+                fi.hot = True
+            elif kind == "fence":
+                fi.fence = True
+                fi.fence_tag = tag
+            elif kind == "publish":
+                fi.publish = True
+                fi.publish_tag = tag
+            elif kind == "thread" and tag:
+                fi.thread = tag
+        if fi.thread is None and cls is not None:
+            fi.thread = self.class_threads.get(cls)
         for dec in node.decorator_list:
             self._parse_decorator(fi, dec)
         return fi
@@ -343,13 +384,75 @@ class PackageIndex:
     def __init__(self, modules: list[ModuleInfo]):
         self.modules = modules
         self.by_name: dict[str, list[FuncInfo]] = {}
+        self.methods: dict[str, dict[str, list[FuncInfo]]] = {}
+        # subclass edges by bare class name (suffix-matched bases, so
+        # `scheduler.FleetScheduler` links like `FleetScheduler`)
+        self.subclasses: dict[str, set[str]] = {}
+        self.bases: dict[str, set[str]] = {}  # reverse: class -> bases
         for m in modules:
             for fi in m.functions.values():
                 bare = fi.qualname.split(".")[-1]
                 self.by_name.setdefault(bare, []).append(fi)
+                if fi.cls:
+                    self.methods.setdefault(fi.cls, {}).setdefault(
+                        bare, []
+                    ).append(fi)
+            for cls, bases in m.class_bases.items():
+                for b in bases:
+                    self.subclasses.setdefault(
+                        b.split(".")[-1], set()
+                    ).add(cls)
+                    self.bases.setdefault(cls, set()).add(
+                        b.split(".")[-1]
+                    )
 
-    def resolve_call(self, call: ast.Call, fi: FuncInfo) -> list[FuncInfo]:
-        """Best-effort callee resolution (see module docstring)."""
+    def _descendants(self, cls: str) -> set[str]:
+        out: set[str] = set()
+        queue = [cls]
+        while queue:
+            c = queue.pop()
+            for sub in self.subclasses.get(c, ()):
+                if sub not in out:
+                    out.add(sub)
+                    queue.append(sub)
+        return out
+
+    def _ancestors(self, cls: str) -> list[str]:
+        out: list[str] = []
+        seen = {cls}
+        queue = [cls]
+        while queue:
+            for b in sorted(self.bases.get(queue.pop(), ())):
+                if b not in seen:
+                    seen.add(b)
+                    out.append(b)
+                    queue.append(b)
+        return out
+
+    def override_methods(self, cls: str, name: str) -> list[FuncInfo]:
+        """Every subclass override of ``cls.name`` in the index — a
+        ``self.m()`` call in a hot-path root dispatches to the override
+        when the subclass runs (ReplicatedScheduler's ``_plan`` /
+        ``_deliver`` bus tick), so the hot-path walks must cover them,
+        not just the statically enclosing class."""
+        out = []
+        for sub in sorted(self._descendants(cls)):
+            out.extend(self.methods.get(sub, {}).get(name, []))
+        return out
+
+    def resolve_call(self, call: ast.Call, fi: FuncInfo,
+                     strict: bool = False) -> list[FuncInfo]:
+        """Best-effort callee resolution (see module docstring).
+
+        ``strict=True`` keeps only the confident edges — same-module /
+        named-import functions and ``self.m()`` dispatch (subclass
+        overrides included) — and drops the any-receiver bare-name
+        fan-out.  The fan-out is tuned for recall (a missed host sync
+        is a silent stall, so G002 wants every plausible edge); thread-
+        ownership propagation needs precision instead — one generic
+        method name shared between a status handler and the scheduler
+        would fuse the two thread roots and mark half the package
+        bilaterally owned."""
         f = call.func
         if isinstance(f, ast.Name):
             m = fi.module
@@ -370,13 +473,66 @@ class PackageIndex:
                 if fi.cls:
                     own = fi.module.functions.get(f"{fi.cls}.{name}")
                     if own is not None:
-                        return [own]
-            if name in _GENERIC_METHODS:
+                        # the defining method PLUS every subclass
+                        # override virtual dispatch could select
+                        return [own] + self.override_methods(
+                            fi.cls, name
+                        )
+                    # inherited: `self.m()` where m lives on an
+                    # ancestor class — dispatch UP the hierarchy to
+                    # the defining method, then back down through the
+                    # overrides of the CALLING class (still a
+                    # confident edge: the receiver is self)
+                    for anc in self._ancestors(fi.cls):
+                        inherited = self.methods.get(anc, {}).get(name)
+                        if inherited:
+                            return list(inherited) + \
+                                self.override_methods(fi.cls, name)
+            if strict or name in _GENERIC_METHODS:
                 return []
             # obj.method(...): link every same-named package function —
             # conservative, fences/suppressions handle the rare FP.
             return self.by_name.get(name, [])
         return []
+
+
+def hot_roots(index: PackageIndex) -> list[FuncInfo]:
+    """The serving hot-path roots: ``# graftlint: hot-path`` markers
+    plus the built-in qualname set."""
+    return [
+        fi for m in index.modules for fi in m.functions.values()
+        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
+    ]
+
+
+def walk_hot_scope(index: PackageIndex, *, descend_fences: bool):
+    """THE hot-path call-graph walker shared by G002/G012/G013/G016:
+    yields ``(fi, chain)`` for every function reachable from the hot
+    roots via :meth:`PackageIndex.resolve_call` (subclass overrides of
+    ``self.m()`` dispatches included).  ``descend_fences=False`` is the
+    G002 shape (fences are declared sync boundaries, the walk stops at
+    them); the hygiene rules (G012/G013/G016) descend — being behind a
+    sync boundary does not make a mid-drain socket, a per-round series
+    registration, or a blocking wait acceptable."""
+    seen: set[int] = set()
+    queue: list[tuple[FuncInfo, str]] = [
+        (r, f"reached from {r.qualname}") for r in hot_roots(index)
+    ]
+    while queue:
+        fi, chain = queue.pop()
+        if id(fi) in seen:
+            continue
+        seen.add(id(fi))
+        if not descend_fences and fi.fence:
+            continue
+        yield fi, chain
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                for callee in index.resolve_call(node, fi):
+                    if id(callee) not in seen:
+                        queue.append(
+                            (callee, f"{chain} -> {callee.qualname}")
+                        )
 
 
 # ---------------------------------------------------------------------------
@@ -448,31 +604,50 @@ def build_index(paths: list[str]) -> tuple[PackageIndex, list[Finding]]:
     return PackageIndex(modules), errors
 
 
+#: Artifact-driven rules: rule id -> (keyword, CLI flag) of the runtime
+#: ground truth it cross-checks; without an artifact the rule is
+#: skipped (nothing to validate against), and explicitly selecting it
+#: without one is a G000 failure, never a silent no-op.
+ARTIFACT_RULES = {
+    "G011": ("sync_artifact", "--sync-artifact"),
+    "G017": ("thread_artifact", "--thread-artifact"),
+}
+
+
 def run_lint(paths: list[str], select: set[str] | None = None,
-             sync_artifact: str | None = None) -> list[Finding]:
+             sync_artifact: str | None = None,
+             thread_artifact: str | None = None) -> list[Finding]:
     """Run the rule suite over ``paths``.  ``sync_artifact`` names a
     serve bench artifact (or raw ``boundary_syncs`` JSON) to enable the
     G011 fence-cost cross-check — without it G011 is skipped (it has no
-    runtime ground truth to compare the static fence graph against)."""
+    runtime ground truth to compare the static fence graph against).
+    ``thread_artifact`` is the same for G017's ``thread_crossings``
+    publish-point cross-check (usually the same artifact file)."""
     from . import rules as _rules
 
+    artifacts = {
+        "sync_artifact": sync_artifact,
+        "thread_artifact": thread_artifact,
+    }
     index, findings = build_index(paths)
     for rule_id, fn in _rules.RULES.items():
         if select and rule_id not in select:
             continue
-        if rule_id == "G011":
-            if sync_artifact is not None:
-                findings.extend(fn(index, sync_artifact))
-            elif select and "G011" in select:
-                # explicitly selecting G011 with no ground truth must
-                # FAIL, not no-op: a dropped --sync-artifact in a CI
+        if rule_id in ARTIFACT_RULES:
+            kw, flag = ARTIFACT_RULES[rule_id]
+            artifact = artifacts[kw]
+            if artifact is not None:
+                findings.extend(fn(index, artifact))
+            elif select and rule_id in select:
+                # explicitly selecting the rule with no ground truth
+                # must FAIL, not no-op: a dropped artifact flag in a CI
                 # script would otherwise turn the gate permanently green
                 findings.append(Finding(
-                    rule="G000", path="<G011>", line=0, col=0,
+                    rule="G000", path=f"<{rule_id}>", line=0, col=0,
                     msg=(
-                        "G011 selected but no --sync-artifact given — "
-                        "the fence-cost check has no runtime counters "
-                        "to validate against"
+                        f"{rule_id} selected but no {flag} given — "
+                        "the cross-check has no runtime counters to "
+                        "validate against"
                     ),
                 ))
             continue
@@ -525,6 +700,48 @@ def format_json(findings: list[Finding]) -> str:
                 for f in findings
             ],
             "count": len(findings),
+        },
+        indent=2,
+    )
+
+
+def format_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 (the schema CI annotation surfaces ingest).  One
+    run, one result per finding; ``level`` is always ``error`` — the
+    exit-code gate treats every finding as fatal, SARIF must not paint
+    a softer picture.  Artifact-level findings carry line 0; SARIF
+    regions are 1-based, so those clamp to line 1."""
+    rules = sorted({f.rule for f in findings})
+    return json.dumps(
+        {
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "graftlint",
+                    "rules": [{"id": r} for r in rules],
+                }},
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.msg},
+                        "locations": [{
+                            "physicalLocation": {
+                                "artifactLocation": {"uri": f.path},
+                                "region": {
+                                    "startLine": max(1, f.line),
+                                    "startColumn": max(1, f.col + 1),
+                                },
+                            },
+                        }],
+                    }
+                    for f in findings
+                ],
+            }],
         },
         indent=2,
     )

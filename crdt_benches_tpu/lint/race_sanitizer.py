@@ -1,0 +1,321 @@
+"""Runtime race sanitizer: the dynamic half of the G014/G015 model.
+
+graftlint's thread-confinement rules (lint/threads.py) prove
+*statically* that every mutable object crossing host threads passes
+through a declared ``# graftlint: publish`` point — but the static
+model trusts the annotations.  This module supplies the runtime
+evidence, the same architecture as the G002 sync sanitizer:
+
+- every declared publish point routes through :func:`publish_point`
+  (usually via the :func:`published` decorator, keyed by the
+  function's ``__qualname__`` so runtime counters line up with the
+  static publish markers) and counts its **entries** — always, in
+  every mode, one lock-guarded dict increment per handoff;
+- with ``CRDT_BENCH_SANITIZE_RACES=1``, :func:`share` wraps the object
+  being handed over in a :class:`SharedProxy` — an ownership cell
+  remembering its **owner thread id**, its **publish generation**
+  (bumped at each declared publish), and the publish point that last
+  released it.  An access from another thread while the object is
+  UNPUBLISHED raises :class:`UndeclaredCrossThreadAccess` **at the
+  callsite**; so does any in-place mutation after publish THROUGH the
+  shared reference (owner or reader side — a published snapshot is
+  frozen by contract, exactly G015's two halves).  A mutation through
+  a bare alias the publisher retained is invisible to the proxy, so
+  each publish also fingerprints the snapshot (they are
+  JSON-serializable by contract — /status.json renders them) and every
+  legal cross-thread read re-verifies it: a torn publish raises at the
+  READ that observes it, attributed to its publish point.  Legal
+  cross-thread reads are counted against the publish point that made
+  them legal, giving per-point **crossing** counters;
+- the serve bench snapshots :func:`counters` into its artifact as the
+  ``thread_crossings`` block, and lint rule G017 cross-validates that
+  ground truth against the static publish markers (dead publish
+  points, unattributed crossings) — G011's mirror.
+
+Disarmed (the default), :func:`share` and :func:`reveal` return their
+argument unchanged — identity, asserted by tests like the ``@fenced``
+and span no-op paths — so the only cost anywhere is the publish-entry
+counter bump (a mutex-guarded dict store, gated <=5% by the smoke's
+race-sanitized leg).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+from contextlib import contextmanager
+
+_ENV = "CRDT_BENCH_SANITIZE_RACES"
+
+
+class UndeclaredCrossThreadAccess(RuntimeError):
+    """An object crossed host threads outside every declared publish
+    point (or was mutated after publish) — the static G014/G015
+    confinement model just met a counterexample."""
+
+
+_tls = threading.local()
+#: Publish-point entry counts — bumped in EVERY run (G017's ground
+#: truth), exactly like the sync sanitizer's fence entries.
+_publishes: dict[str, int] = {}
+#: Cross-thread accesses attributed to the publish point that made
+#: them legal — only populated while the sanitizer is armed (the
+#: proxies are what observe individual accesses).
+_crossings: dict[str, int] = {}
+#: Crossing bumps come from reader threads (the status server's
+#: handler pool), so unlike every other counter in lint/ they need a
+#: real mutex.  Publish bumps take it too: today one thread publishes,
+#: but the ROADMAP's prefetch/bus work adds publisher threads, and an
+#: uncounted bump (or a dict resize racing ``counters()``) would
+#: corrupt the very G017 ground truth this module exists to record.
+#: The critical section is one dict store — the race-sanitized smoke
+#: leg's <=5% overhead gate holds with it in place.
+_mu = threading.Lock()
+
+
+def sanitizing() -> bool:
+    """True when ``CRDT_BENCH_SANITIZE_RACES`` arms the sanitizer.
+    Read at every :func:`share` (not at import) so tests can flip it."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+def reset_counters() -> None:
+    """Zero both counter tables (each bench run owns its window)."""
+    with _mu:
+        _publishes.clear()
+        _crossings.clear()
+
+
+def counters() -> dict[str, dict[str, int]]:
+    """Snapshot: ``{"publishes": {point: n}, "crossings": {point: n}}``.
+    ``crossings`` is only populated while the sanitizer is armed."""
+    with _mu:
+        return {
+            "publishes": dict(sorted(_publishes.items())),
+            "crossings": dict(sorted(_crossings.items())),
+        }
+
+
+def _point_stack() -> list:
+    s = getattr(_tls, "points", None)
+    if s is None:
+        s = _tls.points = []
+    return s
+
+
+#: Receiver-mutating method names the proxy treats as writes.  This is
+#: THE canonical set: the static model (lint/threads.py
+#: MUTATOR_METHODS) derives from it, so the two halves of the
+#: G014/G015 model cannot drift apart.
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "update", "setdefault", "pop",
+    "popitem", "popleft", "appendleft", "clear", "add", "discard",
+    "remove", "sort", "reverse",
+})
+
+_SLOTS = ("_graft_target", "_graft_label", "_graft_owner",
+          "_graft_gen", "_graft_point", "_graft_fp")
+
+
+def _fingerprint(obj) -> str | None:
+    """Content fingerprint of a published snapshot, or None when the
+    object is not canonically serializable.  The snapshots this module
+    guards are JSON-serializable by contract (/status.json and the
+    Prometheus renderer consume them), so in practice every publish
+    gets one."""
+    try:
+        return json.dumps(obj, sort_keys=True, default=repr)
+    except Exception:
+        return None
+
+
+class SharedProxy:
+    """Ownership-tracking wrapper around one shared object.
+
+    Owner-thread accesses are free until the object is published; a
+    publish (inside a declared publish point) freezes it — further
+    in-place mutation from ANY thread raises — and licenses
+    cross-thread reads, each counted against the publish point.  An
+    unpublished cross-thread access raises at the callsite."""
+
+    __slots__ = _SLOTS
+
+    def __init__(self, target, label: str):
+        object.__setattr__(self, "_graft_target", target)
+        object.__setattr__(self, "_graft_label", label)
+        object.__setattr__(self, "_graft_owner", threading.get_ident())
+        object.__setattr__(self, "_graft_gen", 0)
+        object.__setattr__(self, "_graft_point", None)
+        object.__setattr__(self, "_graft_fp", None)
+
+    # -- the access rule --
+
+    def _graft_check(self, mutate: bool, what: str) -> None:
+        tid = threading.get_ident()
+        gen = self._graft_gen
+        if tid == self._graft_owner:
+            if mutate and gen:
+                raise UndeclaredCrossThreadAccess(
+                    f"owner mutation `{what}` of `{self._graft_label}` "
+                    f"AFTER publish (generation {gen}, via "
+                    f"`{self._graft_point}`): a published object is "
+                    "frozen — readers on other threads may hold it; "
+                    "build a fresh object and publish that instead "
+                    f"({_ENV}=1)"
+                )
+            return
+        if gen == 0:
+            raise UndeclaredCrossThreadAccess(
+                f"undeclared cross-thread access `{what}` to "
+                f"`{self._graft_label}` (owner thread "
+                f"{self._graft_owner}, reader thread {tid}): the "
+                "object never passed a declared publish point "
+                f"({_ENV}=1) — hand it over inside a "
+                "`# graftlint: publish` function"
+            )
+        if mutate:
+            raise UndeclaredCrossThreadAccess(
+                f"reader-side mutation `{what}` of published "
+                f"`{self._graft_label}` (thread {tid}): what crosses "
+                "a publish point is read-only on the far side — copy "
+                f"before mutating ({_ENV}=1)"
+            )
+        # torn-publish detection: the proxy cannot see a mutation made
+        # through a bare alias the publisher retained, but the
+        # fingerprint taken at publish can — verify it at every legal
+        # cross-thread read, so the tear raises at the read that would
+        # have observed it.
+        fp = self._graft_fp
+        if fp is not None and _fingerprint(self._graft_target) != fp:
+            raise UndeclaredCrossThreadAccess(
+                f"torn publish of `{self._graft_label}` observed at "
+                f"read `{what}` (thread {tid}, via "
+                f"`{self._graft_point}`): the snapshot changed after "
+                "its publish — the publisher mutated a retained bare "
+                "reference; a published object is frozen, build a "
+                f"fresh one and publish that instead ({_ENV}=1)"
+            )
+        point = self._graft_point
+        with _mu:
+            _crossings[point] = _crossings.get(point, 0) + 1
+
+    def _graft_publish(self, point: str) -> None:
+        object.__setattr__(self, "_graft_gen", self._graft_gen + 1)
+        object.__setattr__(self, "_graft_point", point)
+        object.__setattr__(self, "_graft_fp",
+                           _fingerprint(self._graft_target))
+
+    # -- forwarding surface --
+
+    def __getattr__(self, name):
+        self._graft_check(name in MUTATOR_METHODS, name)
+        return getattr(self._graft_target, name)
+
+    def __setattr__(self, name, value):
+        self._graft_check(True, f"set {name}")
+        setattr(self._graft_target, name, value)
+
+    def __getitem__(self, k):
+        self._graft_check(False, f"[{k!r}]")
+        return self._graft_target[k]
+
+    def __setitem__(self, k, v):
+        self._graft_check(True, f"[{k!r}] = ...")
+        self._graft_target[k] = v
+
+    def __delitem__(self, k):
+        self._graft_check(True, f"del [{k!r}]")
+        del self._graft_target[k]
+
+    def __iter__(self):
+        self._graft_check(False, "iter")
+        return iter(self._graft_target)
+
+    def __len__(self):
+        self._graft_check(False, "len")
+        return len(self._graft_target)
+
+    def __contains__(self, k):
+        self._graft_check(False, "in")
+        return k in self._graft_target
+
+    def __bool__(self):
+        self._graft_check(False, "bool")
+        return bool(self._graft_target)
+
+    def __repr__(self):
+        return (
+            f"SharedProxy({self._graft_label!r}, "
+            f"gen={self._graft_gen}, via={self._graft_point!r})"
+        )
+
+
+def share(obj, label: str | None = None):
+    """Wrap ``obj`` for cross-thread handoff.  Disarmed: returns
+    ``obj`` unchanged (identity — the zero-overhead contract).  Armed:
+    returns (or re-publishes) a :class:`SharedProxy`; when called
+    inside an active publish point the proxy's generation bumps and
+    the handoff is attributed to that point, otherwise the object
+    stays owner-confined until a publish releases it."""
+    if not sanitizing():
+        return obj
+    if isinstance(obj, SharedProxy):
+        proxy = obj
+    else:
+        proxy = SharedProxy(obj, label or type(obj).__name__)
+    stack = _point_stack()
+    if stack:
+        proxy._graft_publish(stack[-1])
+    return proxy
+
+
+def reveal(obj):
+    """The reader-side gate: check the cross-thread access (counted
+    against the licensing publish point; raises if unpublished) and
+    return the BARE object — callers hand it to code that needs the
+    real type (``json.dumps``, the Prometheus renderer).  Identity on
+    non-proxies, so disarmed paths pass straight through."""
+    if isinstance(obj, SharedProxy):
+        obj._graft_check(False, "reveal")
+        return obj._graft_target
+    return obj
+
+
+def generation(obj) -> int | None:
+    """The proxy's publish generation (None for bare objects)."""
+    if isinstance(obj, SharedProxy):
+        return obj._graft_gen
+    return None
+
+
+@contextmanager
+def publish_point(name: str):
+    """One declared publish-point entry: count it (always — the G017
+    ground truth), and while inside, every :func:`share` call is a
+    publish attributed to ``name``."""
+    with _mu:
+        _publishes[name] = _publishes.get(name, 0) + 1
+    stack = _point_stack()
+    stack.append(name)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def published(fn):
+    """Decorator form of :func:`publish_point`, keyed by
+    ``__qualname__`` so the runtime counter name equals the static
+    publish marker's qualname.  Goes on exactly the functions carrying
+    ``# graftlint: publish`` markers — G017 cross-checks that the two
+    sets agree."""
+    name = fn.__qualname__
+
+    @functools.wraps(fn)
+    def handoff(*args, **kwargs):
+        with publish_point(name):
+            return fn(*args, **kwargs)
+
+    return handoff

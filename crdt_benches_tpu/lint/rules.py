@@ -1,13 +1,16 @@
-"""graftlint rules G001-G013.
+"""graftlint rules G001-G017.
 
 Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
 registered in :data:`RULES`.  Every rule is motivated by a real hazard
 this repository has already hit (see README "Static analysis" for the
 rule table and the incident each one encodes).  G008 lives in
 :mod:`crdt_benches_tpu.lint.flow` (the interprocedural constant pass),
-G009/G010 in :mod:`crdt_benches_tpu.lint.pallas_rules`; G011 (below)
-cross-validates the static fence graph against a serve bench artifact's
-``boundary_syncs`` counters and only runs when the driver hands it one.
+G009/G010 in :mod:`crdt_benches_tpu.lint.pallas_rules`, the
+thread-confinement suite G014-G017 in
+:mod:`crdt_benches_tpu.lint.threads`; G011 (below) cross-validates the
+static fence graph against a serve bench artifact's ``boundary_syncs``
+counters and only runs when the driver hands it one (G017 does the
+same for the ``thread_crossings`` publish-point counters).
 """
 
 from __future__ import annotations
@@ -17,7 +20,6 @@ import json
 import os
 
 from .core import (
-    DEFAULT_HOT_ROOTS,
     DTYPE_NAMES,
     G005_DIRS,
     G006_DIRS,
@@ -26,9 +28,16 @@ from .core import (
     FuncInfo,
     PackageIndex,
     dotted,
+    walk_hot_scope,
 )
 from .flow import g008_shape_drift
 from .pallas_rules import g009_pallas_grid, g010_block_lane
+from .threads import (
+    g014_shared_escape,
+    g015_publish_discipline,
+    g016_blocking_hot_thread,
+    g017_thread_crossings,
+)
 
 _JNP_CREATORS = {
     "array", "zeros", "ones", "empty", "full", "arange", "linspace",
@@ -220,34 +229,15 @@ def _sync_findings(fi: FuncInfo, index: PackageIndex, chain: str
 
 def g002_host_sync(index: PackageIndex) -> list[Finding]:
     """Walk the call graph from the serving hot-path roots
-    (``# graftlint: hot-path`` markers + the built-in root set) and flag
+    (``# graftlint: hot-path`` markers + the built-in root set, with
+    ``self.m()`` dispatches covering subclass overrides — the
+    ReplicatedScheduler bus tick, not just the base planner) and flag
     host-synchronizing calls.  Functions marked ``# graftlint: fence``
     are DECLARED sync boundaries (the scheduler's bucket pulls, the
     drain fence): the walk does not descend into them."""
-    roots = [
-        fi for m in index.modules for fi in m.functions.values()
-        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
-    ]
     out: list[Finding] = []
-    seen: set[int] = set()
-    queue: list[tuple[FuncInfo, str]] = [
-        (r, f"reached from {r.qualname}") for r in roots
-    ]
-    while queue:
-        fi, chain = queue.pop()
-        if id(fi) in seen:
-            continue
-        seen.add(id(fi))
-        if fi.fence:
-            continue
+    for fi, chain in walk_hot_scope(index, descend_fences=False):
         out.extend(_sync_findings(fi, index, chain))
-        for node in ast.walk(fi.node):
-            if isinstance(node, ast.Call):
-                for callee in index.resolve_call(node, fi):
-                    if id(callee) not in seen and not callee.fence:
-                        queue.append(
-                            (callee, f"{chain} -> {callee.qualname}")
-                        )
     return out
 
 
@@ -668,25 +658,9 @@ def _load_boundary_syncs(path: str) -> tuple[dict | None, str | None]:
     """The ``boundary_syncs`` block of a serve bench artifact (a
     ``save_results`` list of BenchResult dicts) or of a raw JSON fixture.
     Returns (block, error)."""
-    try:
-        with open(path, encoding="utf-8") as fh:
-            data = json.load(fh)
-    except (OSError, ValueError) as e:
-        return None, f"unreadable sync artifact: {e}"
-    if isinstance(data, dict):
-        block = data.get("boundary_syncs")
-        return (block, None) if isinstance(block, dict) else (
-            None, "artifact has no boundary_syncs block"
-        )
-    if isinstance(data, list):
-        for entry in data:
-            extra = entry.get("extra") if isinstance(entry, dict) else None
-            if isinstance(extra, dict) and isinstance(
-                extra.get("boundary_syncs"), dict
-            ):
-                return extra["boundary_syncs"], None
-        return None, "artifact has no boundary_syncs block"
-    return None, "artifact is neither a result list nor a dict"
+    from .threads import load_artifact_block
+
+    return load_artifact_block(path, "boundary_syncs")
 
 
 def g011_fence_cost(index: PackageIndex, artifact_path: str
@@ -843,28 +817,9 @@ def g012_obs_hygiene(index: PackageIndex) -> list[Finding]:
     is a shared no-op and arming mid-drain would void that contract.
     Unlike G002 the walk DESCENDS into declared fences: naming
     discipline applies behind sync boundaries too."""
-    roots = [
-        fi for m in index.modules for fi in m.functions.values()
-        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
-    ]
     out: list[Finding] = []
-    seen: set[int] = set()
-    queue: list[tuple[FuncInfo, str]] = [
-        (r, f"reached from {r.qualname}") for r in roots
-    ]
-    while queue:
-        fi, chain = queue.pop()
-        if id(fi) in seen:
-            continue
-        seen.add(id(fi))
+    for fi, chain in walk_hot_scope(index, descend_fences=True):
         out.extend(_obs_findings(fi, chain))
-        for node in ast.walk(fi.node):
-            if isinstance(node, ast.Call):
-                for callee in index.resolve_call(node, fi):
-                    if id(callee) not in seen:
-                        queue.append(
-                            (callee, f"{chain} -> {callee.qualname}")
-                        )
     return out
 
 
@@ -968,32 +923,13 @@ def g013_status_isolation(index: PackageIndex) -> list[Finding]:
     G012 (and unlike G002) the walk DESCENDS into declared fences:
     being behind a sync boundary does not make a mid-drain socket or a
     per-round series registration acceptable."""
-    roots = [
-        fi for m in index.modules for fi in m.functions.values()
-        if fi.hot or fi.qualname in DEFAULT_HOT_ROOTS
-    ]
     out: list[Finding] = []
-    seen: set[int] = set()
-    queue: list[tuple[FuncInfo, str]] = [
-        (r, f"reached from {r.qualname}") for r in roots
-    ]
-    while queue:
-        fi, chain = queue.pop()
-        if id(fi) in seen:
-            continue
-        seen.add(id(fi))
+    for fi, chain in walk_hot_scope(index, descend_fences=True):
         for node in ast.walk(fi.node):
-            if not isinstance(node, ast.Call):
-                continue
-            finding = _g013_call_finding(fi, node, chain)
-            if finding is not None:
-                out.append(finding)
-                continue
-            for callee in index.resolve_call(node, fi):
-                if id(callee) not in seen:
-                    queue.append(
-                        (callee, f"{chain} -> {callee.qualname}")
-                    )
+            if isinstance(node, ast.Call):
+                finding = _g013_call_finding(fi, node, chain)
+                if finding is not None:
+                    out.append(finding)
     return out
 
 
@@ -1011,4 +947,8 @@ RULES = {
     "G011": g011_fence_cost,  # artifact-driven; see run_lint
     "G012": g012_obs_hygiene,
     "G013": g013_status_isolation,
+    "G014": g014_shared_escape,
+    "G015": g015_publish_discipline,
+    "G016": g016_blocking_hot_thread,
+    "G017": g017_thread_crossings,  # artifact-driven; see run_lint
 }

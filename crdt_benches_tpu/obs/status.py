@@ -26,6 +26,21 @@ attribute store each; CPython makes the reference swap atomic).  All
 socket work and rendering happens on the server's own threads against
 whatever snapshot is current.
 
+Concurrency contract (enforced by graftlint G014/G015 + the runtime
+race sanitizer, lint/threads.py + lint/race_sanitizer.py): the
+publisher methods are owned by the **hot** thread, the handler surface
+by the **status** threads, and the ONLY mutable state crossing between
+them — the status and metrics snapshots — crosses inside the two
+declared ``# graftlint: publish`` points below, as an atomic reference
+swap of an object the publisher never touches again.  Health is a
+single immutable ``(ok, reason)`` tuple swap for the same reason (two
+separate field stores could be observed torn).  Under
+``CRDT_BENCH_SANITIZE_RACES=1`` the snapshots become ownership-tracking
+proxies and any unpublished cross-thread access raises at its
+callsite; the per-point publish/crossing counters land in the serve
+artifact's ``thread_crossings`` block, which lint rule G017
+cross-checks against these annotations.
+
 A polling terminal view ships as the module CLI::
 
     python -m crdt_benches_tpu.obs.status --watch --url http://127.0.0.1:8787
@@ -41,6 +56,8 @@ import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from threading import Thread
+
+from ..lint.race_sanitizer import published, reveal, share
 
 # ---------------------------------------------------------------------------
 # Prometheus text exposition (format version 0.0.4)
@@ -146,7 +163,7 @@ def render_prometheus(metrics: dict) -> str:
 # ---------------------------------------------------------------------------
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(BaseHTTPRequestHandler):  # graftlint: thread=status
     server_version = "crdt-serve-status/1"
 
     def log_message(self, *args) -> None:  # no stderr chatter per scrape
@@ -197,8 +214,10 @@ class StatusServer:
         self.stale_after = stale_after
         self._status: dict = {}
         self._metrics: dict = {}
-        self._health_ok = True
-        self._health_reason = ""
+        # ONE immutable tuple, swapped atomically: a reader that raced
+        # two separate ok/reason stores could pair a new verdict with a
+        # stale reason (found by the G014/G015 audit, ISSUE 10)
+        self._health: tuple[bool, str] = (True, "")
         self._last_publish = time.monotonic()
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: Thread | None = None
@@ -235,33 +254,35 @@ class StatusServer:
 
     # ---- publisher side (hot path: reference swaps only) ----
 
-    def publish_status(self, snapshot: dict) -> None:
+    @published
+    def publish_status(self, snapshot: dict) -> None:  # graftlint: publish=status  # graftlint: thread=hot
         snapshot["ts"] = time.time()
-        self._status = snapshot
+        self._status = share(snapshot, "StatusServer.status")
         self._last_publish = time.monotonic()
 
-    def publish_metrics(self, metrics: dict) -> None:
-        self._metrics = metrics
+    @published
+    def publish_metrics(self, metrics: dict) -> None:  # graftlint: publish=status  # graftlint: thread=hot
+        self._metrics = share(metrics, "StatusServer.metrics")
 
-    def set_health(self, ok: bool, reason: str = "") -> None:
-        self._health_ok = ok
-        self._health_reason = reason
+    def set_health(self, ok: bool, reason: str = "") -> None:  # graftlint: thread=hot
+        self._health = (ok, reason)  # immutable tuple: atomic swap
 
     # ---- reader side (handler threads) ----
 
-    def status_snapshot(self) -> dict:
-        return self._status
+    def status_snapshot(self) -> dict:  # graftlint: thread=status
+        return reveal(self._status)
 
-    def metrics_snapshot(self) -> dict:
-        return self._metrics
+    def metrics_snapshot(self) -> dict:  # graftlint: thread=status
+        return reveal(self._metrics)
 
-    def health(self) -> tuple[bool, str]:
+    def health(self) -> tuple[bool, str]:  # graftlint: thread=status
         if self.stale_after is not None:
             silent = time.monotonic() - self._last_publish
             if silent > self.stale_after:
                 return False, f"stale: no publish for {silent:.1f}s"
-        if not self._health_ok:
-            return False, self._health_reason or "anomaly active"
+        ok, reason = self._health
+        if not ok:
+            return False, reason or "anomaly active"
         return True, ""
 
 

@@ -28,6 +28,15 @@ continuous series across many fleet drains.
 Hot-path discipline (enforced by graftlint G013): everything called per
 round here is pure host arithmetic on pre-registered metric objects —
 no registry get-or-create, no socket/server work, no device traffic.
+
+Thread confinement (enforced by graftlint G014-G016 + the runtime race
+sanitizer): both classes here are owned by the **hot** thread — the
+recorder's ring, the delta baseline, and the facade's re-basing state
+are never touched from another thread.  The only state that leaves the
+hot thread is what :class:`ServeTelemetry` pushes through the status
+server's declared publish points (fresh ``to_dict()`` / status-field
+snapshots, never live objects); the status threads read those
+snapshots, never the recorder.
 """
 
 from __future__ import annotations
@@ -62,7 +71,7 @@ def read_rss_bytes() -> int | None:
                     else 4096)
 
 
-class TimeseriesRecorder:
+class TimeseriesRecorder:  # graftlint: thread=hot
     """Fold per-round samples into bounded, delta-encoded windows.
 
     One window = up to ``window_rounds`` macro-rounds: wall seconds,
@@ -213,7 +222,7 @@ class TimeseriesRecorder:
 
 
 @dataclass
-class ServeTelemetry:
+class ServeTelemetry:  # graftlint: thread=hot
     """The continuous-telemetry bundle one serve run threads through
     its scheduler(s).  Any piece may be None; a soak run shares one
     bundle across every drain it spins up."""

@@ -47,7 +47,7 @@ from ..bench.harness import (
     save_results,
     summarize,
 )
-from ..lint import sanitizer
+from ..lint import race_sanitizer, sanitizer
 from ..obs import trace as obs_trace
 from ..obs.anomaly import AnomalyDetector
 from ..obs.profiler import DeviceProfiler
@@ -256,6 +256,18 @@ def run_serve_bench(
     # close the journal, drop an owned journal dir, and release the
     # pool's spool directory (CI chaos runs must not leak temp dirs)
     try:
+        # publish-point / cross-thread counters must start counting
+        # BEFORE the first status publish (the note_phase below enters
+        # StatusServer.publish_status) — the artifact's thread_crossings
+        # block is G017's ground truth, so a reset after the fact would
+        # undercount the run's publishes; with CRDT_BENCH_SANITIZE_RACES=1
+        # the status snapshots become ownership-tracking proxies and an
+        # unpublished cross-thread access raises at its callsite
+        # (lint/race_sanitizer.py)
+        race_sanitizer.reset_counters()
+        race_sanitized = race_sanitizer.sanitizing()
+        if race_sanitized:
+            log("serve: race sanitizer ARMED (CRDT_BENCH_SANITIZE_RACES)")
         if telemetry is not None:
             telemetry.note_phase("building")  # staleness-clock heartbeat
         log(f"serve: building fleet n_docs={n_docs} mix={mix_name} seed={seed}")
@@ -456,6 +468,29 @@ def run_serve_bench(
                f"transfers" if sanitized else "")
         )
 
+        # ---- publish-point ground truth (lint G017 cross-checks the
+        # static thread-confinement model against exactly this block) ----
+        race_counts = race_sanitizer.counters()
+        thread_crossings = {
+            "sanitized": race_sanitized,
+            "status": (
+                telemetry is not None and telemetry.status is not None
+            ),
+            "publishes": race_counts["publishes"],
+            "crossings": (
+                race_counts["crossings"] if race_sanitized else None
+            ),
+        }
+        log(
+            "serve: thread crossings — publishes "
+            + (", ".join(
+                f"{k.split('.')[-1]}={v}"
+                for k, v in race_counts["publishes"].items()
+            ) or "none")
+            + (f"; {sum(race_counts['crossings'].values())} cross-thread "
+               "accesses attributed" if race_sanitized else "")
+        )
+
         occ = stats.occupancy.mean
         r = BenchResult(
             group="serve",
@@ -522,6 +557,7 @@ def run_serve_bench(
                 },
                 "faults": fault_summary,
                 "boundary_syncs": boundary_syncs,
+                "thread_crossings": thread_crossings,
                 # versioned typed-metric registry: every counter /
                 # gauge / histogram the drain emitted (obs/metrics.py)
                 "metrics": stats.metrics.to_dict(),

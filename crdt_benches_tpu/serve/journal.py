@@ -66,9 +66,18 @@ SNAP_PREFIX = "snap_"
 # ---------------------------------------------------------------------------
 
 
-class OpJournal:
+class OpJournal:  # graftlint: thread=hot
     """Append-only write-ahead journal.  One record per line:
     ``<crc32 of payload, 8 hex chars> <compact json payload>``.
+
+    Thread confinement (G014-G016 audit, ISSUE 10): the journal writer
+    is owned by the hot thread — WAL appends happen inside the
+    macro-round (write-ahead of dispatch) and recovery readers run
+    before a drain starts, on the same thread.  Nothing here may be
+    touched from the status/bus threads; when the tiered-residency
+    prefetch work moves journaling off-thread, the handoff must become
+    a declared publish point (a bounded queue), not shared file-handle
+    state.
 
     ``fsync=True`` makes every record durable before the append returns
     (the strict WAL discipline); the default leaves flushing to the OS —

@@ -44,7 +44,7 @@ from .group import GroupTable, ReplicaGroup
 
 
 @dataclass
-class _GroupState:
+class _GroupState:  # graftlint: thread=hot
     """Per-group bus state; index ``w`` = writer ``w``'s replica."""
 
     group: ReplicaGroup
@@ -73,11 +73,20 @@ class _GroupState:
         self.prefix[w] = p
 
 
-class BroadcastBus:
+class BroadcastBus:  # graftlint: thread=hot
     """Publish/deliver engine over a :class:`GroupTable` (see module
     docstring).  Host-only: no device arrays anywhere — the bus never
     syncs, so it lives inside the scheduler's sanitized hot scope
-    without a fence."""
+    without a fence.
+
+    Thread confinement (G014-G016 audit, ISSUE 10): the bus is owned by
+    the hot thread — the tick runs inside the macro-round, interleaved
+    with staging, and every ``_GroupState`` field (delivery bitmaps,
+    assembled prefixes, backlogs) is hot-confined.  The G002/G013
+    hot-path walks cover the tick through ``ReplicatedScheduler``'s
+    ``_plan``/``_deliver`` overrides (subclass-dispatch resolution,
+    this PR); a future off-thread bus must hand batches over through a
+    declared publish point."""
 
     def __init__(
         self,
@@ -170,7 +179,7 @@ class BroadcastBus:
         gs.advance_prefix(w)
         self._record(gid, w, rnd, seq)
         if remote:
-            lo, hi = gs.group.span(seq)
+            lo, hi = gs.group.block_span(seq)
             nbytes = (hi - lo) * self.op_nbytes
             self.blocks_delivered_remote += 1
             self.bytes_broadcast += nbytes

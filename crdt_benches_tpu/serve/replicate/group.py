@@ -41,7 +41,10 @@ class ReplicaGroup:
     def owner(self, seq: int) -> int:
         return self.blocks[seq][2]
 
-    def span(self, seq: int) -> tuple[int, int]:
+    def block_span(self, seq: int) -> tuple[int, int]:
+        # (named block_span, not span: the hot-path walks resolve
+        # attribute calls by bare name, and `span` is the obs tracer's
+        # G012-policed constant-name API)
         lo, hi, _w = self.blocks[seq]
         return lo, hi
 

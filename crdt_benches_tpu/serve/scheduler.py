@@ -1351,7 +1351,7 @@ class FleetScheduler:
 
     # ---- driver ----
 
-    def run_round(self) -> bool:
+    def run_round(self) -> bool:  # graftlint: thread=hot
         """One macro-round (plan -> WAL record -> stage -> boundary
         moves -> one async dispatch per class).  Returns False when no
         work remains.
@@ -1361,7 +1361,15 @@ class FleetScheduler:
         ``CRDT_BENCH_SANITIZE_SYNCS=1``): a host sync anywhere in here
         that is not behind a ``# graftlint: fence`` function raises at
         its exact callsite — the dynamic proof of the static G002
-        model.  Unarmed, the scope is a no-op."""
+        model.  Unarmed, the scope is a no-op.
+
+        The round is also the **hot thread root** of the
+        thread-confinement model (lint/threads.py, G014-G016): every
+        object it shares with the status threads crosses through the
+        status server's declared publish points as an immutable
+        snapshot swap — under ``CRDT_BENCH_SANITIZE_RACES=1`` an
+        unpublished cross-thread access raises the same way an
+        undeclared sync does."""
         with hot_path():
             if self.profiler is not None:
                 self.profiler.round_begin()

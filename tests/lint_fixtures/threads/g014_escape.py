@@ -1,0 +1,20 @@
+"""Seeded G014: a mutable dict written on the hot thread and read from
+the status thread, with no declared publish point anywhere on its write
+path — the minimized shape of the shared-mutable-escape hazard the
+thread-confinement audit polices (compare obs/status.py, where the same
+handoff rides a ``# graftlint: publish`` reference swap)."""
+
+
+class RoundStats:
+    def __init__(self):
+        # __init__ writes precede thread handoff: never a finding
+        self.latest = {}
+        self.rounds = 0
+
+    def record(self, rnd: int, patched: int) -> None:  # graftlint: thread=hot
+        # hot-confined scalar: only one owning thread, stays legal
+        self.rounds = rnd
+        self.latest["patched"] = patched  # expect: G014
+
+    def snapshot(self) -> dict:  # graftlint: thread=status
+        return dict(self.latest)

@@ -1,0 +1,34 @@
+"""Seeded G015: the publish-point contract broken all five ways — an
+in-place mutation INSIDE a declared publish point (readers can observe
+the half-applied state), a reader-thread mutation of an attribute it
+received through one (published snapshots are read-only on the far
+side), an OWNER-side mutation outside the publish point (readers may
+already hold the published reference), a far-side REASSIGNMENT of the
+published attribute (even an atomic swap races the publisher when a
+non-writer thread does it), and an OWNER-side reassignment to a fresh
+mutable object outside the publish point (the swap is atomic but the
+replacement carries no publish generation — the race sanitizer cannot
+track it)."""
+
+
+class SnapshotFeed:
+    def __init__(self):
+        self._snap = {}
+
+    def publish(self, snap: dict) -> None:  # graftlint: publish  # graftlint: thread=hot
+        self._snap = snap  # the legal atomic swap
+        self._snap["late_field"] = True  # expect: G015
+
+    def bump(self) -> None:  # graftlint: thread=hot
+        self._snap["n"] = 1  # expect: G015
+
+    def read(self) -> dict:  # graftlint: thread=status
+        got = self._snap
+        got["seen"] = True  # expect: G015
+        return got
+
+    def reset(self) -> None:  # graftlint: thread=status
+        self._snap = {}  # expect: G015
+
+    def clear(self) -> None:  # graftlint: thread=hot
+        self._snap = {}  # expect: G015

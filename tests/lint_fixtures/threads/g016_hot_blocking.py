@@ -1,0 +1,55 @@
+"""Seeded G016: blocking host primitives reachable from the serving
+hot path — a lock-guarded section and a bare ``acquire`` (the drain
+stalls behind whoever holds the lock), an unbounded stdlib-queue get,
+a bare event wait, and a thread join hiding INSIDE a declared fence
+(the G016 walk descends: a fence declares a device sync, not a license
+to wedge the drain).  Every hazard sits next to its legal twin: the
+non-blocking / bounded forms (``get_nowait``, positional timeouts,
+``acquire(blocking=False)``, ``wait(timeout=...)``) and a ``block``-
+named context manager that must NOT read as a lock."""
+
+import queue
+import threading
+
+_LOCK = threading.Lock()
+_INBOX = queue.Queue()
+
+
+_DONE = threading.Event()
+
+
+class _BlockCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_BLOCK_GUARD = _BlockCtx()
+
+
+def drain_round():  # graftlint: hot-path
+    with _LOCK:  # expect: G016
+        plan_next()
+    with _BLOCK_GUARD:  # "block" is not "lock": stays legal
+        pass
+    _INBOX.get()  # expect: G016
+    _INBOX.get_nowait()  # bounded: stays legal
+    _INBOX.get(True, 0.1)  # positional timeout: stays legal
+    _INBOX.put("x", False)  # positional block=False: stays legal
+    _DONE.wait()  # expect: G016
+    _DONE.wait(timeout=0.1)  # bounded: stays legal
+    boundary_pull()
+
+
+def plan_next():
+    _LOCK.acquire()  # expect: G016
+    if _LOCK.acquire(blocking=False):  # poll, never stalls: legal
+        _LOCK.release()
+    _LOCK.release()
+
+
+def boundary_pull():  # graftlint: fence
+    worker = threading.Thread()
+    worker.join()  # expect: G016

@@ -1,0 +1,30 @@
+"""Seeded G017 corpus (lints with ``threads/artifact.json`` as
+``--thread-artifact``; without an artifact the rule has no ground truth
+and stays silent, so tests/test_lint.py drives this file explicitly
+instead of through the per-file marker contract):
+
+- ``publish_snap`` is a DECLARED publish point the artifact's run
+  never entered -> dead-point finding at its def line;
+- ``publish_status_only`` is tagged ``publish=status`` and the
+  artifact says the status surface was NOT armed -> exempt;
+- ``publish_typod`` is tagged ``publish=statsu`` — a surface the
+  artifact does not even record -> unknown-tag finding (a tag that can
+  never match an armed surface would silently disable the dead-point
+  check forever);
+- the artifact's ``rogue_handoff`` counter has no matching marker ->
+  unattributed-crossing finding against the artifact itself.
+"""
+
+
+class Feed:
+    def __init__(self):
+        self._snap = {}
+
+    def publish_snap(self, snap: dict) -> None:  # graftlint: publish  # expect: G017
+        self._snap = snap
+
+    def publish_status_only(self, snap: dict) -> None:  # graftlint: publish=status
+        self._snap = snap
+
+    def publish_typod(self, snap: dict) -> None:  # graftlint: publish=statsu  # expect: G017
+        self._snap = snap

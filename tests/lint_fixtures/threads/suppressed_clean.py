@@ -1,0 +1,34 @@
+"""The thread-rule suppression contract: every seeded G014/G015/G016
+violation here carries a same-line ``graftlint: disable=G01X`` comment
+(or rides the file-wide directive below) and the file must lint CLEAN
+— the escape hatch works for the concurrency rules exactly like it
+does for the JAX-hygiene ones (this docstring mentioning the directive
+does not count; only real comments do)."""
+
+# graftlint: disable-file=G016
+
+import threading
+
+_LOCK = threading.Lock()
+
+
+class Escapee:
+    def __init__(self):
+        self.shared = {}
+        self.escaped = {}
+
+    def record(self, v: int) -> None:  # graftlint: thread=hot
+        self.escaped["v"] = v  # graftlint: disable=G014
+        self.shared["v"] = v  # graftlint: disable=G015
+
+    def publish(self, snap: dict) -> None:  # graftlint: publish  # graftlint: thread=hot
+        self.shared = snap
+        self.shared["late"] = True  # graftlint: disable=G015
+
+    def read(self) -> dict:  # graftlint: thread=status
+        return dict(self.shared) | dict(self.escaped)
+
+
+def drain_round():  # graftlint: hot-path
+    with _LOCK:  # covered by the file-wide G016 disable
+        pass

@@ -41,10 +41,13 @@ ALL_FIXTURE_FILES = sorted(p for p in FIXTURES.glob("**/*.py"))
 
 #: Cross-module corpora (``xmod_*`` directories) lint as a UNIT — their
 #: rules see nothing in a single-file run — so the per-file contract
-#: below covers only the standalone fixtures.
+#: below covers only the standalone fixtures.  The G017 fixture is
+#: artifact-driven the same way G011 is (no ground truth, no findings),
+#: so its explicit test passes the artifact instead.
 FIXTURE_FILES = [
     p for p in ALL_FIXTURE_FILES
     if not any(part.startswith("xmod_") for part in p.parts)
+    and p.name != "g017_dead_publish.py"
 ]
 XMOD_DIRS = sorted(
     d for d in FIXTURES.iterdir()
@@ -52,6 +55,7 @@ XMOD_DIRS = sorted(
 )
 G008_DIR = FIXTURES / "xmod_g008"
 G011_DIR = FIXTURES / "xmod_g011"
+THREADS_DIR = FIXTURES / "threads"
 
 
 def test_corpus_is_nonempty():
@@ -185,6 +189,87 @@ def test_g011_fence_tags_scope_the_accounting():
         assert dead == {"chaos_repair", "barrier"}  # cold stays exempt
 
 
+def test_hot_walk_covers_subclass_overrides(tmp_path):
+    """A ``self.m()`` dispatch in a hot-path root resolves to subclass
+    OVERRIDES too (virtual dispatch: the override runs when the
+    subclass does) — the ReplicatedScheduler `_plan`/`_deliver` bus
+    tick shape.  A host sync seeded in the override must be flagged
+    even though no hot marker sits anywhere near the subclass."""
+    mod = tmp_path / "sched.py"
+    mod.write_text(
+        "class Base:\n"
+        "    def run_round(self):  # graftlint: hot-path\n"
+        "        self._plan()\n"
+        "    def _plan(self):\n"
+        "        return 0\n"
+        "class Replicated(Base):\n"
+        "    def _plan(self):\n"
+        "        return self.x.item()\n"
+    )
+    findings = run_lint([str(mod)])
+    assert [(f.rule, f.line) for f in findings] == [("G002", 8)]
+
+
+def test_thread_labels_reach_inherited_helpers(tmp_path):
+    """`self.m()` dispatches UP the hierarchy too: a helper defined on
+    a base class and called from an annotated subclass entry must
+    inherit the thread label, or hazards in inherited helpers are
+    invisible to the whole confinement suite."""
+    mod = tmp_path / "inh.py"
+    mod.write_text(
+        "class Base:\n"
+        "    def __init__(self):\n"
+        "        self._d = {}\n"
+        "    def helper(self):\n"
+        "        self._d['k'] = 1\n"
+        "class Sub(Base):\n"
+        "    def hot_entry(self):  # graftlint: thread=hot\n"
+        "        self.helper()\n"
+        "    def status_read(self):  # graftlint: thread=status\n"
+        "        return self._d\n"
+    )
+    findings = run_lint([str(mod)])
+    assert [(f.rule, f.line) for f in findings] == [("G014", 5)]
+
+
+def test_attr_scanner_sees_tuple_unpacking_stores(tmp_path):
+    """`self._a, x = {}, y` stores into self._a just as surely as the
+    single-target form — tuple-unpacked writes must reach the G014/G015
+    access table, or the hazard hides behind an unpacking."""
+    mod = tmp_path / "tup.py"
+    mod.write_text(
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._snap = {}\n"
+        "        self._other = {}\n"
+        "    def publish(self, snap):  # graftlint: publish\n"
+        "        self._snap = snap\n"
+        "    def hot_write(self):  # graftlint: thread=hot\n"
+        "        self._other, _x = {}, 1\n"
+        "    def reset(self):  # graftlint: thread=status\n"
+        "        self._snap, _old = {}, self._snap\n"
+        "    def status_read(self):  # graftlint: thread=status\n"
+        "        return self._other\n"
+    )
+    findings = run_lint([str(mod)])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("G014", 8), ("G015", 10),
+    ]
+
+
+def test_hot_walk_reaches_replicated_scheduler_in_the_package():
+    """The real package's PR 9 overrides are inside the walked scope —
+    the thing the subclass-dispatch extension exists for."""
+    from crdt_benches_tpu.lint.core import build_index, walk_hot_scope
+
+    index, errors = build_index([str(PACKAGE)])
+    assert not errors
+    walked = {fi.qualname for fi, _ in
+              walk_hot_scope(index, descend_fences=True)}
+    assert {"ReplicatedScheduler._plan", "ReplicatedScheduler._deliver",
+            "BroadcastBus.tick"} <= walked
+
+
 def test_every_rule_has_a_detection_case():
     covered = set()
     for p in ALL_FIXTURE_FILES:
@@ -192,7 +277,88 @@ def test_every_rule_has_a_detection_case():
     assert {
         "G001", "G002", "G003", "G004", "G005", "G006", "G007",
         "G008", "G009", "G010", "G011", "G012", "G013",
+        "G014", "G015", "G016", "G017",
     } <= covered
+
+
+def test_threads_corpus_covers_each_rule_exactly_once_per_hazard():
+    """The thread-confinement corpus seeds the canonical shape of each
+    hazard: one escaped dict (G014), all five publish-contract breaks
+    (G015: in-place inside the point, owner-side mutation outside it,
+    reader-side mutation, far-side reassignment, owner-side mutable
+    reassignment outside the point),
+    and the five blocking kinds the walker must reach — including one
+    inside a declared fence (G016 descends)."""
+    g014 = run_lint([str(THREADS_DIR / "g014_escape.py")])
+    assert [(f.rule, f.line) for f in g014] == [("G014", 17)]
+    g015_path = THREADS_DIR / "g015_publish.py"
+    g015 = run_lint([str(g015_path)])
+    assert {f.rule for f in g015} == {"G015"}
+    assert [(f.rule, f.line) for f in g015] == sorted(
+        expected_markers(g015_path), key=lambda rl: rl[1]
+    )
+    assert len(g015) == 5
+    assert "inside publish point" in g015[0].msg
+    assert "outside its publish point" in g015[1].msg
+    assert "read-only" in g015[2].msg
+    assert "reassigned" in g015[3].msg
+    assert "no publish generation" in g015[4].msg
+    g016 = run_lint([str(THREADS_DIR / "g016_hot_blocking.py")])
+    assert {f.rule for f in g016} == {"G016"}
+    # with-lock, queue get, bare event wait, acquire, fence join —
+    # while the bounded/non-blocking twins on adjacent lines stay legal
+    assert len(g016) == 5
+
+
+def test_g017_dead_publish_and_unattributed_counter():
+    """G017 mirrors G011 for publish points: a declared point the run
+    never entered is flagged at its def line, a ``publish=status`` tag
+    exempts the point when the artifact's run never armed that surface,
+    and a runtime counter with no marker is flagged against the
+    artifact.  Without an artifact the rule stays silent."""
+    artifact = THREADS_DIR / "artifact.json"
+    path = THREADS_DIR / "g017_dead_publish.py"
+    findings = run_lint([str(path)], thread_artifact=str(artifact))
+    dead = {(f.path, f.rule, f.line) for f in findings
+            if f.path.endswith(".py")}
+    assert dead == {
+        (str(path), r, ln) for r, ln in expected_markers(path)
+    }, "\n".join(f"  {f.path}:{f.line} {f.rule} {f.msg}" for f in findings)
+    rogue = [f for f in findings if f.path == str(artifact)]
+    assert len(rogue) == 1 and "rogue_handoff" in rogue[0].msg
+    assert run_lint([str(path)]) == []  # no artifact -> no G017
+
+
+def test_g017_armed_surface_counts_tagged_points():
+    """When the artifact's run DID arm the status surface, the tagged
+    point participates in the dead-point accounting like any other."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        armed = Path(td) / "armed.json"
+        armed.write_text(json.dumps({"thread_crossings": {
+            "sanitized": True, "status": True,
+            "publishes": {"Feed.publish_snap": 4},
+            "crossings": {"Feed.publish_snap": 9},
+        }}))
+        findings = run_lint(
+            [str(THREADS_DIR / "g017_dead_publish.py")],
+            thread_artifact=str(armed),
+        )
+        dead = {f.msg.split("`")[1] for f in findings}
+        assert dead == {"Feed.publish_status_only", "Feed.publish_typod"}
+        typod = [f for f in findings if "publish_typod" in f.msg]
+        assert len(typod) == 1 and "statsu" in typod[0].msg
+
+
+def test_g017_selected_without_artifact_fails_like_g011():
+    """Explicitly selecting an artifact-driven rule with no ground
+    truth must FAIL the gate, never silently no-op."""
+    findings = run_lint(
+        [str(THREADS_DIR / "g017_dead_publish.py")], select={"G017"}
+    )
+    assert [f.rule for f in findings] == ["G000"]
+    assert "--thread-artifact" in findings[0].msg
 
 
 def test_historical_bugs_caught_by_the_right_rule():
@@ -273,6 +439,45 @@ def test_json_reporter_roundtrips():
     blob = json.loads(format_json(findings))
     assert blob["count"] == len(findings) > 0
     assert blob["findings"][0]["rule"] == "G004"
+
+
+def test_sarif_reporter_schema_shape():
+    """--format sarif: valid SARIF 2.1.0 skeleton, one result per
+    finding at 1-based positions, every ruleId declared in the driver
+    — and artifact-level findings (line 0) clamp to line 1 instead of
+    emitting an out-of-spec region."""
+    from crdt_benches_tpu.lint import format_sarif
+
+    findings = run_lint(
+        [str(THREADS_DIR / "g017_dead_publish.py")],
+        thread_artifact=str(THREADS_DIR / "artifact.json"),
+    )
+    sarif = json.loads(format_sarif(findings))
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {res["ruleId"] for res in run["results"]} == declared == {"G017"}
+    assert len(run["results"]) == len(findings) == 3
+    for res in run["results"]:
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+        assert res["level"] == "error"
+
+
+def test_cli_sarif_keeps_exit_code_semantics():
+    """A reporter changes the rendering, never the gate: sarif output
+    on a dirty fixture still exits 1, and on the clean tree exits 0
+    with a parseable empty result set."""
+    dirty = _cli(
+        "--format", "sarif", str(THREADS_DIR / "g016_hot_blocking.py")
+    )
+    assert dirty.returncode == 1
+    blob = json.loads(dirty.stdout)
+    assert len(blob["runs"][0]["results"]) == 5
+    clean = _cli("--format", "sarif", "crdt_benches_tpu")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert json.loads(clean.stdout)["runs"][0]["results"] == []
 
 
 def _cli(*args: str) -> subprocess.CompletedProcess:

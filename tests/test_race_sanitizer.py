@@ -1,0 +1,309 @@
+"""The race sanitizer (lint/race_sanitizer.py): the runtime proof of
+the static G014/G015 thread-confinement model.
+
+Covers the contract points ISSUE 10 names: an unpublished cross-thread
+access raises at its callsite; crossings attribute to the publish
+point (and generation) that licensed them; a published object is
+frozen on both sides; disarmed, ``share``/``reveal`` are IDENTITY (the
+zero-overhead contract, like the ``@fenced`` no-op path); and a full
+race-sanitized drain with the live status server up finishes
+verify-green with its artifact ``thread_crossings`` a subset of the
+static publish set — G017 clean in both directions.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from crdt_benches_tpu.lint import race_sanitizer
+from crdt_benches_tpu.lint.core import build_index
+from crdt_benches_tpu.lint.race_sanitizer import (
+    SharedProxy,
+    UndeclaredCrossThreadAccess,
+    generation,
+    publish_point,
+    published,
+    reveal,
+    share,
+)
+from crdt_benches_tpu.lint.threads import g017_thread_crossings
+from crdt_benches_tpu.serve.bench import run_serve_bench
+
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_RACES", "1")
+    race_sanitizer.reset_counters()
+    yield
+    race_sanitizer.reset_counters()
+
+
+def _on_thread(fn):
+    """Run ``fn`` on a fresh thread; return {'result': ...} or
+    {'error': exc}."""
+    box: dict = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except Exception as e:  # noqa: BLE001 (the exception IS the assertion)
+            box["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    return box
+
+
+# ---------------------------------------------------------------------------
+# the access rule
+# ---------------------------------------------------------------------------
+
+
+def test_unpublished_cross_thread_access_raises(armed):
+    shared = share({"v": 1}, "test.unpublished")
+    assert isinstance(shared, SharedProxy)
+    assert shared["v"] == 1  # owner access is free pre-publish
+    shared["v"] = 2  # owner mutation too (not yet published)
+    for access in (
+        lambda: shared["v"],
+        lambda: len(shared),
+        lambda: list(shared),
+        lambda: reveal(shared),
+    ):
+        box = _on_thread(access)
+        assert isinstance(box.get("error"), UndeclaredCrossThreadAccess)
+        assert "test.unpublished" in str(box["error"])
+
+
+def test_publish_generation_and_attribution(armed):
+    with publish_point("pt.alpha"):
+        shared = share({"v": 7}, "test.attributed")
+    assert generation(shared) == 1
+    box = _on_thread(lambda: reveal(shared)["v"])
+    assert box.get("result") == 7
+    box = _on_thread(lambda: shared["v"])
+    assert box.get("result") == 7
+    c = race_sanitizer.counters()
+    assert c["publishes"]["pt.alpha"] == 1
+    assert c["crossings"]["pt.alpha"] == 2
+    # a re-publish through another point re-attributes the handoff
+    with publish_point("pt.beta"):
+        again = share(shared)
+    assert again is shared and generation(shared) == 2
+    _on_thread(lambda: reveal(shared))
+    c = race_sanitizer.counters()
+    assert c["publishes"]["pt.beta"] == 1
+    assert c["crossings"]["pt.beta"] == 1
+    assert c["crossings"]["pt.alpha"] == 2  # old attributions keep
+
+
+def test_published_object_is_frozen_both_sides(armed):
+    with publish_point("pt.freeze"):
+        shared = share({"v": 1}, "test.frozen")
+    # owner-side mutation after publish: readers may already hold it
+    with pytest.raises(UndeclaredCrossThreadAccess, match="AFTER publish"):
+        shared["v"] = 9
+    with pytest.raises(UndeclaredCrossThreadAccess, match="AFTER publish"):
+        shared.update({"v": 9})
+    # reader-side mutation: published snapshots are read-only far-side
+    box = _on_thread(lambda: shared.__setitem__("w", 1))
+    assert isinstance(box.get("error"), UndeclaredCrossThreadAccess)
+    assert "read-only" in str(box["error"])
+    # reads stay legal on both sides
+    assert shared["v"] == 1
+    assert _on_thread(lambda: shared["v"]).get("result") == 1
+
+
+def test_torn_publish_detected_at_cross_thread_read(armed):
+    """The proxy cannot see a mutation made through a bare alias the
+    publisher retained — but the fingerprint taken at publish can: the
+    tear raises at the next legal cross-thread read."""
+    snap = {"phase": "steady", "rounds": 3}
+    with publish_point("pt.torn"):
+        shared = share(snap, "test.torn")
+    # a clean read crosses fine first
+    assert _on_thread(lambda: reveal(shared)["phase"]).get("result") \
+        == "steady"
+    snap["phase"] = "torn"  # bare-alias mutation AFTER publish
+    box = _on_thread(lambda: reveal(shared))
+    assert isinstance(box.get("error"), UndeclaredCrossThreadAccess)
+    assert "torn publish" in str(box["error"])
+    assert "pt.torn" in str(box["error"])
+
+
+def test_published_decorator_keys_by_qualname(armed):
+    class Feed:
+        @published
+        def publish_snap(self, snap):
+            return share(snap, "Feed.snap")
+
+    shared = Feed().publish_snap({"x": 1})
+    key = "test_published_decorator_keys_by_qualname.<locals>.Feed.publish_snap"
+    assert race_sanitizer.counters()["publishes"][key] == 1
+    assert generation(shared) == 1
+    assert _on_thread(lambda: reveal(shared)["x"]).get("result") == 1
+    assert race_sanitizer.counters()["crossings"][key] == 1
+
+
+# ---------------------------------------------------------------------------
+# disarmed: identity, entries-only counters
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_share_and_reveal_are_identity(monkeypatch):
+    """The zero-overhead contract, same as the ``@fenced``/span no-op
+    paths: disarmed, the 'proxy' IS the bare object."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_RACES", raising=False)
+    race_sanitizer.reset_counters()
+    obj = {"v": 1}
+    assert share(obj, "test.identity") is obj
+    assert reveal(obj) is obj
+    assert generation(obj) is None
+    with publish_point("pt.disarmed"):
+        assert share(obj) is obj
+    # entry counters still tick in every mode: G017's ground truth
+    assert race_sanitizer.counters() == {
+        "publishes": {"pt.disarmed": 1}, "crossings": {},
+    }
+
+
+def test_disarmed_share_timing_smoke(monkeypatch):
+    """The disarmed fast path is one env read + an isinstance — a loose
+    ceiling pins it from regressing into per-call proxy construction
+    (flake margin: ~50x headroom on this container)."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_RACES", raising=False)
+    obj = {"v": 1}
+    t0 = time.perf_counter()
+    for _ in range(20_000):
+        reveal(share(obj))
+    assert time.perf_counter() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# the sanitized drain: runtime ground truth vs the static publish set
+# ---------------------------------------------------------------------------
+
+
+def _static_publish_qualnames() -> set[str]:
+    import crdt_benches_tpu
+
+    pkg = crdt_benches_tpu.__path__[0]
+    index, errors = build_index([pkg])
+    assert not errors
+    return {
+        fi.qualname
+        for m in index.modules for fi in m.functions.values() if fi.publish
+    }
+
+
+def test_race_sanitized_drain_with_live_status(armed, tmp_path):
+    """A full (tiny) 12-doc drain under CRDT_BENCH_SANITIZE_RACES=1
+    with the status server live on an ephemeral port and a scraper
+    thread hammering it MID-DRAIN: finishes verify-green (an
+    unpublished cross-thread access would have raised), every observed
+    crossing is attributed to a declared ``# graftlint: publish``
+    point, and the artifact's ``thread_crossings`` block passes G017 in
+    both directions."""
+    ports: dict = {}
+    scrapes = {"ok": 0}
+
+    def log(msg):
+        m = re.search(r"status server on http://127\.0\.0\.1:(\d+)",
+                      str(msg))
+        if m:
+            ports["port"] = int(m.group(1))
+
+    stop = threading.Event()
+
+    def scraper():
+        deadline = time.time() + 120
+        while time.time() < deadline and not stop.is_set():
+            port = ports.get("port")
+            if port is None:
+                time.sleep(0.01)
+                continue
+            base = f"http://127.0.0.1:{port}"
+            try:
+                json.load(urllib.request.urlopen(
+                    base + "/status.json", timeout=2
+                ))
+                urllib.request.urlopen(base + "/metrics", timeout=2).read()
+                scrapes["ok"] += 1
+            except OSError:
+                pass  # server booting or already down: keep polling
+            time.sleep(0.02)
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    try:
+        r, info = run_serve_bench(
+            mix=TINY_MIX, n_docs=12, batch=16, macro_k=2, batch_chars=64,
+            classes=(128, 512), slots=(8, 4), arrival_span=2,
+            verify_sample=4, bands=TINY_BANDS, seed=7,
+            spool_dir=str(tmp_path / "spool"),
+            results_dir=str(tmp_path), save_name="race_smoke",
+            status_port=0,
+            timeseries_path=str(tmp_path / "race_smoke_ts.jsonl"),
+            log=log,
+        )
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+    assert info["verify_ok"]
+    assert ports.get("port"), "status server never announced its port"
+    assert scrapes["ok"] > 0, "scraper never landed a mid-drain read"
+    block = r.extra["thread_crossings"]
+    assert block["sanitized"] is True and block["status"] is True
+    # disk parity: the block the artifact carries is the one in memory
+    disk = json.loads((tmp_path / "race_smoke.json").read_text())
+    assert disk[0]["extra"]["thread_crossings"] == block
+    static = _static_publish_qualnames()
+    assert set(block["publishes"]) <= static
+    assert set(block["crossings"]) <= set(block["publishes"])
+    # the drain actually published, and the scraper actually crossed
+    assert block["publishes"].get("StatusServer.publish_status")
+    assert block["publishes"].get("StatusServer.publish_metrics")
+    assert sum(block["crossings"].values()) > 0
+    # G017 clean in both directions against this very artifact
+    import crdt_benches_tpu
+
+    index, errors = build_index([crdt_benches_tpu.__path__[0]])
+    assert not errors
+    findings = g017_thread_crossings(
+        index, str(tmp_path / "race_smoke.json")
+    )
+    assert findings == [], "\n".join(f.msg for f in findings)
+
+
+def test_unsanitized_drain_records_publish_entries(monkeypatch, tmp_path):
+    """Publish-entry counters are ground truth in EVERY run (G017's
+    food), sanitizer or not — and the disarmed snapshot path stores
+    the BARE dict (identity contract on the serving surface itself)."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_RACES", raising=False)
+    race_sanitizer.reset_counters()
+    from crdt_benches_tpu.obs.status import StatusServer
+
+    srv = StatusServer(port=0)
+    snap = {"phase": "steady", "rounds": 3}
+    srv.publish_status(snap)
+    assert srv._status is snap  # identity: no proxy disarmed
+    assert srv.status_snapshot() is snap
+    c = race_sanitizer.counters()
+    assert c["publishes"] == {"StatusServer.publish_status": 1}
+    assert c["crossings"] == {}

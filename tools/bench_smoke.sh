@@ -133,9 +133,80 @@ case "$family" in
         --serve-status 0 \
         --serve-timeseries bench_results/serve_smoke_timeseries.jsonl \
         --serve-save-name serve_smoke_telemetry
-    exec python tools/bench_compare.py \
+    python tools/bench_compare.py \
       bench_results/serve_smoke_telemetry.json bench_results/serve_smoke.json \
       --max-throughput-regress 15
+    # Race-sanitized leg: the SAME status+timeseries drain under
+    # CRDT_BENCH_SANITIZE_RACES=1 — the status/metrics snapshots become
+    # ownership-tracking proxies and any cross-thread access outside a
+    # declared `# graftlint: publish` point raises at its callsite
+    # (lint/race_sanitizer.py, the dynamic proof of the static
+    # G014/G015 confinement model).  Gated at <=5% vs the telemetry leg
+    # it mirrors (identical config, env flag aside: the armed cost is
+    # one proxy hop per scrape + a counter bump per publish, so unlike
+    # the cross-kernel legs this pair is apples-to-apples).
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-status 0 \
+        --serve-timeseries bench_results/serve_smoke_races.jsonl \
+        --serve-save-name serve_smoke_races
+    python tools/bench_compare.py \
+      bench_results/serve_smoke_races.json \
+      bench_results/serve_smoke_telemetry.json \
+      --max-throughput-regress 5
+    # ...and G017 closes the loop exactly like G011 does for fences:
+    # every declared publish point the armed run should have crossed
+    # must appear in its thread_crossings counters (dead points fail),
+    # every runtime counter must map back to a declared point
+    # (unattributed handoffs fail).
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_smoke_races.json
+    # Race-sanitized CHAOS leg: the serve-faults recipe (800ms stall
+    # against a 250ms watchdog, journal + snapshot barriers — the
+    # barriers are what surface the staging stall as a stuck ROUND
+    # instead of hiding it behind the async device wait) re-run under
+    # the race sanitizer with the status server live — the watchdog
+    # flip crosses set_health's immutable tuple swap while the handler
+    # threads read it, so an unpublished handoff anywhere on the
+    # anomaly -> health -> scrape path would raise and fail the leg.
+    # Exit 0 = verify green + stall fired AND cleared + zero
+    # undeclared cross-thread accesses.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 3 \
+        --serve-queue-cap 128 \
+        --serve-faults "seed=5,span=5,stall_ms=800,spool_corrupt=1,device_loss=1,queue_overflow=1,dup_batch=1,stall@7=1" \
+        --serve-soak 0 --serve-watchdog 0.25 \
+        --serve-status 0 \
+        --serve-timeseries bench_results/serve_smoke_races_chaos.jsonl \
+        --serve-save-name serve_smoke_races_chaos
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_smoke_races_chaos.json
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_smoke_races_chaos.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+tc = x["thread_crossings"]
+assert tc["sanitized"] and tc["status"], tc
+assert tc["publishes"].get("StatusServer.publish_status"), tc
+assert set(tc["crossings"] or {}) <= set(tc["publishes"]), tc
+stuck = [e for e in x["anomalies"]["events"] if e["kind"] == "stuck_round"]
+assert stuck and all(e["cleared"] for e in stuck), x["anomalies"]
+print(f"race chaos: stall -> stuck_round -> cleared under the race "
+      f"sanitizer; {sum(tc['publishes'].values())} publish entries, "
+      f"{sum((tc['crossings'] or {}).values())} attributed crossings")
+PYEOF
     ;;
   serve-repl)
     # Replication smoke: a small fleet of 2-writer groups drained

@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# The lint gate: graftlint (JAX hygiene, rules G001-G007) + ruff (when
+# The lint gate: graftlint (JAX hygiene G001-G013 + thread-confinement
+# G014-G017) + ruff (when
 # installed).  Exits NONZERO on any finding — CI and the tier-1 gate
 # both call this before running a single test.
 #

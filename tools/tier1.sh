@@ -6,7 +6,8 @@
 #
 # Usage: tools/tier1.sh        (from the repo root)
 #
-# Stage 0 is the LINT gate (graftlint G001-G007 + ruff when installed,
+# Stage 0 is the LINT gate (graftlint G001-G016 + ruff when installed;
+# the artifact-driven cross-checks G011/G017 ride the bench smoke,
 # sub-10s, see tools/lint.sh): JAX-hygiene violations fail tier-1 before
 # a single test runs.  Escape hatch: `# graftlint: disable=G00X` on the
 # offending line (reviewed, never drive-by).
