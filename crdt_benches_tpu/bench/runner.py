@@ -679,6 +679,7 @@ def run_serve(args) -> int:
             ("--serve-timeseries", args.serve_timeseries is not None),
             ("--serve-trace", args.serve_trace is not None),
             ("--serve-profile", args.serve_profile > 0),
+            ("--serve-flight", args.serve_flight is not None),
         ]
         bad = [flag for flag, hit in unsupported if hit]
         if bad:
@@ -706,6 +707,8 @@ def run_serve(args) -> int:
             snapshot_every=args.serve_snapshot_every,
             faults=args.serve_faults,
             save_name=args.serve_save_name,
+            reqtrace_samples=args.serve_reqtrace,
+            slo_spec=args.serve_slo,
             log=lambda m: print(m, file=sys.stderr),
         )
         rb = r.extra["replication"]
@@ -743,6 +746,9 @@ def run_serve(args) -> int:
         save_name=args.serve_save_name,
         trace_path=args.serve_trace,
         profile_rounds=args.serve_profile,
+        reqtrace_samples=args.serve_reqtrace,
+        slo_spec=args.serve_slo,
+        flight_path=args.serve_flight,
         log=lambda m: print(m, file=sys.stderr),
     )
     if args.serve_soak is not None:
@@ -889,6 +895,29 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-timeseries-window", type=int, default=8,
                     metavar="N",
                     help="macro-rounds folded per time-series window")
+    ap.add_argument("--serve-reqtrace", type=int, default=0,
+                    metavar="N",
+                    help="arm obs/reqtrace.py request-scoped causal "
+                         "tracing, keeping the last N sampled request "
+                         "traces (0 = disarmed; the artifact gains a "
+                         "versioned 'reqtrace' block with per-request "
+                         "segment breakdowns, publish-point hops and "
+                         "histogram exemplars)")
+    ap.add_argument("--serve-slo", default=None, metavar="SPEC",
+                    help="per-class latency objectives, "
+                         "class=pQ:MS[,class=pQ:MS...] — e.g. "
+                         "'default=p99:250,c4096=p99.9:1500'; arms "
+                         "request tracing, exports rolling burn-rate "
+                         "gauges on /metrics + /status.json, and adds "
+                         "a versioned 'slo' artifact block gated by "
+                         "tools/bench_compare.py")
+    ap.add_argument("--serve-flight", default=None, metavar="PATH",
+                    help="arm the obs/flight.py anomaly flight "
+                         "recorder: a bounded ring of recent rounds + "
+                         "sampled request traces + registry snapshot, "
+                         "dumped atomically to PATH on anomaly fire, "
+                         "unrecovered fault, or crash (validate with "
+                         "python -m crdt_benches_tpu.obs.flight PATH)")
     ap.add_argument("--serve-soak", type=float, default=None,
                     metavar="SECONDS",
                     help="soak mode: drain re-seeded fleets back-to-"

@@ -49,6 +49,9 @@ accounting against serve bench artifacts:
   against chaos artifacts;
 - ``fence=journal`` — crosses only with the write-ahead journal on;
   accounted only against journaled artifacts;
+- ``fence=flight`` — crosses only when the flight recorder DUMPED
+  (``boundary_syncs.flight``); even an armed recorder on a clean
+  chaos run never enters it, so chaos-scoping would false-positive;
 - ``fence=cold`` — an off-drain API boundary (direct pool calls from
   tests/tools): still a G002 barrier, never dead-fence accounted.
 """
@@ -110,7 +113,7 @@ _MARKER_RE = re.compile(
 )
 
 #: Recognized ``fence=<tag>`` spellings (see module docstring).
-FENCE_TAGS = ("chaos", "journal", "cold")
+FENCE_TAGS = ("chaos", "journal", "flight", "cold")
 
 
 def dotted(e: ast.expr) -> str | None:
@@ -152,7 +155,7 @@ class FuncInfo:
     boundary_line: int = 0
     hot: bool = False
     fence: bool = False
-    fence_tag: str | None = None  # None | "chaos" | "journal" | "cold"
+    fence_tag: str | None = None  # None|"chaos"|"journal"|"flight"|"cold"
     publish: bool = False  # declared cross-thread publish point
     publish_tag: str | None = None  # armed-surface tag (e.g. "status")
     thread: str | None = None  # declared owning thread (or class's)

@@ -74,6 +74,29 @@ _crossings: dict[str, int] = {}
 #: leg's <=5% overhead gate holds with it in place.
 _mu = threading.Lock()
 
+#: Publish-entry observers (the sanitizer's fence-observer pattern,
+#: lint/sanitizer.py): each is called with the point's qualname at
+#: every publish-point entry, OUTSIDE the counter mutex.  The request
+#: tracer (obs/reqtrace.py) hooks here so every trace-context
+#: propagation edge IS a declared publish point — the crossing
+#: counters and the request trace stay one causal picture.  Observers
+#: run on the publishing thread (for every point in this stack, the
+#: hot thread); an observer that needs cross-thread safety brings its
+#: own.
+_publish_observers: list = []
+
+
+def add_publish_observer(fn) -> None:
+    if fn not in _publish_observers:
+        _publish_observers.append(fn)
+
+
+def remove_publish_observer(fn) -> None:
+    try:
+        _publish_observers.remove(fn)
+    except ValueError:
+        pass
+
 
 def sanitizing() -> bool:
     """True when ``CRDT_BENCH_SANITIZE_RACES`` arms the sanitizer.
@@ -297,6 +320,9 @@ def publish_point(name: str):
     publish attributed to ``name``."""
     with _mu:
         _publishes[name] = _publishes.get(name, 0) + 1
+    if _publish_observers:  # disarmed runs keep the entry allocation-free
+        for fn in list(_publish_observers):
+            fn(name)
     stack = _point_stack()
     stack.append(name)
     try:

@@ -671,8 +671,9 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
     the annotation is stale (delete it) or the boundary moved (re-fence
     the real one); a runtime counter with no matching ``# graftlint:
     fence`` marker is an UNATTRIBUTED sync boundary the static model
-    does not know about.  ``fence=chaos`` / ``fence=journal`` fences are
-    accounted only against artifacts whose run had faults / a journal;
+    does not know about.  ``fence=chaos`` / ``fence=journal`` /
+    ``fence=flight`` fences are accounted only against artifacts whose
+    run had faults / a journal / a flight-recorder dump;
     ``fence=cold`` fences (off-drain APIs) are never dead-checked."""
     block, err = _load_boundary_syncs(artifact_path)
     if block is None:
@@ -682,6 +683,7 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
     entries = block.get("entries") or {}
     chaos = bool(block.get("chaos"))
     journal = bool(block.get("journal"))
+    flight = bool(block.get("flight"))
     out = []
     fences = {
         fi.qualname: fi
@@ -694,6 +696,8 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
         if tag == "chaos" and not chaos:
             continue
         if tag == "journal" and not journal:
+            continue
+        if tag == "flight" and not flight:
             continue
         if not entries.get(qual):
             out.append(Finding(
@@ -724,7 +728,17 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
 # G012 — observability hygiene in hot-path scopes
 
 #: obs-API calls that take a series NAME as their first argument.
-_OBS_NAME_CALLS = {"span", "instant", "counter", "gauge", "histogram"}
+#: ``segment`` is the obs/reqtrace.py per-phase timer — its names are
+#: registered constants exactly like span/metric names.
+_OBS_NAME_CALLS = {"span", "instant", "counter", "gauge", "histogram",
+                   "segment"}
+
+#: obs/reqtrace.py admission/drain-EDGE calls: opening a request
+#: context or sampling an exemplar allocates and (for exemplars) grows
+#: per-bucket state — legal once per admitted doc at the selection/
+#: close edges (loop depth <= 1), banned in per-op inner loops.
+_REQTRACE_EDGE_CALLS = {"open_request", "sample_exemplar",
+                        "RequestContext"}
 
 #: Tracer lifecycle — never legal in a hot scope (arming inside the
 #: drain voids the disarmed-tracer no-op contract and skews timing).
@@ -808,18 +822,79 @@ def _obs_findings(fi: FuncInfo, chain: str) -> list[Finding]:
     return out
 
 
+def _reqtrace_call_name(m, f: ast.expr) -> str | None:
+    """The reqtrace edge-call name this expression denotes, or None.
+    Attribute calls (``tracker.open_request``) match by attr name —
+    the method names are distinctive; bare names must be imported from
+    ``obs.reqtrace``."""
+    d = dotted(f)
+    if d is None:
+        return None
+    tail = d.split(".")[-1]
+    if tail not in _REQTRACE_EDGE_CALLS:
+        return None
+    if isinstance(f, ast.Name):
+        src = m.imports.get(f.id, "")
+        return tail if "reqtrace" in src else None
+    return tail
+
+
+def _reqtrace_loop_findings(fi: FuncInfo, chain: str) -> list[Finding]:
+    """Request-context creation / exemplar sampling inside per-op
+    INNER loops (loop depth >= 2) of a hot-path scope.  Depth 1 is the
+    admission edge — the scheduler's per-DOC selection loop opens one
+    context per admitted doc there, which is the sanctioned pattern."""
+    m = fi.module
+    out: list[Finding] = []
+
+    def walk(node: ast.AST, depth: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            d = depth
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                d = depth + 1
+            elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                    ast.DictComp, ast.GeneratorExp)):
+                d = depth + len(child.generators)
+            if isinstance(child, ast.Call) and depth >= 2:
+                name = _reqtrace_call_name(m, child.func)
+                if name is not None:
+                    what = ("request-context creation"
+                            if name in ("open_request", "RequestContext")
+                            else "exemplar sampling")
+                    out.append(Finding(
+                        rule="G012", path=m.path, line=child.lineno,
+                        col=child.col_offset,
+                        msg=(
+                            f"{what} `{name}(...)` inside a per-op "
+                            f"inner loop (depth {depth}) in a hot-path "
+                            f"scope ({chain}) — contexts and exemplars "
+                            "are admission/drain-edge work: open once "
+                            "per admitted doc in the selection loop, "
+                            "sample once per request close"
+                        ),
+                    ))
+            walk(child, d)
+
+    walk(fi.node, 0)
+    return out
+
+
 def g012_obs_hygiene(index: PackageIndex) -> list[Finding]:
     """Observability discipline on the serving hot path: every
-    ``obs/trace.py`` span and ``obs/metrics.py`` series created in a
-    hot-path scope must use a registered CONSTANT name (dynamic context
-    goes in args / pre-registered cause tags), and the tracer lifecycle
-    (arm / disarm / write) must never run there — the disarmed tracer
-    is a shared no-op and arming mid-drain would void that contract.
-    Unlike G002 the walk DESCENDS into declared fences: naming
-    discipline applies behind sync boundaries too."""
+    ``obs/trace.py`` span, ``obs/metrics.py`` series, and
+    ``obs/reqtrace.py`` segment created in a hot-path scope must use a
+    registered CONSTANT name (dynamic context goes in args /
+    pre-registered cause tags), the tracer lifecycle (arm / disarm /
+    write) must never run there — the disarmed tracer is a shared
+    no-op and arming mid-drain would void that contract — and request
+    contexts / exemplars are opened at admission/drain EDGES only,
+    never in per-op inner loops.  Unlike G002 the walk DESCENDS into
+    declared fences: naming discipline applies behind sync boundaries
+    too."""
     out: list[Finding] = []
     for fi, chain in walk_hot_scope(index, descend_fences=True):
         out.extend(_obs_findings(fi, chain))
+        out.extend(_reqtrace_loop_findings(fi, chain))
     return out
 
 
@@ -834,6 +909,12 @@ _G013_SERVER_CTORS = {
     "StatusServer",
 }
 _G013_SERVER_SOURCES = ("http.server", "socketserver", "obs.status")
+
+#: obs/ v3 lifecycle constructors: the flight recorder and the request
+#: tracker are built (and armed — the tracker installs a global
+#: publish observer) by the bench DRIVER; constructing either mid-
+#: drain re-arms tracing under the hot path and leaks observers.
+_G013_OBS_LIFECYCLE_CTORS = {"FlightRecorder", "RequestTracker"}
 
 #: ``socket``-module entry points that create/bind network endpoints.
 _G013_SOCKET_FUNCS = {"socket", "create_server", "create_connection"}
@@ -868,6 +949,20 @@ def _g013_call_finding(fi: FuncInfo, node: ast.Call, chain: str
                     "references in"
                 ),
             )
+    # (a') obs/ v3 lifecycle construction (flight recorder / request
+    # tracker) — driver-side work, like the status server above
+    if tail in _G013_OBS_LIFECYCLE_CTORS:
+        return Finding(
+            rule="G013", path=m.path, line=node.lineno,
+            col=node.col_offset,
+            msg=(
+                f"`{tail}(...)` constructed in a hot-path scope "
+                f"({chain}) — flight-recorder / request-tracker "
+                "lifecycle belongs to the bench driver (the tracker "
+                "installs a global publish observer when armed); the "
+                "drain holds pre-built references"
+            ),
+        )
     # (b) raw socket creation
     if d is not None and len(d.split(".")) == 2:
         root, attr = d.split(".")

@@ -230,25 +230,52 @@ class ServeTelemetry:  # graftlint: thread=hot
     recorder: TimeseriesRecorder | None = None
     anomaly: object | None = None  # obs/anomaly.py AnomalyDetector
     status: object | None = None  # obs/status.py StatusServer
+    flight: object | None = None  # obs/flight.py FlightRecorder
     shards: object | None = field(default=None, init=False)
     registry: object | None = field(default=None, init=False)
+    reqtrace: object | None = field(default=None, init=False)
+    _flight_fired_seen: int = field(default=0, init=False)
     _drain_done: bool = field(default=False, init=False)
 
-    def bind(self, pool, registry) -> None:
+    def bind(self, pool, registry, reqtrace=None) -> None:
         """A drain's scheduler calls this once at construction: build
         the per-shard series against the drain's registry, re-base the
         recorder's delta baseline, and publish an initial snapshot so
-        a scrape BEFORE the first window close already answers."""
+        a scrape BEFORE the first window close already answers.
+        ``reqtrace`` is the drain's RequestTracker — the flight
+        recorder dumps its sampled/in-flight traces on a trigger."""
         from .shard import ShardMetrics
 
         self.registry = registry
         self.shards = ShardMetrics(pool, registry)
+        self.reqtrace = reqtrace
         self._drain_done = False
         if self.recorder is not None:
             self.recorder.rebase(n_shards=pool.n_sh)
         if self.status is not None:
             self.status.publish_metrics(registry.to_dict())
             self.status.publish_status({"phase": "starting", "rounds": 0})
+
+    def _flight_requests(self) -> list:
+        if self.reqtrace is None:
+            return []
+        return self.reqtrace.dump_requests()
+
+    def flight_dump(self, reason: str, status: dict | None = None) -> None:
+        """Trigger a flight-recorder dump with everything the bundle
+        holds (no-op without a recorder)."""
+        if self.flight is None:
+            return
+        self.flight.trigger(
+            reason,
+            registry=self.registry,
+            status=status,
+            requests=self._flight_requests(),
+            anomalies=(
+                self.anomaly.active_kinds()
+                if self.anomaly is not None else []
+            ),
+        )
 
     # -- per-round fan-out (hot path; pre-registered objects only) --
 
@@ -270,6 +297,23 @@ class ServeTelemetry:  # graftlint: thread=hot
             self.anomaly.note_round(
                 seconds, skip=compiled or barrier, round_no=round_no
             )
+        if self.flight is not None:
+            # one small dict per round into the bounded ring; a NEW
+            # anomaly fire triggers the atomic dump (the post-mortem
+            # window this recorder exists to keep)
+            self.flight.note_round({
+                "round": round_no,
+                "seconds": seconds,
+                "compiled": compiled,
+                "barrier": barrier,
+                "occupancy": occupancy,
+                "queue_depth": queue_depth,
+                "ops": cum.get("ops", 0),
+                "shed": cum.get("shed", 0),
+                "deferred": cum.get("deferred", 0),
+                "quarantines": cum.get("quarantines", 0),
+                "recoveries": cum.get("recoveries", 0),
+            })
         if closed is not None:
             if self.anomaly is not None:
                 self.anomaly.note_window(closed)
@@ -285,6 +329,16 @@ class ServeTelemetry:  # graftlint: thread=hot
                     ",".join(status["anomalies_active"]),
                 )
             self.status.publish_status(status)
+        # flight trigger LAST, after both the per-round and per-window
+        # detectors had their look: a NEW fire (per-round watchdog OR
+        # window-level degradation/leak) dumps the post-mortem window
+        if (self.flight is not None and self.anomaly is not None
+                and self.anomaly.fired > self._flight_fired_seen):
+            self._flight_fired_seen = self.anomaly.fired
+            self.flight_dump(
+                "anomaly:" + ",".join(self.anomaly.active_kinds()),
+                status=status,
+            )
 
     def note_phase(self, phase: str) -> None:
         """Driver-side heartbeat between drains (fleet build, verify):
@@ -313,6 +367,15 @@ class ServeTelemetry:  # graftlint: thread=hot
             if self.anomaly is not None:
                 status["anomalies_active"] = self.anomaly.active_kinds()
             self.status.publish_status(status)
+        if (self.flight is not None and self.anomaly is not None
+                and self.anomaly.uncleared > 0):
+            # an anomaly still ACTIVE at drain end fails the run — the
+            # dump is the post-mortem that exit code used to discard
+            self.flight_dump(
+                "drain_end_active_anomaly:"
+                + ",".join(self.anomaly.active_kinds()),
+                status=status,
+            )
 
     def close(self) -> None:
         """Release owned resources (stream file, status server)."""

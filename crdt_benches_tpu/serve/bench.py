@@ -50,7 +50,10 @@ from ..bench.harness import (
 from ..lint import race_sanitizer, sanitizer
 from ..obs import trace as obs_trace
 from ..obs.anomaly import AnomalyDetector
+from ..obs.flight import FlightRecorder
 from ..obs.profiler import DeviceProfiler
+from ..obs.reqtrace import RequestTracker
+from ..obs.slo import SloTracker
 from ..obs.status import StatusServer
 from ..obs.timeseries import ServeTelemetry, TimeseriesRecorder
 from ..oracle.text_oracle import replay_trace
@@ -61,6 +64,34 @@ from .scheduler import FleetScheduler, prepare_streams
 from .workload import build_fleet
 
 
+def parse_slo(slo_spec):
+    """Fail-fast parse of a ``--serve-slo`` spec (None when unset).
+
+    The ONLY raising step of reqtrace arming — callers invoke this
+    BEFORE acquiring resources (journal tempdir, telemetry threads), so
+    a malformed spec fails the run with nothing to release.  The
+    tracker itself is constructed by :func:`arm_reqtrace`, last before
+    the resource-releasing try."""
+    return SloTracker.from_spec(slo_spec) if slo_spec else None
+
+
+def arm_reqtrace(samples, slo, slo_spec, log, prefix="serve"):
+    """Construct + log the request tracker (obs/ v3) for a bench family.
+
+    Called LAST before the try whose finally releases it: the armed
+    tracker installs a global publish observer that only
+    ``reqtrace.release()`` drops, and nothing in here can raise — the
+    raising half (spec parse) happened up front in :func:`parse_slo`."""
+    reqtrace = RequestTracker(samples=samples, slo=slo)
+    if reqtrace.armed:
+        log(
+            f"{prefix}: request tracing ARMED "
+            f"(samples={reqtrace.samples_cap}"
+            + (f", slo={slo_spec}" if slo_spec else "") + ")"
+        )
+    return reqtrace
+
+
 def build_telemetry(
     *,
     status_port: int | None = None,
@@ -69,6 +100,7 @@ def build_telemetry(
     anomaly: bool = False,
     watchdog_s: float = 0.0,
     stale_after: float | None = None,
+    flight_path: str | None = None,
     log=print,
 ) -> ServeTelemetry | None:
     """Assemble the continuous-telemetry bundle a serve run threads
@@ -78,7 +110,8 @@ def build_telemetry(
     (``stale_after`` seconds without a publish turns ``/healthz`` 503 —
     the external-probe view of a wedged publisher), and the soak
     anomaly detectors.  Returns None when nothing is armed."""
-    if status_port is None and not timeseries_path and not anomaly:
+    if status_port is None and not timeseries_path and not anomaly \
+            and not flight_path:
         return None
     telemetry = ServeTelemetry(
         recorder=TimeseriesRecorder(
@@ -88,7 +121,11 @@ def build_telemetry(
         else None,
         status=StatusServer(port=status_port, stale_after=stale_after)
         if status_port is not None else None,
+        flight=FlightRecorder(flight_path) if flight_path else None,
     )
+    if telemetry.flight is not None:
+        log(f"serve: flight recorder armed -> {flight_path} "
+            "(dumped on anomaly fire / unrecovered fault / crash)")
     if telemetry.status is not None:
         port = telemetry.status.start()
         log(
@@ -174,6 +211,9 @@ def run_serve_bench(
     timeseries_path: str | None = None,
     timeseries_window: int = 8,
     telemetry: ServeTelemetry | None = None,
+    reqtrace_samples: int = 0,
+    slo_spec: str | None = None,
+    flight_path: str | None = None,
     log=print,
 ) -> tuple[BenchResult, dict]:
     """Build the fleet, drain it once, verify a per-class doc sample
@@ -232,6 +272,10 @@ def run_serve_bench(
             queue_cap = 8 * batch
             log(f"serve: queue_overflow faults need a bounded queue; "
                 f"defaulting queue_cap={queue_cap}")
+    # a malformed --serve-slo spec fails HERE, before the journal
+    # tempdir / telemetry threads exist — nothing yet to release
+    slo = parse_slo(slo_spec)
+
     owns_journal = journal_dir == "auto"
     if owns_journal:
         journal_dir = tempfile.mkdtemp(prefix="crdt_journal_")
@@ -242,7 +286,8 @@ def run_serve_bench(
     if owns_telemetry:
         telemetry = build_telemetry(
             status_port=status_port, timeseries_path=timeseries_path,
-            timeseries_window=timeseries_window, log=log,
+            timeseries_window=timeseries_window,
+            flight_path=flight_path, log=log,
         )  # None when nothing is armed
 
     mesh = None
@@ -250,6 +295,10 @@ def run_serve_bench(
         from ..parallel.mesh import replica_mesh
 
         mesh = replica_mesh(mesh_devices)
+
+    # request tracing + SLO accounting (obs/ v3): an SLO spec arms the
+    # tracker too — burn rates are computed over closed requests
+    reqtrace = arm_reqtrace(reqtrace_samples, slo, slo_spec, log)
 
     pool = None
     # every exit path — including a failed drain or verify — must
@@ -302,6 +351,7 @@ def run_serve_bench(
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
             profiler=profiler, telemetry=telemetry,
+            reqtrace=reqtrace, slo=slo,
             warm_start=True,
         )
         # per-fence boundary-sync counters cover drain + verify; with
@@ -311,6 +361,14 @@ def run_serve_bench(
         sanitized = sanitizer.sanitizing()
         if sanitized:
             log("serve: sync sanitizer ARMED (CRDT_BENCH_SANITIZE_SYNCS)")
+        # the flight recorder outlives soak iterations (one shared
+        # bundle), so the artifact's per-drain dump accounting keys on
+        # the DELTA — like the fence counters it sits beside
+        flight_dumps_at_start = (
+            telemetry.flight.dumps
+            if telemetry is not None and telemetry.flight is not None
+            else 0
+        )
         # span tracing: an explicit trace_path arms it; CRDT_BENCH_TRACE=1
         # arms it too, defaulting the file next to the artifact
         if trace_path is None and obs_trace.env_armed():
@@ -326,7 +384,24 @@ def run_serve_bench(
             log(f"serve: span tracer ARMED -> {trace_path}")
         profile_block = None
         try:
-            stats = sched.run()
+            try:
+                stats = sched.run()
+            except BaseException as e:
+                # crash post-mortem: dump the flight window before the
+                # exception leaves the drain (the exit code alone is
+                # what this recorder exists to improve on).  The dump
+                # is best-effort: a failure HERE (half-broken scheduler
+                # state, unwritable path) must never replace the crash
+                # it is documenting.
+                if telemetry is not None and telemetry.flight is not None:
+                    try:
+                        telemetry.flight_dump(
+                            f"crash: {type(e).__name__}: {e}",
+                            status=sched.status_fields(),
+                        )
+                    except Exception:
+                        pass
+                raise
         finally:
             # only release what THIS run acquired: a failed drain must
             # not hijack a caller-armed tracer, and an open profiler
@@ -447,6 +522,38 @@ def run_serve_bench(
                 f"{fault_summary['unrecovered']} unrecovered, "
                 f"{fault_summary['not_fired']} never fired"
             )
+            if telemetry is not None and telemetry.flight is not None:
+                # the dump reason distinguishes a fault that fired and
+                # stuck from one that never fired (a plan/timing
+                # problem, not a recovery failure) — both fail the run
+                telemetry.flight_dump(
+                    "unrecovered_fault"
+                    if fault_summary["unrecovered"] > 0
+                    else "unfired_fault",
+                    status={**sched.status_fields(), "done": True},
+                )
+
+        if reqtrace.armed:
+            log(
+                f"serve: requests — {reqtrace.requests_closed} closed "
+                f"({reqtrace.reopened} re-admissions opened fresh "
+                f"contexts), hops "
+                + (", ".join(
+                    f"{k.split('.')[-1]}={v}"
+                    for k, v in sorted(reqtrace.hop_counts.items())
+                ) or "none")
+            )
+        if slo is not None:
+            for name, st_cls in sorted(slo.classes.items()):
+                d = st_cls.to_dict()
+                log(
+                    f"serve: slo {name} — compliance "
+                    f"{d['compliance']:.4f} over {d['requests']} "
+                    f"requests (objective p{st_cls.objective.quantile * 100:g}"
+                    f" <= {st_cls.objective.threshold_s * 1e3:.0f}ms, "
+                    f"burn fast {d['burn_rate_fast']:.2f} / slow "
+                    f"{d['burn_rate_slow']:.2f})"
+                )
 
         # ---- boundary-sync ground truth (lint G011 cross-checks the
         # static fence graph against exactly this block) ----
@@ -455,6 +562,17 @@ def run_serve_bench(
             "sanitized": sanitized,
             "chaos": plan is not None,
             "journal": journal is not None,
+            # FlightRecorder.trigger (fence=flight) only crosses when a
+            # dump actually fired — a chaos run whose faults all
+            # recover cleanly never enters it, so G011 dead-checks it
+            # only against runs that dumped.  Per-DRAIN delta: under
+            # soak the recorder is shared across iterations, and a
+            # clean later drain (fence entries reset, no trigger) must
+            # not inherit an earlier iteration's dump
+            "flight": (
+                telemetry is not None and telemetry.flight is not None
+                and telemetry.flight.dumps > flight_dumps_at_start
+            ),
             "entries": sync_counts["entries"],
             "syncs": sync_counts["syncs"] if sanitized else None,
         }
@@ -473,9 +591,15 @@ def run_serve_bench(
         race_counts = race_sanitizer.counters()
         thread_crossings = {
             "sanitized": race_sanitized,
+            # armed surfaces: G017's tag scoping (publish=status /
+            # publish=journal / publish=bus) dead-checks a tagged point
+            # only against artifacts whose run armed its surface
             "status": (
                 telemetry is not None and telemetry.status is not None
             ),
+            "journal": journal is not None,
+            "bus": False,  # only the replicated family drives the bus
+            # (its artifact arms the surface; see replicate/bench.py)
             "publishes": race_counts["publishes"],
             "crossings": (
                 race_counts["crossings"] if race_sanitized else None
@@ -585,6 +709,17 @@ def run_serve_bench(
                     if telemetry is not None and telemetry.anomaly
                     is not None else None
                 ),
+                # obs/ v3: request-scoped tracing, SLO accounting and
+                # the flight recorder — all versioned, all optional
+                # (disarmed runs carry None, bench_compare skips-with-
+                # note like the other one-sided blocks)
+                "reqtrace": reqtrace.block() if reqtrace.armed else None,
+                "slo": slo.block() if slo is not None else None,
+                "flight": (
+                    telemetry.flight.summary()
+                    if telemetry is not None and telemetry.flight
+                    is not None else None
+                ),
                 "status_port": (
                     telemetry.status.port
                     if telemetry is not None and telemetry.status
@@ -612,6 +747,8 @@ def run_serve_bench(
             "stats": stats,
         }
     finally:
+        reqtrace.release()  # drop the publish observer: each run owns
+        # its hop window (idempotent; no-op disarmed)
         if journal is not None:
             journal.close()
         if owns_journal:
@@ -630,6 +767,7 @@ def run_serve_soak(
     timeseries_path: str | None = None,
     timeseries_window: int = 8,
     watchdog_s: float = 0.0,
+    flight_path: str | None = None,
     log=print,
     **kw,
 ) -> tuple[BenchResult, dict]:
@@ -654,7 +792,8 @@ def run_serve_soak(
     telemetry = build_telemetry(
         status_port=status_port, timeseries_path=timeseries_path,
         timeseries_window=timeseries_window,
-        anomaly=True, watchdog_s=watchdog_s, stale_after=120.0, log=log,
+        anomaly=True, watchdog_s=watchdog_s, stale_after=120.0,
+        flight_path=flight_path, log=log,
     )
     import time as _time
 

@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..lint.race_sanitizer import published
 from ..obs.metrics import Counter
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
@@ -148,12 +149,22 @@ class OpJournal:  # graftlint: thread=hot
         self._m_records.inc()
         self._m_bytes.inc(len(line))
 
-    def round_record(
+    @published
+    def round_record(  # graftlint: publish=journal
         self, rnd: int, lanes: dict[int, list[tuple[int, int, int]]]
     ) -> None:
         """The write-ahead record for one macro-round: per class, the
         ``[doc, start_cursor, end_cursor]`` of every scheduled lane.
-        MUST be appended before the round's dispatch."""
+        MUST be appended before the round's dispatch.
+
+        Declared a publish point (``publish=journal``): the WAL append
+        is where a round's lane set leaves the hot thread's live state
+        and becomes durable — the journal-replay reader consumes it in
+        another lifetime (and, when the tiered-residency work moves
+        journaling off-thread, this point becomes the real queue
+        handoff).  Entries are counted in every journaled run (G017
+        ground truth) and request traces record the hop as their WAL
+        propagation edge (obs/reqtrace.py)."""
         self.append({
             "t": "round",
             "r": rnd,

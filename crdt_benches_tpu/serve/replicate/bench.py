@@ -31,7 +31,8 @@ import shutil
 import tempfile
 
 from ...bench.harness import BenchResult, save_results
-from ..bench import _parse_int_tuple
+from ...lint import race_sanitizer
+from ..bench import _parse_int_tuple, arm_reqtrace, parse_slo
 from ..faults import FaultInjector, FaultPlan
 from ..journal import OpJournal
 from ..pool import DocPool
@@ -68,6 +69,8 @@ def run_serve_repl_bench(
     faults=None,
     results_dir: str | None = None,
     save_name: str | None = None,
+    reqtrace_samples: int = 0,
+    slo_spec: str | None = None,
     log=print,
 ) -> tuple[BenchResult, dict]:
     """Build a replicated fleet, drain it, run the convergence +
@@ -97,13 +100,31 @@ def run_serve_repl_bench(
                 "(--serve-queue-cap); the replicated family's delivery "
                 "pacing is the broadcast bus's"
             )
+    # request tracing + SLO accounting (obs/ v3): same arming rule as
+    # the plain family — replica requests are requests.  Spec parse
+    # fails BEFORE the journal tempdir exists; the tracker (whose armed
+    # form installs the publish observer the finally releases) is
+    # constructed last before the try, same contract as the plain bench.
+    slo = parse_slo(slo_spec)
+
     owns_journal = journal_dir == "auto"
     if owns_journal:
         journal_dir = tempfile.mkdtemp(prefix="crdt_repl_journal_")
     journal = OpJournal(journal_dir) if journal_dir else None
 
+    reqtrace = arm_reqtrace(reqtrace_samples, slo, slo_spec, log,
+                            prefix="serve/repl")
+
     pool = None
     try:
+        # publish-point counters start BEFORE the first publish (the
+        # artifact's thread_crossings block is G017's ground truth for
+        # the bus surface — only this family drives it)
+        race_sanitizer.reset_counters()
+        race_sanitized = race_sanitizer.sanitizing()
+        if race_sanitized:
+            log("serve/repl: race sanitizer ARMED "
+                "(CRDT_BENCH_SANITIZE_RACES)")
         log(
             f"serve/repl: building fleet n_docs={n_docs} x "
             f"writers={writers} mix={mix_name} seed={seed}"
@@ -132,6 +153,7 @@ def run_serve_repl_bench(
             batch=batch, macro_k=macro_k, batch_chars=batch_chars,
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
+            reqtrace=reqtrace, slo=slo,
             warm_start=True,
         )
         stats = sched.run()
@@ -225,6 +247,24 @@ def run_serve_repl_bench(
                     "snapshot_every": snapshot_every,
                 },
                 "metrics": stats.metrics.to_dict(),
+                # G017 ground truth: the ONLY family that arms the
+                # broadcast-bus publish surface — without this block a
+                # dead BroadcastBus._cross_block annotation (and the
+                # silently missing bus hop in replica traces) would
+                # never be flagged
+                "thread_crossings": {
+                    "sanitized": race_sanitized,
+                    "status": False,  # repl family rejects --serve-status
+                    "journal": journal is not None,
+                    "bus": True,
+                    "publishes": race_sanitizer.counters()["publishes"],
+                    "crossings": (
+                        race_sanitizer.counters()["crossings"]
+                        if race_sanitized else None
+                    ),
+                },
+                "reqtrace": reqtrace.block() if reqtrace.armed else None,
+                "slo": slo.block() if slo is not None else None,
                 "verify_ok": report.converged,
                 "ra_ok": report.ra_ok,
             },
@@ -246,6 +286,7 @@ def run_serve_repl_bench(
             "scheduler": sched,
         }
     finally:
+        reqtrace.release()  # drop the publish observer (idempotent)
         if journal is not None:
             journal.close()
         if owns_journal:

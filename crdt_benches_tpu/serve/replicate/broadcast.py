@@ -40,6 +40,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ...lint.race_sanitizer import published
 from .group import GroupTable, ReplicaGroup
 
 
@@ -243,6 +244,19 @@ class BroadcastBus:  # graftlint: thread=hot
             self.reordered_rounds += 1
             self._reorder = None
 
+    @published
+    def _cross_block(self, gid: int, seq: int, owner: int) -> None:  # graftlint: publish=bus
+        """The block's cross-replica propagation edge, declared as a
+        publish point (``publish=bus``): publishing block ``seq`` is
+        the moment writer ``owner``'s ops leave its local log and fan
+        out to the group's peers.  The bus is host-side and
+        hot-confined today, so no object handoff happens here — the
+        point exists to COUNT the edge (G017 ground truth, one entry
+        per published block) and to give request traces their bus hop
+        (obs/reqtrace.py); when replication moves onto its own thread
+        (ROADMAP: device-collective delivery with a host control
+        plane), this becomes the real queue handoff."""
+
     def _publish(self, gs: _GroupState, rnd: int) -> None:
         g = gs.group
         budget = self.pub_ops
@@ -253,6 +267,7 @@ class BroadcastBus:  # graftlint: thread=hot
             gs.published = seq + 1
             gs.last_publish_round = rnd
             self.blocks_published += 1
+            self._cross_block(g.logical_id, seq, owner)
             if g.logical_id in self.publish_log:
                 self.publish_log[g.logical_id].append((rnd, seq))
             if self.journal is not None:

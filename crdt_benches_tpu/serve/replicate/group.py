@@ -55,15 +55,15 @@ class ReplicaGroup:
             return 0
         return self.blocks[min(n_blocks, len(self.blocks)) - 1][1]
 
-    def remote_intervals(self, writer: int, lo: int,
-                         hi: int) -> list[tuple[int, int]]:
-        """Sub-intervals of ``[lo, hi)`` authored by writers OTHER than
-        ``writer`` — the remote (downstream-merge) share of a staged
-        slice.  Host arithmetic over the few blocks a slice spans
-        (blocks are uniform ``turn_ops`` wide except the last)."""
-        out: list[tuple[int, int]] = []
+    def _remote_segments(self, writer: int, lo: int, hi: int):
+        """THE block walk: ``(a, b, owner)`` sub-segments of
+        ``[lo, hi)`` authored by writers other than ``writer``, in
+        stream order.  Host arithmetic over the few blocks a slice
+        spans (blocks are uniform ``turn_ops`` wide except the last);
+        every remote-share view below derives from this one walk so a
+        block-layout change lands in exactly one place."""
         if hi <= lo or not self.blocks:
-            return out
+            return
         turn = self.blocks[0][1] - self.blocks[0][0]
         seq = min(lo // turn, len(self.blocks) - 1)
         while seq < len(self.blocks):
@@ -72,11 +72,20 @@ class ReplicaGroup:
                 break
             a, b = max(lo, blo), min(hi, bhi)
             if b > a and w != writer:
-                if out and out[-1][1] == a:
-                    out[-1] = (out[-1][0], b)
-                else:
-                    out.append((a, b))
+                yield a, b, w
             seq += 1
+
+    def remote_intervals(self, writer: int, lo: int,
+                         hi: int) -> list[tuple[int, int]]:
+        """Sub-intervals of ``[lo, hi)`` authored by writers OTHER than
+        ``writer`` — the remote (downstream-merge) share of a staged
+        slice, adjacent segments coalesced."""
+        out: list[tuple[int, int]] = []
+        for a, b, _w in self._remote_segments(writer, lo, hi):
+            if out and out[-1][1] == a:
+                out[-1] = (out[-1][0], b)
+            else:
+                out.append((a, b))
         return out
 
     def split_local_remote(self, writer: int, lo: int,

@@ -165,6 +165,7 @@ class ReplicatedScheduler(FleetScheduler):
         cursors: every staged slice's ops split into the writer's own
         (upstream) share and the peers' broadcast (downstream-merge)
         share, counted under the landing capacity class."""
+        traced = self.reqtrace.armed
         for cls, lanes in plan.lanes.items():
             for lane in lanes:
                 st = lane.stream
@@ -173,11 +174,19 @@ class ReplicatedScheduler(FleetScheduler):
                 g, w = self.table.group_of(st.doc_id)
                 rem_ops = 0
                 rem_units = 0
-                for a, b in g.remote_intervals(w, st.cursor, lane.end):
+                by_writer: dict[int, int] | None = {} if traced else None
+                # ONE block walk per lane: interval sums and (armed
+                # only) per-writer attribution both fall out of
+                # _remote_segments (the coalesced remote_intervals
+                # view is for callers that need the interval list)
+                for a, b, ow in g._remote_segments(w, st.cursor,
+                                                   lane.end):
                     rem_ops += b - a
                     rem_units += (
                         st.units_before(b) - st.units_before(a)
                     )
+                    if by_writer is not None:
+                        by_writer[ow] = by_writer.get(ow, 0) + (b - a)
                 loc = (lane.end - st.cursor) - rem_ops
                 if rem_ops:
                     self.replica_metrics.note_merged(
@@ -185,6 +194,11 @@ class ReplicatedScheduler(FleetScheduler):
                     )
                     self.merged_ops += rem_ops
                     self.merged_unit_ops += rem_units
+                    if by_writer:
+                        # request-trace attribution: this replica's
+                        # merged ops belong to their ORIGINATING
+                        # writers (obs/reqtrace.py)
+                        self.reqtrace.note_remote(st.doc_id, by_writer)
                 if loc:
                     self.replica_metrics.note_local(loc)
                     self.local_ops += loc

@@ -85,6 +85,7 @@ from ..obs.metrics import (
     Histogram,
     MetricsRegistry,
 )
+from ..obs.reqtrace import RequestTracker
 from ..obs.trace import span
 from ..traces.tensorize import (
     INSERT,
@@ -410,6 +411,7 @@ class FleetScheduler:
                  degrade_after: int = 3, degrade_window: int = 8,
                  degrade_rounds: int = 4,
                  start_round: int = 0, profiler=None, telemetry=None,
+                 reqtrace=None, slo=None,
                  warm_start: bool = False):
         if overflow_policy not in ("defer", "shed"):
             raise ValueError(f"unknown overflow policy {overflow_policy!r}")
@@ -456,7 +458,13 @@ class FleetScheduler:
         )
         self.profiler = profiler  # obs/profiler.py DeviceProfiler (or None)
         self._pending_round: tuple[float, bool, bool] | None = None
-        self._admit_t: dict[int, float] = {}  # doc -> first-admission time
+        # request lifecycle (obs/reqtrace.py): disarmed, the tracker is
+        # exactly the old per-doc admission-timestamp table; armed
+        # (--serve-reqtrace / --serve-slo) every admission opens a full
+        # request context with segment timings + publish-point hops.
+        self.reqtrace = reqtrace if reqtrace is not None \
+            else RequestTracker()
+        self.slo = slo  # obs/slo.py SloTracker (or None)
         # one registry per drain: pool / journal / fault counters attach
         # to it so the artifact's metrics block carries the whole run
         reg = self.stats.metrics
@@ -465,6 +473,9 @@ class FleetScheduler:
             journal.bind_metrics(reg)
         if faults is not None:
             faults.bind_metrics(reg)
+        if slo is not None:
+            slo.bind(reg)  # burn-rate gauges pre-registered (G013)
+        self.reqtrace.bind(self.stats)
         self._m_faults_seen = reg.counter("serve.faults.seen")
         # continuous telemetry (obs/timeseries.py ServeTelemetry, or
         # None): per-round time-series windows, per-shard series, the
@@ -479,7 +490,7 @@ class FleetScheduler:
         self._sh_ops = [0] * n_sh
         self._sh_units = [0] * n_sh
         if telemetry is not None:
-            telemetry.bind(pool, reg)
+            telemetry.bind(pool, reg, reqtrace=self.reqtrace)
 
     # ---- degradation (automatic macro-K -> K=1 fallback) ----
 
@@ -562,12 +573,13 @@ class FleetScheduler:
     def _note_doc_drained(self, st: DocStream, tag: str | None = None
                           ) -> None:
         """One doc's stream is finished (drained, shed empty, or
-        quarantined): record admission-to-drain latency under its cause
-        tag.  Pops the admission timestamp, so the first observation
-        wins and a doc is never double-counted."""
-        t0 = self._admit_t.pop(st.doc_id, None)
-        if t0 is None:
-            return  # never admitted (or already recorded)
+        quarantined): close its request context and record the
+        admission-to-drain latency under its cause tag.  The close pops
+        the context, so each EPISODE is observed exactly once — and a
+        doc re-admitted after a close (quarantine-rebuild, the ingest
+        refill paths to come) opens a FRESH request context instead of
+        being double-counted under its old one (the PR 6 ``_admit_t``
+        doc-keyed scheme's bug, pinned by tests)."""
         if tag is None:
             if st.lossy:
                 tag = "shed"
@@ -575,7 +587,12 @@ class FleetScheduler:
                 tag = "deferred"
             else:
                 tag = "ok"
-        self.stats.note_doc_drained(tag, time.perf_counter() - t0)
+        dt = self.reqtrace.close_request(
+            st.doc_id, tag, round_no=self.round
+        )
+        if dt is None:
+            return  # never admitted (or this episode already closed)
+        self.stats.note_doc_drained(tag, dt)
 
     def _select(self, plan: _Plan) -> None:
         """Pick this macro-round's lanes: {class: [_Lane]}, bounded by
@@ -620,8 +637,10 @@ class FleetScheduler:
                 deferred.append(doc_id)
                 continue
             lanes.append(_Lane(stream=st, takes=takes, end=end))
-            if doc_id not in self._admit_t:
-                self._admit_t[doc_id] = time.perf_counter()
+            # the admission edge: one request context per episode
+            # (G012 allows context creation here, in the per-DOC
+            # selection loop — never in per-op inner loops)
+            self.reqtrace.open_request(doc_id, self.round, cap_cls=cls)
             scheduled.append(doc_id)
         # rotation: scheduled docs go to the back; deferred keep order.
         self._rr.extend(deferred)
@@ -1329,7 +1348,7 @@ class FleetScheduler:
         including its fault/degraded state.  Plain scalars only — the
         status server serializes whatever is published verbatim."""
         s = self.stats
-        return {
+        out = {
             "phase": "serving",
             "round": self.round,
             "rounds": self._n_rounds,
@@ -1348,6 +1367,11 @@ class FleetScheduler:
             "snapshots": s.snapshots,
             "done": False,
         }
+        if self.slo is not None:
+            # burn rates + top-K slowest docs with segment breakdowns
+            # (pure host arithmetic over pre-registered state, G013)
+            out["slo"] = self.slo.status_fields()
+        return out
 
     # ---- driver ----
 
@@ -1373,35 +1397,61 @@ class FleetScheduler:
         with hot_path():
             if self.profiler is not None:
                 self.profiler.round_begin()
+            rt = self.reqtrace
+            rt.round_begin()  # reset segment/hop accumulators (no-op
+            # disarmed; armed, this round's phase timings and publish-
+            # point entries fold into every scheduled doc's context)
             t0 = time.perf_counter()
             with span("serve.round", round=self.round):
                 if self.faults is not None:
                     with span("serve.faults.inject"):
                         self._fire_overflow()
-                with span("serve.plan"):
+                with span("serve.plan"), rt.segment("plan"):
                     plan = self._plan()
                 if plan is None:
                     return False
+                if rt.armed:
+                    # the lane set is final: publishes from here to the
+                    # drain fence carry exactly these docs' data, so
+                    # hop attribution (even for a mid-round close) is
+                    # scoped to them
+                    rt.note_scheduled(
+                        l.stream.doc_id
+                        for lanes in plan.lanes.values() for l in lanes
+                    )
                 if self.journal is not None:
                     # write-ahead: the lane set is durable BEFORE dispatch
-                    with span("serve.journal.wal"):
+                    with span("serve.journal.wal"), rt.segment("wal"):
                         self.journal.round_record(plan.base_round, {
                             cls: [[l.stream.doc_id, int(l.stream.cursor),
                                    int(l.end)]
                                   for l in lanes]
                             for cls, lanes in plan.lanes.items()
                         })
-                with span("serve.stage"):
+                with span("serve.stage"), rt.segment("stage"):
                     tensors = self._stage(plan)
                 if self.faults is not None:
-                    self._maybe_stall(plan.base_round)
-                with span("serve.moves"):
+                    # its own segment: an injected stall must show up
+                    # in request traces AS the stall, not as phantom
+                    # inter-round queue wait
+                    with rt.segment("faults"):
+                        self._maybe_stall(plan.base_round)
+                with span("serve.moves"), rt.segment("moves"):
                     self._execute_moves(plan)
                 if self.faults is not None:
                     with span("serve.faults.inject"):
                         self._fire_spool_fault(plan)
-                with span("serve.dispatch"):
+                with span("serve.dispatch"), rt.segment("dispatch"):
                     compiled = self._dispatch(plan, tensors)
+                if rt.armed:
+                    # fold BEFORE cursors advance (ops per lane still
+                    # derivable) and before _advance's closes, so a
+                    # request finishing this round carries this
+                    # round's segments and hops
+                    rt.fold_round(plan.base_round, [
+                        (l.stream.doc_id, l.end - l.stream.cursor)
+                        for lanes in plan.lanes.values() for l in lanes
+                    ])
                 self._advance(plan)
                 if self._planned_degraded:
                     with span("serve.degraded_fence"):

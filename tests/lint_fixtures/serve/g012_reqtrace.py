@@ -1,0 +1,38 @@
+"""G012/G013 seed: request-tracing edge discipline on the hot path.
+
+``hot_round`` is the declared hot root.  Opening request contexts and
+sampling exemplars is admission/drain-EDGE work: once per admitted doc
+in the depth-1 selection loop (the sanctioned pattern, shown clean),
+never in a per-op inner loop — a context per op allocates per op and
+exemplar-per-op explodes bucket state (G012).  Constructing the flight
+recorder or the request tracker mid-drain is driver-side lifecycle
+(the tracker installs a global publish observer when armed) — G013,
+the same contract as the status server.  ``driver_setup`` shows the
+identical calls are LEGAL off the hot call graph.
+"""
+
+from crdt_benches_tpu.obs.flight import FlightRecorder
+from crdt_benches_tpu.obs.reqtrace import RequestContext, RequestTracker
+
+TRACKER = RequestTracker()  # driver-built, disarmed: clean
+
+
+def hot_round(docs, ops):  # graftlint: hot-path
+    for doc in docs:  # the admission edge: one context per admitted doc
+        TRACKER.open_request(doc, 0)  # depth 1: clean
+        for op in ops[doc]:  # the per-op inner loop
+            TRACKER.open_request(doc, op)  # expect: G012
+            TRACKER.sample_exemplar("ok", 0.1, None)  # expect: G012
+            RequestContext(doc, op, 1, "default", 0)  # expect: G012
+    FlightRecorder("/tmp/flight.json")  # expect: G013
+    RequestTracker(samples=8)  # expect: G013
+
+
+def driver_setup(path):
+    # off the hot call graph: lifecycle construction and nested-loop
+    # sampling are the driver's (and the tests') business
+    tracker = RequestTracker(samples=8)
+    for doc in range(4):
+        for op in range(4):
+            tracker.sample_exemplar("ok", 0.2, None)
+    return FlightRecorder(path), tracker

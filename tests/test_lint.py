@@ -151,30 +151,35 @@ def test_g011_dead_fence_and_unattributed_counter():
 
 
 def test_g011_fence_tags_scope_the_accounting():
-    """chaos/journal fences are only dead-checked against artifacts
-    whose run could have crossed them; cold fences never are."""
+    """chaos/journal/flight fences are only dead-checked against
+    artifacts whose run could have crossed them; cold fences never
+    are.  The flight tag keys on an actual DUMP, not on chaos — a
+    chaos run whose faults all recover never enters the flight
+    trigger, so chaos-scoping it would false-positive."""
     import json
     import tempfile
 
     src = (
         "def drain():  # graftlint: hot-path\n"
-        "    chaos_repair(); barrier(); api()\n"
+        "    chaos_repair(); barrier(); dump(); api()\n"
         "def chaos_repair():  # graftlint: fence=chaos\n"
         "    return 1\n"
         "def barrier():  # graftlint: fence=journal\n"
         "    return 2\n"
-        "def api():  # graftlint: fence=cold\n"
+        "def dump():  # graftlint: fence=flight\n"
         "    return 3\n"
+        "def api():  # graftlint: fence=cold\n"
+        "    return 4\n"
     )
     with tempfile.TemporaryDirectory() as td:
         mod = Path(td) / "serve_mod.py"
         mod.write_text(src)
 
-        def artifact(chaos, journal):
-            p = Path(td) / f"a_{chaos}_{journal}.json"
+        def artifact(chaos, journal, flight=False):
+            p = Path(td) / f"a_{chaos}_{journal}_{flight}.json"
             p.write_text(json.dumps({"boundary_syncs": {
                 "sanitized": True, "chaos": chaos, "journal": journal,
-                "entries": {}, "syncs": {},
+                "flight": flight, "entries": {}, "syncs": {},
             }}))
             return str(p)
 
@@ -186,7 +191,13 @@ def test_g011_fence_tags_scope_the_accounting():
             [str(mod)], sync_artifact=artifact(True, True)
         )
         dead = {f.msg.split("`")[1] for f in loud}
-        assert dead == {"chaos_repair", "barrier"}  # cold stays exempt
+        # a chaos run that never dumped leaves the flight fence exempt
+        assert dead == {"chaos_repair", "barrier"}
+        dumped = run_lint(
+            [str(mod)], sync_artifact=artifact(True, True, flight=True)
+        )
+        dead = {f.msg.split("`")[1] for f in dumped}
+        assert dead == {"chaos_repair", "barrier", "dump"}
 
 
 def test_hot_walk_covers_subclass_overrides(tmp_path):

@@ -1,0 +1,844 @@
+"""obs/ v3: request-scoped causal tracing, SLO burn-rate accounting,
+and the anomaly flight recorder.
+
+Contracts under test:
+
+- the ``--serve-slo`` grammar parses every documented shape and FAILS
+  on every malformed one (a typo'd objective silently gating nothing
+  is worse than none);
+- burn rates follow the multi-window math: violations/budget over the
+  fast and slow request windows, a spike separable from a sustained
+  burn, compliance cumulative;
+- the DISARMED RequestTracker is the identity path: one shared no-op
+  segment object, the bare admission-timestamp table, no observer
+  installed (the ``@boundary`` / NOOP_SPAN contract);
+- episode semantics pin the PR 6 ``_admit_t`` fix: each episode is
+  observed exactly once, a re-admitted doc opens a FRESH context with
+  its own admission clock — never double-counted under the old one;
+- request traces record their publish-point hops (status, journal
+  WAL, broadcast bus) and every hop is a subset of the race
+  sanitizer's publish counters — the two are one causal picture;
+- replica-merge ops are attributed to their ORIGINATING writers and
+  sum to the scheduler's merge totals;
+- exemplars land in exactly the histogram bucket their latency
+  observes into (shared bounds, shared bisect);
+- the flight recorder's ring is bounded, its dump is schema-valid and
+  atomic, repeated triggers accumulate reasons, and the CLI validator
+  gates exactly like the smoke does;
+- an anomaly fire (and an anomaly still active at drain end) triggers
+  the dump through the telemetry bundle;
+- ``tools/bench_compare.py`` gates the drain p99.9 and the SLO
+  compliance floor, one-sided like the other obs blocks.
+"""
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from crdt_benches_tpu.lint import race_sanitizer
+from crdt_benches_tpu.obs.anomaly import AnomalyDetector
+from crdt_benches_tpu.obs.flight import (
+    FlightRecorder,
+    validate_flight,
+    validate_flight_file,
+)
+from crdt_benches_tpu.obs.flight import main as flight_main
+from crdt_benches_tpu.obs.reqtrace import (
+    NOOP_SEGMENT,
+    SEGMENTS,
+    RequestTracker,
+)
+from crdt_benches_tpu.obs.slo import (
+    SloSpecError,
+    SloTracker,
+    parse_slo_spec,
+)
+from crdt_benches_tpu.obs.timeseries import ServeTelemetry
+from crdt_benches_tpu.serve.journal import OpJournal
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import (
+    FleetScheduler,
+    prepare_streams,
+)
+from crdt_benches_tpu.serve.workload import build_fleet
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_BANDS = {"synth-small": ("synth", (40, 120))}
+TINY_MIX = {"synth-small": 1.0}
+
+
+def _fleet(tmp_path, n=8, seed=11, classes=(128,), slots=(4,),
+           bands=TINY_BANDS, mix=TINY_MIX, arrival_span=2, batch=8,
+           batch_chars=32, macro_k=4, **kw):
+    sessions = build_fleet(
+        n, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands
+    )
+    pool = DocPool(classes=classes, slots=slots,
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(
+        sessions, pool, batch=batch, batch_chars=batch_chars
+    )
+    sched = FleetScheduler(pool, streams, batch=batch, macro_k=macro_k,
+                           batch_chars=batch_chars, **kw)
+    return sessions, pool, streams, sched
+
+
+# ---------------------------------------------------------------------------
+# the --serve-slo grammar
+# ---------------------------------------------------------------------------
+
+
+def test_slo_spec_grammar_parses_documented_shapes():
+    objs = parse_slo_spec("default=p99:250,c4096=p99.9:1500")
+    assert set(objs) == {"default", "c4096"}
+    assert objs["default"].quantile == pytest.approx(0.99)
+    assert objs["default"].threshold_s == pytest.approx(0.250)
+    assert objs["default"].budget == pytest.approx(0.01)
+    assert objs["c4096"].quantile == pytest.approx(0.999)
+    assert objs["c4096"].threshold_s == pytest.approx(1.5)
+    # whitespace and a trailing comma are tolerated
+    assert set(parse_slo_spec(" default=p90:10 , ")) == {"default"}
+
+
+@pytest.mark.parametrize("bad", [
+    "",                    # names no objective
+    "default",             # no '='
+    "default=99:250",      # quantile not spelled pQ
+    "default=p99",         # no ':MS'
+    "default=pXX:250",     # unparsable quantile
+    "default=p0:250",      # quantile out of (0, 1)
+    "default=p100:250",
+    "default=p99:-5",      # non-positive threshold
+    "default=p99:nan",     # nan passes a bare <=0 check, gates nothing
+    "default=p99:inf",     # infinite threshold gates nothing
+    "default=pnan:250",    # nan quantile
+    "=p99:250",            # empty class name: unroutable objective
+    "default=p99:250,default=p95:100",  # duplicate class
+])
+def test_slo_spec_grammar_rejects_malformed(bad):
+    with pytest.raises(SloSpecError):
+        parse_slo_spec(bad)
+
+
+def test_burn_rate_multi_window_math_and_compliance():
+    slo = SloTracker.from_spec("default=p90:100")  # budget = 10%
+    st = slo.classes["default"]
+    # 60 compliant requests: burn 0 on both windows, compliance 1.0
+    for _ in range(60):
+        slo.note_request("default", 0.010, doc_id=0)
+    assert st.to_dict()["burn_rate_fast"] == 0.0
+    assert st.compliance == 1.0
+    # a spike: 16 violations — the fast window (64) sees 16/64 = 25%
+    # of requests violating against a 10% budget -> burn 2.5; the slow
+    # window (512) holds all 76 -> 16/76 ~ 21% -> burn ~2.1; the spike
+    # reads HOTTER on the fast window, the separation the two windows
+    # exist for
+    for _ in range(16):
+        slo.note_request("default", 0.500, doc_id=1)
+    d = st.to_dict()
+    assert d["burn_rate_fast"] == pytest.approx((16 / 64) / 0.10)
+    assert d["burn_rate_slow"] == pytest.approx((16 / 76) / 0.10)
+    assert d["burn_rate_fast"] > d["burn_rate_slow"]
+    assert st.compliance == pytest.approx(1.0 - 16 / 76)
+    # an unclassified request never crashes the hot path — counted
+    slo.note_request("c9999", 0.001, doc_id=2)
+    assert slo.unclassified == 1
+
+
+def test_slo_classify_prefers_named_class_then_default():
+    slo = SloTracker.from_spec("default=p99:250,c4096=p99.9:1500")
+    assert slo.classify(4096) == "c4096"
+    assert slo.classify(256) == "default"
+    assert slo.classify(None) == "default"
+    named_only = SloTracker.from_spec("c256=p99:100")
+    assert named_only.classify(256) == "c256"
+    # no default objective: the budget class still carries the truth
+    assert named_only.classify(1024) == "c1024"
+
+
+def test_slo_top_k_slowest_with_segments():
+    slo = SloTracker(parse_slo_spec("default=p99:100"), top_k=3)
+    for i in range(8):
+        slo.note_request(
+            "default", latency_s=float(i), doc_id=i,
+            segments={"queue": float(i) / 2},
+        )
+    worst = slo.slowest()
+    assert [e["doc"] for e in worst] == [7, 6, 5]  # worst first, K=3
+    assert worst[0]["segments"] == {"queue": 3.5}
+    blk = slo.block()
+    assert blk["version"] == 1
+    assert blk["windows"] == {"fast": 64, "slow": 512}
+    assert blk["slow_docs"] == worst
+
+
+# ---------------------------------------------------------------------------
+# request tracker: disarmed identity, episode semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_tracker_is_the_identity_table():
+    before = list(race_sanitizer._publish_observers)
+    rt = RequestTracker()  # samples=0, no slo: disarmed
+    assert not rt.armed
+    # no observer installed, no release needed
+    assert race_sanitizer._publish_observers == before
+    # one shared no-op segment object (the NOOP_SPAN contract)
+    assert rt.segment("plan") is NOOP_SEGMENT
+    assert rt.segment("dispatch") is NOOP_SEGMENT
+    with rt.segment("plan"):
+        pass  # enter/exit are empty
+    # the bare admission-timestamp table: open stores a float, close
+    # pops it and returns the latency; everything else is a no-op
+    rt.open_request(7, 0, cap_cls=128)
+    rt.round_begin()
+    rt.fold_round(0, [(7, 5)])
+    dt = rt.close_request(7, "ok")
+    assert dt is not None and dt >= 0
+    assert rt.close_request(7, "ok") is None  # already popped
+    assert rt.requests_opened == 0  # armed-side counters untouched
+    assert rt.sampled() == [] and rt._active == {}
+
+
+def test_armed_tracker_episode_semantics_and_exactly_once(tmp_path):
+    rt = RequestTracker(samples=4)
+    try:
+        assert rt.armed
+        rt.open_request(3, 0, cap_cls=128)
+        rt.open_request(3, 1, cap_cls=128)  # already active: no-op
+        assert rt.requests_opened == 1
+        time.sleep(0.01)
+        dt1 = rt.close_request(3, "quarantined", round_no=2)
+        assert dt1 is not None and dt1 >= 0.01
+        # exactly once per episode: a second close records nothing
+        assert rt.close_request(3, "quarantined") is None
+        assert rt.requests_closed == 1
+        # re-admission opens a FRESH context: new episode, new id, the
+        # admission clock restarted (the PR 6 _admit_t scheme kept one
+        # doc-keyed timestamp, double-counting the rebuilt episode)
+        t_re = time.perf_counter()
+        rt.open_request(3, 5, cap_cls=128)
+        ctx = rt._active[3]
+        assert ctx.episode == 2 and rt.reopened == 1
+        assert ctx.admit_t >= t_re
+        dt2 = rt.close_request(3, "ok", round_no=6)
+        assert rt.requests_closed == 2
+        # episode 2 measured from ITS OWN admission, not episode 1's
+        assert dt2 < dt1
+        eps = [t["episode"] for t in rt.sampled()]
+        assert eps == [1, 2]
+        causes = [t["cause"] for t in rt.sampled()]
+        assert causes == ["quarantined", "ok"]
+    finally:
+        rt.release()
+    # release dropped the observer (idempotent)
+    rt.release()
+    assert rt._on_publish not in race_sanitizer._publish_observers
+
+
+def test_scheduler_readmission_opens_fresh_episode(tmp_path):
+    """The fix pin at the scheduler surface: `_note_doc_drained` +
+    `open_request` on a real FleetScheduler observe each EPISODE
+    exactly once in the cause-tagged histograms."""
+    rt = RequestTracker(samples=8)
+    try:
+        _s, _p, _st, sched = _fleet(tmp_path, reqtrace=rt)
+        doc = next(iter(sched.streams))
+        st = sched.streams[doc]
+        sched.reqtrace.open_request(doc, 0, cap_cls=128)
+        time.sleep(0.005)
+        sched._note_doc_drained(st, tag="quarantined")
+        h_q = sched.stats.doc_latency["quarantined"]
+        assert h_q.count == 1 and rt.requests_closed == 1
+        # the old double-count shape: a second drain note for the same
+        # episode must record NOTHING
+        sched._note_doc_drained(st, tag="quarantined")
+        assert h_q.count == 1 and rt.requests_closed == 1
+        # re-admitted (quarantine-rebuild / the ingest refill to come):
+        # a fresh episode, closed under its own cause and clock
+        sched.reqtrace.open_request(doc, 3, cap_cls=128)
+        sched._note_doc_drained(st, tag="ok")
+        assert sched.stats.doc_latency["ok"].count == 1
+        assert rt.requests_closed == 2 and rt.reopened == 1
+        # total histogram observations == closed episodes: no loss, no
+        # double count
+        total = sum(
+            h.count for h in sched.stats.doc_latency.values()
+        )
+        assert total == rt.requests_closed
+    finally:
+        rt.release()
+
+
+def test_dropped_requests_burn_error_budget():
+    """A shed/quarantined close is an SLO violation no matter how
+    fast the drop was — dropped traffic reading as compliant would
+    let a mass-shed regression sail through the compliance gate."""
+    slo = SloTracker.from_spec("default=p90:60000")
+    rt = RequestTracker(samples=8, slo=slo)
+    try:
+        rt.open_request(1, 0)
+        rt.close_request(1, "ok", round_no=1)       # fast, served
+        rt.open_request(2, 0)
+        rt.close_request(2, "shed", round_no=1)     # fast, DROPPED
+        rt.open_request(3, 0)
+        rt.close_request(3, "quarantined", round_no=1)
+        rt.open_request(4, 0)
+        rt.close_request(4, "deferred", round_no=1)  # late but served
+        st = slo.classes["default"]
+        assert st.requests == 4 and st.violations == 2
+        blk = slo.block()["classes"]["default"]
+        assert blk["compliance"] == pytest.approx(0.5)
+    finally:
+        rt.release()
+
+
+def test_round_hops_attach_only_to_scheduled_docs():
+    """Hops scope to the round's LANE SET: a doc closed mid-round while
+    not scheduled (deferred off a lost shard, then quarantined) must
+    not be stamped with publish edges its data never rode, while a
+    scheduled doc closed after the WAL publish keeps them."""
+    rt = RequestTracker(samples=8)
+    try:
+        rt.open_request(1, 0, cap_cls=128)
+        rt.open_request(2, 0, cap_cls=128)
+        rt.round_begin()
+        rt.note_scheduled([1])  # doc 2 deferred out of this round
+        rt._on_publish("OpJournal.round_record")  # the WAL fires
+        rt.close_request(2, "quarantined", round_no=0)
+        # trailing publish (the end-of-round status snapshot fires
+        # AFTER fold/close): round_begin unions it into the prior lane
+        # set's still-active contexts — doc 1 gets the status edge,
+        # the closed doc 2 stays untouched
+        rt._on_publish("StatusServer.publish_status")
+        rt.round_begin()
+        rt.close_request(1, "ok", round_no=1)
+        by_doc = {t["doc"]: t for t in rt.sampled()}
+        assert by_doc[1]["hops"] == [
+            "OpJournal.round_record", "StatusServer.publish_status"
+        ]
+        assert by_doc[2]["hops"] == []
+    finally:
+        rt.release()
+
+
+def test_malformed_slo_spec_fails_before_resources(tmp_path, monkeypatch):
+    """A malformed --serve-slo spec fails the run BEFORE the journal
+    tempdir / telemetry threads are acquired — the resource-releasing
+    finally is never reached, so there must be nothing to release."""
+    from crdt_benches_tpu.serve import bench as serve_bench
+
+    acquired = []
+    monkeypatch.setattr(
+        serve_bench.tempfile, "mkdtemp",
+        lambda *a, **k: acquired.append("journal") or str(tmp_path / "j"),
+    )
+    monkeypatch.setattr(
+        serve_bench, "build_telemetry",
+        lambda **k: acquired.append("telemetry"),
+    )
+    with pytest.raises(SloSpecError):
+        serve_bench.run_serve_bench(
+            mix=TINY_MIX, bands=TINY_BANDS, n_docs=2,
+            journal_dir="auto", status_port=0,
+            slo_spec="default=99:250",  # missing the 'p'
+            results_dir=str(tmp_path),
+        )
+    assert acquired == []
+
+
+# ---------------------------------------------------------------------------
+# armed drains: segments, hops, exemplars, SLO block
+# ---------------------------------------------------------------------------
+
+
+def test_armed_drain_traces_requests_with_segments(tmp_path):
+    slo = SloTracker.from_spec("default=p99:60000")
+    rt = RequestTracker(samples=64, slo=slo)
+    try:
+        _s, _p, streams, sched = _fleet(
+            tmp_path, n=8, reqtrace=rt, slo=slo
+        )
+        stats = sched.run()
+        assert sched.done
+        assert rt.requests_opened == len(streams)
+        assert rt.requests_closed == rt.requests_opened
+        assert not rt._active
+        traces = rt.sampled()
+        assert len(traces) == len(streams)
+        for t in traces:
+            assert t["cause"] == "ok"
+            assert t["rounds"] >= 1 and t["ops"] >= 1
+            assert t["latency_s"] > 0
+            assert set(t["segments"]) <= set(SEGMENTS)
+            # a drained doc spent time in the timed phases
+            assert sum(t["segments"].values()) > 0
+            assert t["segments"].get("plan", 0) >= 0
+        # ops fold exactly: per-trace ops sum to the drain total
+        assert sum(t["ops"] for t in traces) == stats.ops
+        # every request landed in the (generous) objective
+        blk = slo.block()
+        assert blk["classes"]["default"]["requests"] == len(streams)
+        assert blk["classes"]["default"]["compliance"] == 1.0
+        assert blk["classes"]["default"]["violations"] == 0
+        assert [e["latency_s"] for e in blk["slow_docs"]] == sorted(
+            (e["latency_s"] for e in blk["slow_docs"]), reverse=True
+        )
+        # the artifact block round-trips through JSON
+        rb = json.loads(json.dumps(rt.block()))
+        assert rb["version"] == 1 and rb["armed"] is True
+        assert rb["requests_closed"] == len(streams)
+    finally:
+        rt.release()
+
+
+def test_trace_hops_cover_declared_publish_points(tmp_path):
+    """With the journal armed, every trace's WAL hop is recorded, and
+    the hop set is a subset of the race sanitizer's publish counters —
+    the G017 ground truth (the smoke cross-checks the same invariant
+    on the full artifact)."""
+    race_sanitizer.reset_counters()
+    rt = RequestTracker(samples=64)
+    journal = OpJournal(str(tmp_path / "wal"))
+    try:
+        _s, _p, streams, sched = _fleet(
+            tmp_path, n=6, reqtrace=rt, journal=journal,
+        )
+        sched.run()
+        assert sched.done
+        assert rt.hop_counts.get("OpJournal.round_record", 0) >= 1
+        publishes = set(race_sanitizer.counters()["publishes"])
+        assert set(rt.hop_counts) <= publishes
+        traces = rt.sampled()
+        assert traces
+        for t in traces:
+            assert set(t["hops"]) <= set(rt.hop_counts)
+            # every drained doc rode at least one WAL record
+            assert "OpJournal.round_record" in t["hops"]
+    finally:
+        rt.release()
+        journal.close()
+
+
+def test_exemplars_agree_with_histogram_buckets(tmp_path):
+    rt = RequestTracker(samples=64)
+    try:
+        _s, _p, streams, sched = _fleet(tmp_path, n=8, reqtrace=rt)
+        sched.run()
+        assert sched.done
+        assert rt.exemplars, "no exemplar sampled over a full drain"
+        from bisect import bisect_left
+        for tag, buckets in rt.exemplars.items():
+            h = sched.stats.doc_latency[tag]
+            for i, ex in buckets.items():
+                # the exemplar's bucket is exactly where its latency
+                # observes into the histogram (shared bounds + bisect)
+                assert bisect_left(h.bounds, float(ex["latency_s"])) == i
+                assert h.counts[i] >= 1, (
+                    f"exemplar in empty bucket {tag}[{i}]"
+                )
+        # the artifact block serializes bucket indices as strings
+        blk = rt.block()
+        for tag, buckets in blk["exemplars"].items():
+            assert all(isinstance(k, str) for k in buckets)
+    finally:
+        rt.release()
+
+
+def test_replica_merge_attributed_to_originating_writer(tmp_path):
+    from crdt_benches_tpu.serve.replicate.bench import (
+        run_serve_repl_bench,
+    )
+
+    r, info = run_serve_repl_bench(
+        mix=TINY_MIX, n_docs=4, writers=2, batch=16, macro_k=4,
+        batch_chars=64, classes=(128,), slots=(8,), bands=TINY_BANDS,
+        arrival_span=2, turn_ops=8, seed=3,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        reqtrace_samples=64,
+        log=lambda *_a, **_k: None,
+    )
+    assert info["verify_ok"]
+    sched = info["scheduler"]
+    rt = sched.reqtrace
+    # the bus hop is on the causal picture
+    assert rt.hop_counts.get("BroadcastBus._cross_block", 0) >= 1
+    traces = rt.sampled()
+    assert traces
+    merged_by_trace = 0
+    for t in traces:
+        # writers=2: every remote op came from writer 0 or 1, and a
+        # replica never attributes its OWN writer's ops as remote
+        w_self = t["doc"] % 2
+        assert set(t["remote_ops"]) <= {"0", "1"} - {str(w_self)}
+        merged_by_trace += sum(t["remote_ops"].values())
+    # attribution partitions the merge total exactly
+    assert merged_by_trace == sched.merged_ops
+    # the artifact carries the block
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    assert d["extra"]["reqtrace"]["requests_closed"] == len(traces)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def _rounds(rec, n, t0=0.0):
+    for i in range(n):
+        rec.note_round({"round": i, "seconds": t0 + 0.01})
+
+
+def test_flight_dump_roundtrip_bounded_ring_and_validator(tmp_path, capsys):
+    path = str(tmp_path / "flight.json")
+    rec = FlightRecorder(path, ring=4)
+    _rounds(rec, 10)
+    assert rec.rounds_seen == 10 and len(rec.rounds) == 4
+    rec.trigger(
+        "anomaly:stuck_round",
+        requests=[{"doc": 3, "request": 0, "segments": {}}],
+        anomalies=["stuck_round"],
+    )
+    assert validate_flight_file(path) == []
+    with open(path) as f:
+        d = json.load(f)
+    assert d["version"] == 1 and d["dump_index"] == 1
+    assert [r["round"] for r in d["rounds"]] == [6, 7, 8, 9]  # last 4
+    assert d["requests"][0]["doc"] == 3
+    assert d["anomalies"] == ["stuck_round"]
+    assert d["metrics"] is None
+    # a later trigger REPLACES the file; reasons accumulate
+    rec.note_round({"round": 10, "seconds": 0.5})
+    rec.trigger("unrecovered_fault")
+    with open(path) as f:
+        d2 = json.load(f)
+    assert d2["dump_index"] == 2
+    assert d2["reasons"] == ["anomaly:stuck_round", "unrecovered_fault"]
+    assert d2["rounds"][-1]["round"] == 10
+    assert rec.summary()["dumps"] == 2
+    # the CLI validator the chaos smoke gates on
+    assert flight_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "valid flight dump" in out
+    assert flight_main([]) == 2  # usage
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert flight_main([str(bad)]) == 1
+
+
+def test_flight_dump_is_best_effort_on_unwritable_path(tmp_path):
+    """A dump that cannot be written must never raise out of the
+    trigger — it would kill a run the anomaly would have cleared (or,
+    on the crash path, replace the exception it documents).  Failures
+    are counted and surfaced in the artifact's flight block."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("")  # a FILE where the dump wants a directory
+    rec = FlightRecorder(str(blocker / "flight.json"))
+    rec.note_round({"round": 0, "seconds": 0.1})
+    rec.trigger("anomaly:stuck_round")  # must not raise
+    s = rec.summary()
+    assert s["dumps"] == 0 and s["dump_failures"] == 1
+    assert s["last_error"] and s["reasons"] == ["anomaly:stuck_round"]
+    # an unserializable snapshot is the same contract
+    ok = FlightRecorder(str(tmp_path / "flight.json"))
+    ok.note_round({"round": 0, "seconds": 0.1})
+    ok.trigger("x", status={"bad": object()})  # must not raise
+    assert ok.summary()["dump_failures"] == 1
+    # ...and a later healthy trigger still dumps, with a clean index
+    ok.trigger("y")
+    assert ok.summary()["dumps"] == 1
+    with open(tmp_path / "flight.json") as f:
+        d = json.load(f)
+    assert d["dump_index"] == 1 and d["reasons"] == ["x", "y"]
+
+
+def test_flight_validator_rejects_structural_damage():
+    assert validate_flight([]) == ["top level must be an object"]
+    good = {
+        "version": 1, "reason": "x", "dump_index": 1,
+        "rounds": [{"round": 0, "seconds": 0.1}],
+        "requests": [], "metrics": None, "anomalies": [],
+    }
+    assert validate_flight(good) == []
+    for mutate, frag in [
+        (lambda d: d.update(version=2), "version"),
+        (lambda d: d.update(reason=""), "reason"),
+        (lambda d: d.update(dump_index=0), "dump_index"),
+        (lambda d: d.update(rounds=[]), "rounds is empty"),
+        (lambda d: d.update(rounds=[{"seconds": 1.0}]), "'round'"),
+        (lambda d: d.update(rounds=[{"round": 1}]), "'seconds'"),
+        (lambda d: d.update(requests=[{"nope": 1}]), "requests[0]"),
+        (lambda d: d.update(metrics={"no": "version"}), "metrics"),
+        (lambda d: d.update(anomalies=None), "anomalies"),
+    ]:
+        d = json.loads(json.dumps(good))
+        mutate(d)
+        errs = validate_flight(d)
+        assert errs and any(frag in e for e in errs), (frag, errs)
+
+
+def test_anomaly_fire_triggers_flight_dump_through_telemetry(tmp_path):
+    path = str(tmp_path / "flight.json")
+    tel = ServeTelemetry(
+        anomaly=AnomalyDetector(watchdog_s=0.05),
+        flight=FlightRecorder(path),
+    )
+
+    def round_(i, secs):
+        tel.note_round(
+            round_no=i, seconds=secs, compiled=False, barrier=False,
+            occupancy=0.5, queue_depth=0, cum={"ops": 100 * (i + 1)},
+            shard_lanes=[1], shard_ops=[100], shard_units=[100],
+            status={"round": i},
+        )
+
+    for i in range(5):
+        round_(i, 0.01)
+    assert not Path(path).exists()  # healthy rounds never dump
+    round_(5, 0.2)  # trips the watchdog
+    assert Path(path).exists()
+    assert validate_flight_file(path) == []
+    with open(path) as f:
+        d = json.load(f)
+    assert d["reason"].startswith("anomaly:stuck_round")
+    assert [r["round"] for r in d["rounds"]] == [0, 1, 2, 3, 4, 5]
+    assert d["anomalies"] == ["stuck_round"]
+    assert d["status"]["round"] == 5
+    # the fire is dumped ONCE, not re-dumped every later round
+    round_(6, 0.01)  # clears the watchdog
+    with open(path) as f:
+        assert json.load(f)["dump_index"] == 1
+    # a STILL-ACTIVE anomaly at drain end dumps the post-mortem the
+    # exit code used to discard
+    round_(7, 0.3)
+    tel.drain_end({"phase": "done"})
+    with open(path) as f:
+        d = json.load(f)
+    assert d["dump_index"] == 3  # fire at 7, then the drain-end dump
+    assert d["reason"].startswith("drain_end_active_anomaly:")
+    assert d["reasons"][0].startswith("anomaly:")
+
+
+def test_run_serve_bench_flight_via_telemetry_stays_quiet_when_clean(tmp_path):
+    """An armed flight recorder on a CLEAN drain writes nothing; the
+    artifact's flight block says where a dump would have gone."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    flight = str(tmp_path / "flight.json")
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=8, batch=16, classes=(128,), slots=(8,),
+        seed=2, arrival_span=2, verify_sample=2, bands=TINY_BANDS,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        flight_path=flight,
+        reqtrace_samples=8, slo_spec="default=p99:60000",
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    assert not Path(flight).exists()
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    fb = d["extra"]["flight"]
+    assert fb["path"] == flight and fb["dumps"] == 0
+    assert fb["rounds_seen"] == d["extra"]["rounds"]
+    # reqtrace + slo blocks ride the same artifact
+    assert d["extra"]["reqtrace"]["requests_closed"] == 8
+    assert d["extra"]["slo"]["classes"]["default"]["requests"] == 8
+    # hops ⊆ the artifact's thread-crossing publishes (the smoke's
+    # cross-check, at unit scale)
+    pubs = set(d["extra"]["thread_crossings"]["publishes"])
+    for t in d["extra"]["reqtrace"]["traces"]:
+        assert set(t["hops"]) <= pubs
+    # boundary_syncs accounts the flight fence per DRAIN: no dump this
+    # run, surface unarmed for G011
+    assert d["extra"]["boundary_syncs"]["flight"] is False
+
+
+def test_soak_shared_recorder_flight_surface_is_per_drain(tmp_path):
+    """Under soak the flight recorder is shared across iterations: a
+    clean drain after an earlier iteration's dump must record
+    boundary_syncs.flight=False (its own fence counters were reset, so
+    inheriting the cumulative dump would hand G011 a false dead
+    fence)."""
+    from crdt_benches_tpu.serve.bench import build_telemetry, \
+        run_serve_bench
+
+    flight = str(tmp_path / "flight.json")
+    telemetry = build_telemetry(flight_path=flight, log=lambda *_: None)
+    # "iteration 1" dumped (anomaly fired in an earlier soak drain)
+    telemetry.flight.trigger("anomaly:stuck_round")
+    assert telemetry.flight.dumps == 1
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=8, batch=16, classes=(128,), slots=(8,),
+        seed=2, arrival_span=2, verify_sample=2, bands=TINY_BANDS,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        telemetry=telemetry,
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    assert d["extra"]["flight"]["dumps"] == 1  # cumulative block
+    assert d["extra"]["boundary_syncs"]["flight"] is False  # per-drain
+
+
+def test_disarmed_artifact_carries_no_v3_blocks(tmp_path):
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=4, batch=16, classes=(128,), slots=(4,),
+        seed=5, arrival_span=2, verify_sample=2, bands=TINY_BANDS,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    assert d["extra"]["reqtrace"] is None
+    assert d["extra"]["slo"] is None
+    assert d["extra"]["flight"] is None
+
+
+def test_armed_overhead_smoke(tmp_path):
+    """Tracing + SLO accounting at smoke scale stays in the same cost
+    regime as the disarmed drain (the exact ≤2% acceptance runs at
+    full fleet scale through bench_compare — a unit-scale 2% timing
+    assertion would be flake, so this bound is deliberately loose)."""
+    def drain(arm):
+        rt = RequestTracker(
+            samples=64 if arm else 0,
+            slo=SloTracker.from_spec("default=p99:60000") if arm
+            else None,
+        )
+        try:
+            _s, _p, _st, sched = _fleet(
+                tmp_path, n=8, seed=7, reqtrace=rt, slo=rt.slo
+            )
+            t0 = time.perf_counter()
+            sched.run()
+            return time.perf_counter() - t0
+        finally:
+            rt.release()
+
+    drain(False)  # warm compile caches out of the measurement
+    plain = min(drain(False) for _ in range(2))
+    armed = min(drain(True) for _ in range(2))
+    assert armed <= plain * 1.5 + 0.05
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the obs/ v3 gates
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_v3", REPO / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare_v3"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(tmp_path, name, *, pps=100_000.0, p999=0.8,
+              compliance=0.995, requests=900, with_v3=True):
+    extra = {
+        "family": "serve",
+        "patches_per_sec": pps,
+        "batch_latency": {"p50": 0.002, "p95": 0.004, "p99": 0.005},
+        "rounds": 20,
+        "range_ops": 10_000,
+        "journal": None,
+        "boundary_syncs": {"entries": {"DocPool.block": 40}},
+    }
+    if with_v3:
+        extra["doc_drain_latency"] = {
+            "ok": {"count": 1000, "quantiles": {
+                "p50": 0.1, "p99": 0.5, "p99.9": p999,
+            }},
+        }
+        extra["slo"] = {
+            "version": 1,
+            "classes": {
+                "default": {"requests": requests,
+                            "compliance": compliance},
+                "c4096": {"requests": 100, "compliance": 0.999},
+                "idle": {"requests": 0, "compliance": 1.0},
+            },
+        }
+    data = [{"group": "serve", "trace": "mixed", "backend": "512",
+             "extra": extra}]
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_gates_drain_p999_and_slo_floor(tmp_path, capsys):
+    bc = _bench_compare()
+    base = _artifact(tmp_path, "base.json")
+    same = _artifact(tmp_path, "same.json")
+    assert bc.main([same, base]) == 0
+
+    # p99.9 doubled: past even the loose default 75% gate
+    slow = _artifact(tmp_path, "slow.json", p999=1.8)
+    assert bc.main([slow, base]) == 1
+    assert "doc drain p99.9" in capsys.readouterr().out
+
+    # violation FLOOR: the worst class with traffic is what gates —
+    # default violations grow 0.5% -> 10% of requests (+9.5 points
+    # AND a 20x budget blow-up) while c4096 stays perfect
+    burn = _artifact(tmp_path, "burn.json", compliance=0.90)
+    assert bc.main([burn, base]) == 1
+    assert "slo compliance floor" in capsys.readouterr().out
+    # the blow-up fails even at a loose points threshold: a 20x error
+    # budget explosion is never "within threshold"
+    assert bc.main([burn, base, "--max-slo-regress", "15"]) == 1
+    # points threshold honored when growth is proportionate (10% ->
+    # 20% of requests: +10 points, 2x — under 15 points, no blow-up)
+    loose_base = _artifact(tmp_path, "loose_base.json", compliance=0.90)
+    loose_new = _artifact(tmp_path, "loose_new.json", compliance=0.80)
+    assert bc.main([loose_new, loose_base]) == 1  # default 5 points
+    assert bc.main(
+        [loose_new, loose_base, "--max-slo-regress", "15"]
+    ) == 0
+    # the saturation case a relative-compliance gate misses: 0.1% ->
+    # 5% violations is a 50x budget blow-up but only a 4.9%/-4.9pt
+    # compliance dip — must STILL fail
+    tight_base = _artifact(tmp_path, "tight_base.json",
+                           compliance=0.999)
+    blowout = _artifact(tmp_path, "blowout.json", compliance=0.950)
+    assert bc.main([blowout, tight_base]) == 1
+    # ...but ONE dropped request in a 24-request smoke vs a clean
+    # baseline is a blip the min-violation-count floor absorbs (a
+    # fraction floor alone would fail it: 1/24 = 4.2% from zero)
+    smoke_base = _artifact(tmp_path, "smoke_base.json",
+                           compliance=1.0, requests=24)
+    smoke_blip = _artifact(tmp_path, "smoke_blip.json",
+                           compliance=23 / 24, requests=24)
+    assert bc.main([smoke_blip, smoke_base]) == 0
+
+    # improvements never fail
+    better = _artifact(tmp_path, "better.json", p999=0.4,
+                       compliance=0.999)
+    assert bc.main([better, base]) == 0
+
+
+def test_bench_compare_v3_blocks_are_one_sided(tmp_path, capsys):
+    bc = _bench_compare()
+    old = _artifact(tmp_path, "old.json", with_v3=False)
+    new = _artifact(tmp_path, "new.json")
+    # either direction: skip-with-note, never a failure or exit 2
+    assert bc.main([new, old]) == 0
+    out = capsys.readouterr().out
+    assert "SKIP" in out and "slo" in out
+    assert bc.main([old, new]) == 0

@@ -118,13 +118,87 @@ def _syncs_per_round(extra: dict) -> float | None:
 
 
 #: Artifact blocks newer runs may carry that older baselines will not
-#: (obs/ v2).  One-sided presence is a schema difference, not a
+#: (obs/ v2 + v3).  One-sided presence is a schema difference, not a
 #: regression: it becomes a "skip" line with a note, never an error.
 #: ``replication`` / ``convergence`` are the serve/replicate/ blocks —
 #: a replicated run diffed against a pre-replication baseline (or a
-#: plain run against a replicated one) must also diff cleanly.
+#: plain run against a replicated one) must also diff cleanly;
+#: ``reqtrace`` / ``slo`` / ``flight`` are the obs/ v3 request-tracing
+#: blocks.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
-                    "convergence")
+                    "convergence", "reqtrace", "slo", "flight")
+
+
+def _drain_p999(extra: dict) -> float | None:
+    """The per-doc admission-to-drain p99.9 of cleanly drained ("ok")
+    docs — the obs/ v3 tail-latency headline.  None when the artifact
+    predates the block or no ok-tagged doc drained."""
+    block = extra.get("doc_drain_latency")
+    if not isinstance(block, dict):
+        return None
+    q = (block.get("ok") or {}).get("quantiles")
+    return q.get("p99.9") if isinstance(q, dict) else None
+
+
+def _slo_worst_violation(extra: dict) -> tuple[float, int] | None:
+    """The WORST per-class SLO violation of the run as ``(fraction,
+    requests)`` — ``1 - compliance`` maxed over classes with at least
+    one request, paired with that class's request count (the blowout
+    floor needs a violation COUNT, not just a fraction).  Violations —
+    not compliance — are the gated quantity: a relative compliance
+    check saturates near 1.0, where a 0.1%% -> 5%% violation blow-up
+    (50x the error budget) reads as a 4.9%% compliance dip.  None
+    without an ``slo`` block."""
+    s = extra.get("slo")
+    if not isinstance(s, dict):
+        return None
+    viols = [
+        (1.0 - c["compliance"], c["requests"])
+        for c in (s.get("classes") or {}).values()
+        if isinstance(c, dict) and c.get("requests")
+        and c.get("compliance") is not None
+    ]
+    return max(viols) if viols else None
+
+
+#: Violation-fraction changes below this are measurement noise (half a
+#: percentage point of requests) — the budget-blowout gate never fires
+#: inside it.
+_SLO_VIOLATION_NOISE = 0.005
+
+#: ...and never on fewer than this many violating REQUESTS: a fraction
+#: floor alone lets one shed doc in a 24-request smoke (1/24 = 4.2%)
+#: blow past it against a clean baseline, the exact flake the floor
+#: exists to absorb.  3 violations is past single-blip territory at
+#: any fleet size.
+_SLO_MIN_VIOLATIONS = 3
+
+#: A new violation fraction more than this multiple of the baseline's
+#: (beyond the noise floor) fails regardless of the points threshold —
+#: the error-budget blow-up a points gate misses near tight objectives.
+_SLO_BLOWOUT_RATIO = 10.0
+
+
+def _slo_check(new: dict, base: dict, threshold_pct: float) -> Check:
+    """The SLO gate: worst-class violation growth, one-sided.  Fails
+    when violations grew by more than ``threshold_pct`` percentage
+    POINTS of requests, or blew past ``_SLO_BLOWOUT_RATIO`` x the
+    baseline's violation fraction (beyond the noise floor)."""
+    name = "slo compliance floor (violation growth, worst class)"
+    nw = _slo_worst_violation(new)
+    bw = _slo_worst_violation(base)
+    if nw is None or bw is None:
+        return Check(name, "skip",
+                     note="slo block missing in at least one artifact")
+    (nv, n_req), (bv, _) = nw, bw
+    points = (nv - bv) * 100.0
+    blowout = (
+        nv > max(bv * _SLO_BLOWOUT_RATIO, bv + _SLO_VIOLATION_NOISE)
+        and nv * n_req >= _SLO_MIN_VIOLATIONS
+    )
+    status = "fail" if points > threshold_pct or blowout else "pass"
+    return Check(name, status, new=nv, base=bv, change_pct=points,
+                 threshold_pct=threshold_pct)
 
 
 def _window_floor(extra: dict) -> float | None:
@@ -153,7 +227,7 @@ def _block_presence_checks(new: dict, base: dict) -> list[Check]:
                 f"{blk} block", "skip",
                 note=(
                     f"present only in the {where} artifact "
-                    "(obs/ v2 schema difference); not compared"
+                    "(obs/ v2+v3 schema difference); not compared"
                 ),
             ))
     return out
@@ -162,7 +236,9 @@ def _block_presence_checks(new: dict, base: dict) -> list[Check]:
 def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_p99_regress: float, max_journal_regress: float,
             max_syncs_regress: float,
-            max_window_floor_regress: float = 30.0) -> list[Check]:
+            max_window_floor_regress: float = 30.0,
+            max_drain_p999_regress: float = 75.0,
+            max_slo_regress: float = 5.0) -> list[Check]:
     checks = [
         _regress(
             "throughput (patches/s)",
@@ -197,6 +273,18 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             skip_note="timeseries block missing in at least one "
                       "artifact",
         ),
+        # obs/ v3 gates, one-sided like timeseries: the per-doc drain
+        # tail and the worst per-class SLO compliance (a looser
+        # threshold on p99.9 — a 1-in-1000 quantile is the noisiest
+        # number the artifact carries)
+        _regress(
+            "doc drain p99.9 (s, ok-tagged)",
+            _drain_p999(new), _drain_p999(base),
+            max_drain_p999_regress, higher_is_better=False,
+            skip_note="doc_drain_latency p99.9 missing in at least "
+                      "one artifact",
+        ),
+        _slo_check(new, base, max_slo_regress),
     ]
     checks.extend(_block_presence_checks(new, base))
     return checks
@@ -229,6 +317,19 @@ def main(argv: list[str] | None = None) -> int:
                          "time-series window's throughput (checked "
                          "only when both artifacts carry a "
                          "timeseries block)")
+    ap.add_argument("--max-drain-p999-regress", type=float,
+                    default=75.0, metavar="PCT",
+                    help="max tolerated increase of the per-doc "
+                         "admission-to-drain p99.9 (ok-tagged docs; "
+                         "a 1-in-1000 quantile jitters — the default "
+                         "is deliberately loose)")
+    ap.add_argument("--max-slo-regress", type=float, default=5.0,
+                    metavar="PCT",
+                    help="max tolerated growth of the worst per-class "
+                         "SLO violation fraction, in percentage points "
+                         "of requests; a >10x violation blow-up past "
+                         "the noise floor fails regardless (checked "
+                         "only when both artifacts carry an slo block)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -247,6 +348,8 @@ def main(argv: list[str] | None = None) -> int:
         max_journal_regress=args.max_journal_regress,
         max_syncs_regress=args.max_syncs_regress,
         max_window_floor_regress=args.max_window_floor_regress,
+        max_drain_p999_regress=args.max_drain_p999_regress,
+        max_slo_regress=args.max_slo_regress,
     )
     failed = [c for c in checks if c.status == "fail"]
     if args.json:

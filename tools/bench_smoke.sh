@@ -118,11 +118,13 @@ case "$family" in
       --max-throughput-regress 15
     # Telemetry leg: the same drain with the obs/ v2 continuous
     # telemetry armed — live status server (ephemeral port) + windowed
-    # time-series recorder.  Armed-telemetry throughput overhead vs the
-    # plain leg is gated at the same 15% the traced leg uses (the 2%
-    # headline claim is measured on the full serve/mixed/4096 fleet,
-    # bench_results/serve_mixed_4096_telemetry.json, where run noise is
-    # smaller).
+    # time-series recorder — PLUS the obs/ v3 request tracer and a
+    # (generous) SLO objective, so the artifact carries reqtrace + slo
+    # blocks and the burn-rate gauges render on /metrics.  Armed
+    # overhead vs the plain leg is gated at the same 15% the traced leg
+    # uses (the 2% headline claim is measured on the full
+    # serve/mixed/4096 fleet, bench_results/serve_mixed_4096_v3.json,
+    # where run noise is smaller).
     timeout -k 10 300 env JAX_PLATFORMS=cpu \
       python -m crdt_benches_tpu.bench.runner --family serve \
         --serve-docs 24 --serve-mix mixed --serve-batch 16 \
@@ -132,6 +134,7 @@ case "$family" in
         --serve-arrival-span 2 --serve-verify-sample 6 \
         --serve-status 0 \
         --serve-timeseries bench_results/serve_smoke_timeseries.jsonl \
+        --serve-reqtrace 16 --serve-slo "default=p99:60000" \
         --serve-save-name serve_smoke_telemetry
     python tools/bench_compare.py \
       bench_results/serve_smoke_telemetry.json bench_results/serve_smoke.json \
@@ -141,10 +144,14 @@ case "$family" in
     # ownership-tracking proxies and any cross-thread access outside a
     # declared `# graftlint: publish` point raises at its callsite
     # (lint/race_sanitizer.py, the dynamic proof of the static
-    # G014/G015 confinement model).  Gated at <=5% vs the telemetry leg
-    # it mirrors (identical config, env flag aside: the armed cost is
-    # one proxy hop per scrape + a counter bump per publish, so unlike
-    # the cross-kernel legs this pair is apples-to-apples).
+    # G014/G015 confinement model).  Gated at <=10% vs the telemetry
+    # leg it mirrors (identical config, env flag aside: the armed cost
+    # is one proxy hop per scrape + a counter bump per publish — but
+    # interleaved probes of this 24-doc pair measure a +-6% run-to-run
+    # spread with the armed run sometimes FASTER, so the original 5%
+    # gate flaked every other run; the real <=2% armed-overhead claim
+    # is measured on the full serve/mixed/4096 fleet via
+    # bench_compare, where run noise is small enough to resolve it).
     timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
       python -m crdt_benches_tpu.bench.runner --family serve \
         --serve-docs 24 --serve-mix mixed --serve-batch 16 \
@@ -154,11 +161,12 @@ case "$family" in
         --serve-arrival-span 2 --serve-verify-sample 6 \
         --serve-status 0 \
         --serve-timeseries bench_results/serve_smoke_races.jsonl \
+        --serve-reqtrace 16 --serve-slo "default=p99:60000" \
         --serve-save-name serve_smoke_races
     python tools/bench_compare.py \
       bench_results/serve_smoke_races.json \
       bench_results/serve_smoke_telemetry.json \
-      --max-throughput-regress 5
+      --max-throughput-regress 10
     # ...and G017 closes the loop exactly like G011 does for fences:
     # every declared publish point the armed run should have crossed
     # must appear in its thread_crossings counters (dead points fail),
@@ -189,6 +197,7 @@ case "$family" in
         --serve-soak 0 --serve-watchdog 0.25 \
         --serve-status 0 \
         --serve-timeseries bench_results/serve_smoke_races_chaos.jsonl \
+        --serve-reqtrace 16 --serve-slo "default=p99:60000" \
         --serve-save-name serve_smoke_races_chaos
     python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
       --thread-artifact bench_results/serve_smoke_races_chaos.json
@@ -203,9 +212,22 @@ assert tc["publishes"].get("StatusServer.publish_status"), tc
 assert set(tc["crossings"] or {}) <= set(tc["publishes"]), tc
 stuck = [e for e in x["anomalies"]["events"] if e["kind"] == "stuck_round"]
 assert stuck and all(e["cleared"] for e in stuck), x["anomalies"]
+# obs/ v3 acceptance cross-check: every sampled request trace's
+# publish-point hops are a SUBSET of the G017 thread_crossings
+# publishes — the request tracer and the race sanitizer observe the
+# same declared edges, so a hop with no publish counter means the two
+# causal pictures diverged
+rq = x["reqtrace"]
+assert rq and rq["requests_closed"] > 0, rq
+assert set(rq["hops"]) <= set(tc["publishes"]), (rq["hops"], tc)
+assert rq["hops"].get("OpJournal.round_record"), rq["hops"]
+for t in rq["traces"]:
+    assert set(t["hops"]) <= set(tc["publishes"]), (t, tc)
 print(f"race chaos: stall -> stuck_round -> cleared under the race "
       f"sanitizer; {sum(tc['publishes'].values())} publish entries, "
-      f"{sum((tc['crossings'] or {}).values())} attributed crossings")
+      f"{sum((tc['crossings'] or {}).values())} attributed crossings; "
+      f"{len(rq['traces'])} request traces, hops {sorted(rq['hops'])} "
+      "all subset of the declared publish points")
 PYEOF
     ;;
   serve-repl)
@@ -231,6 +253,12 @@ PYEOF
     python tools/bench_compare.py \
       bench_results/serve_repl_smoke.json \
       bench_results/serve_repl_smoke.json
+    # G017 vs the REPL artifact: the only family that arms the
+    # broadcast-bus publish surface — a dead BroadcastBus._cross_block
+    # annotation (or a rogue runtime counter) is invisible to the plain
+    # family's cross-check, where bus=False skips the dead-point check.
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_repl_smoke.json
     exec python - <<'PYEOF'
 import json
 extras = [e["extra"] for e in json.load(open("bench_results/serve_repl_smoke.json"))
@@ -265,7 +293,14 @@ PYEOF
         --serve-queue-cap 128 \
         --serve-faults "seed=5,span=5,stall_ms=800,spool_corrupt=1,device_loss=1,queue_overflow=1,dup_batch=1,stall@7=1" \
         --serve-soak 0 --serve-watchdog 0.25 \
+        --serve-reqtrace 16 \
+        --serve-flight bench_results/serve_faults_smoke_flight.json \
         --serve-save-name serve_faults_smoke
+    # The flight recorder MUST have dumped on the injected stall (the
+    # watchdog fire is an anomaly trigger even though it later clears)
+    # and the dump must be schema-valid — the validator exits nonzero
+    # otherwise.
+    python -m crdt_benches_tpu.obs.flight bench_results/serve_faults_smoke_flight.json
     python - <<'PYEOF'
 import json
 extras = [e["extra"] for e in json.load(open("bench_results/serve_faults_smoke.json"))
@@ -275,8 +310,20 @@ stuck = [e for e in an["events"] if e["kind"] == "stuck_round"]
 assert stuck, f"stall fault never tripped the watchdog: {an}"
 assert all(e["cleared"] for e in stuck), f"watchdog never cleared: {stuck}"
 assert an["uncleared"] == 0, an
+fb = extras[0]["flight"]
+assert fb and fb["dumps"] >= 1, f"flight recorder never dumped: {fb}"
+assert any(r.startswith("anomaly:stuck_round") for r in fb["reasons"]), fb
+dump = json.load(open("bench_results/serve_faults_smoke_flight.json"))
+assert dump["rounds"], dump.get("reasons")
+# the dump carries the post-mortem window: the stalled round is in the
+# ring, and the sampled/in-flight request traces rode along
+assert any(r["round"] >= stuck[0]["round"] for r in dump["rounds"]), (
+    [r["round"] for r in dump["rounds"]], stuck[0]["round"])
+assert dump["requests"], "armed reqtrace produced no traces in the dump"
 print(f"chaos smoke: stall -> stuck_round at round {stuck[0]['round']} "
-      f"-> cleared at round {stuck[0]['cleared_round']}")
+      f"-> cleared at round {stuck[0]['cleared_round']}; flight dump "
+      f"({dump['reason']!r}) holds {len(dump['rounds'])} rounds + "
+      f"{len(dump['requests'])} request traces")
 PYEOF
     # Replicated chaos leg: the two replication fault kinds against a
     # 2-writer fleet with the WAL + snapshot barriers armed.  A
@@ -329,6 +376,7 @@ PYEOF
         --serve-arrival-span 2 --serve-verify-sample 6 \
         --serve-soak 25 --serve-status 0 \
         --serve-timeseries bench_results/serve_smoke_soak.jsonl \
+        --serve-slo "default=p99:60000" --serve-reqtrace 16 \
         --serve-save-name serve_smoke_soak \
         2> >(tee bench_results/serve_smoke_soak.log >&2) &
     soak_pid=$!
@@ -362,6 +410,13 @@ for _ in range(400):
         # snapshot lands; between drains, "rounds" restarts at 0, so
         # advancement means one strictly-increasing consecutive pair
         assert "# TYPE" in text and "serve_pool_evictions_total" in text
+        # obs/ v3: the per-class SLO burn-rate gauges render on the
+        # live endpoint MID-RUN (pre-registered at scheduler bind, so
+        # they are present from the first registry snapshot on)
+        assert 'serve_slo_burn_rate{class="default",window="fast"}' in text, \
+            "burn-rate gauges missing from /metrics"
+        assert 'serve_slo_burn_rate{class="default",window="slow"}' in text
+        assert 'serve_slo_compliance{class="default"}' in text
         rounds.append(int(s.get("rounds", 0)))
         if len(rounds) >= 2 and rounds[-1] > rounds[-2]:
             break
