@@ -673,6 +673,9 @@ def run_serve(args) -> int:
         # the replicated family are future work, see ROADMAP)
         unsupported = [
             ("--serve-soak", args.serve_soak is not None),
+            ("--serve-longhaul", args.serve_longhaul > 0),
+            ("--serve-recover", args.serve_recover),
+            ("--serve-crash-round", args.serve_crash_round > 0),
             ("--serve-mesh", args.serve_mesh > 1),
             ("--serve-queue-cap", args.serve_queue_cap > 0),
             ("--serve-status", args.serve_status is not None),
@@ -740,6 +743,12 @@ def run_serve(args) -> int:
         serve_kernel=args.serve_kernel,
         journal_dir=args.serve_journal,
         snapshot_every=args.serve_snapshot_every,
+        snapshot_keep=args.serve_snapshot_keep,
+        snapshot_full_every=args.serve_full_every,
+        wal_segment_bytes=args.serve_wal_segment_bytes,
+        longhaul=args.serve_longhaul,
+        measure_recovery=args.serve_recover,
+        crash_after=args.serve_crash_round,
         faults=args.serve_faults,
         queue_cap=args.serve_queue_cap,
         overflow_policy=args.serve_overflow_policy,
@@ -793,6 +802,16 @@ def run_serve(args) -> int:
             f"shed {r.extra['shed_ops']}, "
             f"quarantines {len(r.extra['quarantines'])}, "
             f"degraded rounds {r.extra['degraded_rounds']}"
+        )
+    if r.extra.get("recovery") is not None:
+        rec = r.extra["recovery"]
+        print(
+            f"  recovery: {rec['recover_ms']:.1f}ms restore "
+            f"(snapshot round {rec['snapshot_round']}, chain depth "
+            f"{rec['chain_depth']}, {rec['chain_fallbacks']} fallbacks)"
+            f" + {rec['redo_ms']:.1f}ms redo of {rec['redo_ops']} ops, "
+            f"WAL {rec['journal_disk_bytes']} B on disk, "
+            f"verify {'ok' if rec['verify_ok'] else 'FAILED'}"
         )
     if r.extra.get("anomalies") is not None:
         a = r.extra["anomalies"]
@@ -849,6 +868,49 @@ def main(argv=None) -> int:
                     metavar="N",
                     help="fleet snapshot barrier period in macro-rounds "
                          "(journal mode only)")
+    ap.add_argument("--serve-snapshot-keep", type=int, default=2,
+                    metavar="N",
+                    help="retained snapshot CHAINS (a delta's base "
+                         "links always survive with it; <=0 = never "
+                         "prune).  Also the WAL GC floor: segments "
+                         "are kept back to the oldest retained "
+                         "barrier so chain fallback always finds its "
+                         "redo tail")
+    ap.add_argument("--serve-full-every", type=int, default=4,
+                    metavar="N",
+                    help="every Nth barrier is a chain-rooting FULL "
+                         "snapshot; the barriers between persist only "
+                         "rows dirty since the previous one as a "
+                         "CRC-chained DELTA (1 = every barrier full, "
+                         "the pre-delta behavior)")
+    ap.add_argument("--serve-wal-segment-bytes", type=int,
+                    default=1 << 20, metavar="BYTES",
+                    help="roll the active WAL file into a sealed "
+                         "numbered segment past this size; segments "
+                         "fully covered by a committed snapshot are "
+                         "garbage-collected crash-safely (0 = never "
+                         "roll, the pre-segmentation behavior)")
+    ap.add_argument("--serve-longhaul", type=int, default=0,
+                    metavar="H",
+                    help="the serve/longhaul/<mix>/<fleet> durability "
+                         "family: synthetic streams carry H-times the "
+                         "band op count (days-of-edits scale), the "
+                         "journal is required, and the run ends with a "
+                         "measured recovery leg (recover_ms + redo "
+                         "span + chain depth in the artifact, gated "
+                         "by tools/bench_compare.py)")
+    ap.add_argument("--serve-recover", action="store_true",
+                    help="measure the recovery-time objective after "
+                         "the drain: drop the live fleet, recover a "
+                         "fresh one from the journal directory, "
+                         "resume the redo tail, byte-verify vs the "
+                         "oracle (requires --serve-journal)")
+    ap.add_argument("--serve-crash-round", type=int, default=0,
+                    metavar="N",
+                    help="inject a crash: kill the drain after N "
+                         "macro-rounds and gate the run on the "
+                         "recovered fleet's oracle byte-verify "
+                         "(implies --serve-recover)")
     ap.add_argument("--serve-faults", default=None, metavar="SPEC",
                     help="seeded chaos drain: serve/faults.py spec, e.g. "
                          "'seed=7,span=8,spool_corrupt=1,device_loss=1,"

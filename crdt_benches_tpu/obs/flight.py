@@ -54,10 +54,13 @@ DEFAULT_RING = 256
 class FlightRecorder:  # graftlint: thread=hot
     """Bounded pre-anomaly window + atomic dump (module docstring)."""
 
-    def __init__(self, path: str, ring: int = DEFAULT_RING):
+    def __init__(self, path: str, ring: int = DEFAULT_RING,
+                 event_ring: int = 64):
         self.path = path
         self.rounds: deque[dict] = deque(maxlen=max(1, int(ring)))
+        self.events: deque[dict] = deque(maxlen=max(1, int(event_ring)))
         self.rounds_seen = 0
+        self.events_seen = 0
         self.dumps = 0
         self.dump_failures = 0
         self.last_error: str | None = None
@@ -68,6 +71,14 @@ class FlightRecorder:  # graftlint: thread=hot
     def note_round(self, sample: dict) -> None:
         self.rounds_seen += 1
         self.rounds.append(sample)
+
+    def note_event(self, kind: str, **fields) -> None:
+        """Record a durability/recovery lifecycle event (snapshot
+        barrier committed, WAL compaction pass, in-run recovery) into
+        its own bounded ring — the post-mortem wants 'when did the
+        subsystem last act', which round samples alone cannot answer."""
+        self.events_seen += 1
+        self.events.append({"kind": str(kind), **fields})
 
     # ---- triggers (anomaly fire / unrecovered fault / crash) ----
 
@@ -105,6 +116,8 @@ class FlightRecorder:  # graftlint: thread=hot
             "time_unix": time.time(),
             "rounds_seen": self.rounds_seen,
             "rounds": list(self.rounds),
+            "events_seen": self.events_seen,
+            "events": list(self.events),
             "requests": list(requests) if requests else [],
             "metrics": registry.to_dict() if registry is not None
             else None,
@@ -137,6 +150,7 @@ class FlightRecorder:  # graftlint: thread=hot
             "path": self.path,
             "ring": self.rounds.maxlen,
             "rounds_seen": self.rounds_seen,
+            "events_seen": self.events_seen,
             "dumps": self.dumps,
             "dump_failures": self.dump_failures,
             "last_error": self.last_error,
@@ -187,6 +201,13 @@ def validate_flight(data) -> list[str]:
         if not isinstance(r, dict) or "doc" not in r:
             errors.append(f"requests[{i}]: not a request trace (no "
                           "'doc')")
+    events = data.get("events", [])
+    if not isinstance(events, list):
+        errors.append("events must be a list")
+        events = []
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or not isinstance(e.get("kind"), str):
+            errors.append(f"events[{i}]: not an event (no 'kind')")
     m = data.get("metrics")
     if m is not None and not (
         isinstance(m, dict) and isinstance(m.get("version"), int)

@@ -340,6 +340,14 @@ class ServeTelemetry:  # graftlint: thread=hot
                 status=status,
             )
 
+    def note_event(self, kind: str, **fields) -> None:
+        """Durability/recovery lifecycle marker (snapshot barrier,
+        compaction pass, in-run recovery): lands in the flight
+        recorder's event ring so a post-mortem dump says when the
+        subsystem last acted.  Hot-thread only; pure host append."""
+        if self.flight is not None:
+            self.flight.note_event(kind, **fields)
+
     def note_phase(self, phase: str) -> None:
         """Driver-side heartbeat between drains (fleet build, verify):
         no round is running, but the publisher is alive — resets the

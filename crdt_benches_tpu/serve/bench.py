@@ -39,6 +39,7 @@ import os
 import shutil
 import sys
 import tempfile
+import time
 
 import numpy as np
 
@@ -57,8 +58,13 @@ from ..obs.slo import SloTracker
 from ..obs.status import StatusServer
 from ..obs.timeseries import ServeTelemetry, TimeseriesRecorder
 from ..oracle.text_oracle import replay_trace
-from .faults import REPLICATION_KINDS, FaultInjector, FaultPlan
-from .journal import OpJournal
+from .faults import (
+    JOURNAL_KINDS,
+    REPLICATION_KINDS,
+    FaultInjector,
+    FaultPlan,
+)
+from .journal import DEFAULT_SEGMENT_BYTES, OpJournal, recover_fleet
 from .pool import DocPool
 from .scheduler import FleetScheduler, prepare_streams
 from .workload import build_fleet
@@ -198,7 +204,13 @@ def run_serve_bench(
     spool_dir: str | None = None,
     journal_dir: str | None = None,
     snapshot_every: int = 32,
+    snapshot_keep: int = 2,
+    snapshot_full_every: int = 4,
+    wal_segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     journal_fsync: bool = False,
+    longhaul: int = 0,
+    measure_recovery: bool = False,
+    crash_after: int = 0,
     faults=None,
     queue_cap: int = 0,
     overflow_policy: str = "defer",
@@ -251,6 +263,26 @@ def run_serve_bench(
     classes = _parse_int_tuple(classes)
     slots = _parse_int_tuple(slots)
     mix_name = mix if isinstance(mix, str) else "custom"
+    # longhaul (serve/longhaul/<mix>/<fleet>): days-of-edits-scale
+    # streams + a measured recovery-time objective — the durability
+    # family, so the journal is mandatory and the recovery leg implied
+    longhaul = max(0, int(longhaul))
+    if longhaul:
+        measure_recovery = True
+    if crash_after:
+        measure_recovery = True
+    if measure_recovery and not journal_dir:
+        raise ValueError(
+            "the recovery leg (--serve-recover / --serve-longhaul / "
+            "--serve-crash-round) measures journal recovery: "
+            "--serve-journal is required"
+        )
+    if measure_recovery and mesh_devices > 1:
+        raise ValueError(
+            "--serve-mesh is not supported with the measured recovery "
+            "leg (the recovered fleet is rebuilt single-host)"
+        )
+    mix_label = f"longhaul/{mix_name}" if longhaul else mix_name
 
     plan = None
     if faults is not None:
@@ -272,14 +304,52 @@ def run_serve_bench(
             queue_cap = 8 * batch
             log(f"serve: queue_overflow faults need a bounded queue; "
                 f"defaulting queue_cap={queue_cap}")
+        journal_kinds = sorted({
+            e.kind for e in plan.events if e.kind in JOURNAL_KINDS
+        })
+        if journal_kinds:
+            # the injection points live inside the snapshot barrier:
+            # every precondition that would leave them unreachable is
+            # a loud configuration error, not a drain-end not_fired
+            if not journal_dir:
+                raise ValueError(
+                    f"fault kinds {journal_kinds} target the "
+                    "durability subsystem (WAL GC / delta chains): "
+                    "--serve-journal is required — a journal-less "
+                    "drain never reaches their injection points"
+                )
+            if snapshot_every <= 0:
+                raise ValueError(
+                    f"fault kinds {journal_kinds} fire at snapshot "
+                    "barriers: --serve-snapshot-every must be > 0"
+                )
+            if "delta_corrupt" in journal_kinds \
+                    and snapshot_full_every <= 1:
+                raise ValueError(
+                    "delta_corrupt needs delta barriers: "
+                    "--serve-full-every must be > 1 (1 = every "
+                    "barrier full, so no delta ever exists)"
+                )
+            if "crash_compact" in journal_kinds \
+                    and wal_segment_bytes <= 0:
+                raise ValueError(
+                    "crash_compact needs sealed WAL segments to "
+                    "collect: --serve-wal-segment-bytes must be > 0"
+                )
     # a malformed --serve-slo spec fails HERE, before the journal
     # tempdir / telemetry threads exist — nothing yet to release
     slo = parse_slo(slo_spec)
 
+    default_name = (
+        f"serve_longhaul_{mix_name}_{n_docs}" if longhaul
+        else f"serve_{mix_name}_{n_docs}"
+    )
+
     owns_journal = journal_dir == "auto"
     if owns_journal:
         journal_dir = tempfile.mkdtemp(prefix="crdt_journal_")
-    journal = OpJournal(journal_dir, fsync=journal_fsync) \
+    journal = OpJournal(journal_dir, fsync=journal_fsync,
+                        segment_bytes=wal_segment_bytes) \
         if journal_dir else None
 
     owns_telemetry = telemetry is None
@@ -319,10 +389,12 @@ def run_serve_bench(
             log("serve: race sanitizer ARMED (CRDT_BENCH_SANITIZE_RACES)")
         if telemetry is not None:
             telemetry.note_phase("building")  # staleness-clock heartbeat
-        log(f"serve: building fleet n_docs={n_docs} mix={mix_name} seed={seed}")
+        log(f"serve: building fleet n_docs={n_docs} mix={mix_label} "
+            f"seed={seed}"
+            + (f" horizon=x{longhaul}" if longhaul else ""))
         sessions = build_fleet(
             n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands,
-            delivery=delivery,
+            delivery=delivery, horizon=max(1, longhaul),
         )
         pool = DocPool(classes=classes, slots=slots, mesh=mesh,
                        spool_dir=spool_dir, serve_kernel=serve_kernel)
@@ -350,6 +422,8 @@ def run_serve_bench(
             queue_cap=queue_cap, overflow_policy=overflow_policy,
             faults=FaultInjector(plan) if plan else None,
             journal=journal, snapshot_every=snapshot_every,
+            snapshot_keep=snapshot_keep,
+            snapshot_full_every=snapshot_full_every,
             profiler=profiler, telemetry=telemetry,
             reqtrace=reqtrace, slo=slo,
             warm_start=True,
@@ -374,7 +448,7 @@ def run_serve_bench(
         if trace_path is None and obs_trace.env_armed():
             trace_path = os.path.join(
                 results_dir or "bench_results",
-                f"{save_name or f'serve_{mix_name}_{n_docs}'}_trace.json",
+                f"{save_name or default_name}_trace.json",
             )
         tracer = None
         armed_here = False
@@ -385,7 +459,12 @@ def run_serve_bench(
         profile_block = None
         try:
             try:
-                stats = sched.run()
+                # crash_after > 0 = the injected crash: kill the drain
+                # after N macro-rounds and let the recovery leg resume
+                # from nothing but the journal directory
+                stats = sched.run(
+                    max_rounds=crash_after if crash_after else None
+                )
             except BaseException as e:
                 # crash post-mortem: dump the flight window before the
                 # exception leaves the drain (the exit code alone is
@@ -425,7 +504,13 @@ def run_serve_bench(
                     + ", ".join(
                         f"{o['name']} {o['total_ms']:.1f}ms" for o in top
                     ))
-        assert sched.done, "scheduler stopped with pending work"
+        crashed = crash_after > 0 and not sched.done
+        if crash_after:
+            log(f"serve: CRASH injected after {stats.rounds} macro-"
+                f"rounds ({'work pending' if crashed else 'drained'}); "
+                "recovery leg resumes from the journal")
+        else:
+            assert sched.done, "scheduler stopped with pending work"
         if telemetry is not None:
             telemetry.drain_end(status={
                 **sched.status_fields(), "phase": "done", "done": True,
@@ -491,25 +576,126 @@ def run_serve_bench(
             sample.extend(int(x) for x in pick)
         failures = []
         session_of = {s.doc_id: s for s in sessions}
-        for doc_id in sample:
-            want = replay_trace(session_of[doc_id].trace)
-            got = pool.decode(doc_id)
-            if got != want:
-                failures.append(doc_id)
-        # an EMPTY sample must not pass the gate: with every doc lossy
-        # (mass shed/quarantine) there is nothing left to verify, and a
-        # vacuous green would let the chaos smoke pass while checking
-        # nothing
-        verify_ok = not failures and bool(sample)
-        log(
-            f"serve: verified {len(sample)} docs across classes "
-            f"{used_classes}: "
-            + ("all byte-identical to oracle" if verify_ok
-               else "EMPTY SAMPLE (all docs lossy?)" if not sample
-               else f"MISMATCH on docs {failures}")
-            + (f" ({len(lossy)} lossy docs excluded: {lossy[:16]})"
-               if lossy else "")
-        )
+        if crashed:
+            # an interrupted drain's pool is mid-stream by design; the
+            # byte-verify happens on the RECOVERED fleet below
+            sample = []
+            verify_ok = False
+            log("serve: in-run verify skipped (injected crash); the "
+                "recovered fleet carries the oracle gate")
+        else:
+            for doc_id in sample:
+                want = replay_trace(session_of[doc_id].trace)
+                got = pool.decode(doc_id)
+                if got != want:
+                    failures.append(doc_id)
+            # an EMPTY sample must not pass the gate: with every doc
+            # lossy (mass shed/quarantine) there is nothing left to
+            # verify, and a vacuous green would let the chaos smoke
+            # pass while checking nothing
+            verify_ok = not failures and bool(sample)
+            log(
+                f"serve: verified {len(sample)} docs across classes "
+                f"{used_classes}: "
+                + ("all byte-identical to oracle" if verify_ok
+                   else "EMPTY SAMPLE (all docs lossy?)" if not sample
+                   else f"MISMATCH on docs {failures}")
+                + (f" ({len(lossy)} lossy docs excluded: {lossy[:16]})"
+                   if lossy else "")
+            )
+
+        # ---- measured recovery-time objective (durability v2) ----
+        # The "crash": the live pool/scheduler/journal handle are
+        # dropped; a FRESH fleet recovers from nothing but the journal
+        # directory, resumes the redo tail through the normal macro
+        # path, and byte-verifies against the oracle.  recover_ms is
+        # the first-class RTO metric bench_compare gates.
+        recovery_block = None
+        if measure_recovery and journal is not None:
+            journal.close()  # flush; host state is now disk-only
+            if telemetry is not None:
+                telemetry.note_phase("recovering")
+            rpool = DocPool(classes=classes, slots=slots,
+                            serve_kernel=serve_kernel)
+            rstreams = prepare_streams(
+                sessions, rpool, batch=batch, batch_chars=batch_chars
+            )
+            t_rec = time.perf_counter()
+            rep = recover_fleet(rpool, rstreams, journal_dir)
+            recover_ms = (time.perf_counter() - t_rec) * 1e3
+            rsched = FleetScheduler(
+                rpool, rstreams, batch=batch, macro_k=macro_k,
+                batch_chars=batch_chars, start_round=rep.resume_round,
+            )
+            t_redo = time.perf_counter()
+            rsched.run()
+            redo_ms = (time.perf_counter() - t_redo) * 1e3
+            assert rsched.done, "recovered scheduler left pending work"
+            rlossy = {d for d, st in rstreams.items() if st.lossy}
+            rsample = [d for d in (sample or (
+                s.doc_id for s in sessions)) if d not in rlossy]
+            if not sample:  # crashed run: sample spread over classes
+                rng_r = np.random.default_rng(seed + 2)
+                cand = sorted(rsample)
+                rsample = [int(x) for x in rng_r.choice(
+                    cand, size=min(verify_sample, len(cand)),
+                    replace=False,
+                )] if cand else []
+            rfail = [
+                d for d in rsample
+                if rpool.decode(d) != replay_trace(session_of[d].trace)
+            ]
+            recovered_ok = not rfail and bool(rsample)
+            wal_disk = journal.on_disk_bytes()
+            recovery_block = {
+                "version": 1,
+                "recover_ms": recover_ms,
+                "redo_ms": redo_ms,
+                "redo_ops": rep.ops_replayed,
+                "chain_depth": rep.chain_depth,
+                "chain_fallbacks": rep.chain_fallbacks,
+                "snapshot_round": rep.snapshot_round,
+                "resume_round": rep.resume_round,
+                "torn_records": rep.torn_records,
+                "gc_segments_completed": rep.gc_segments_completed,
+                "staging_removed": rep.staging_removed,
+                "cold_start": rep.snapshot_round < 0,
+                "docs_restored": rep.docs_restored,
+                "spools_restored": rep.spools_restored,
+                "journal_disk_bytes": wal_disk,
+                "verified_docs": len(rsample),
+                "verify_ok": recovered_ok,
+            }
+            log(
+                f"serve: recovery — {recover_ms:.1f}ms to restore "
+                f"(snapshot round {rep.snapshot_round}, chain depth "
+                f"{rep.chain_depth}, {rep.chain_fallbacks} fallbacks), "
+                f"{rep.ops_replayed} redo ops in {redo_ms:.1f}ms, "
+                f"WAL on disk {wal_disk} B; "
+                f"{len(rsample)} recovered docs "
+                + ("byte-identical to oracle" if recovered_ok
+                   else f"MISMATCH on {rfail or 'EMPTY SAMPLE'}")
+            )
+            # the durability chaos kinds close on a PROVEN recovery:
+            # chain fallback exercised / torn GC completed, and the
+            # recovered fleet byte-verified.  On a CRASH run the
+            # in-process finalizer never ran (the crash is the point),
+            # so a full journal recovery is the universal repair for
+            # EVERY fired fault — the dead pool's damaged spools and
+            # lost device state are irrelevant to the fresh fleet
+            # rebuilt from snapshots + deterministic streams.
+            if plan is not None and recovered_ok:
+                for e in plan.events:
+                    if e.fired and not e.recovered and (
+                            crashed or e.kind in JOURNAL_KINDS):
+                        e.recover(
+                            via="recovery_leg",
+                            fallbacks=rep.chain_fallbacks,
+                            gc_completed=rep.gc_segments_completed,
+                        )
+            rpool.close()
+            verify_ok = recovered_ok if crashed \
+                else (verify_ok and recovered_ok)
 
         fault_summary = plan.summary() if plan is not None else None
         faults_ok = fault_summary is None or (
@@ -618,7 +804,7 @@ def run_serve_bench(
         occ = stats.occupancy.mean
         r = BenchResult(
             group="serve",
-            trace=mix_name,
+            trace=mix_label,
             backend=str(n_docs),
             elements=stats.patches,
             samples=[stats.wall_time],
@@ -676,9 +862,24 @@ def run_serve_bench(
                     "bytes": journal.bytes_written,
                     "fsync": journal_fsync,
                     "snapshots": stats.snapshots,
+                    "snapshots_full": stats.snapshots_full,
+                    "snapshots_delta": stats.snapshots_delta,
                     "snapshot_every": snapshot_every,
+                    "snapshot_full_every": snapshot_full_every,
                     "snapshot_time": stats.snapshot_time,
+                    # durability v2: segmented-WAL footprint (disk
+                    # bytes are the bounded-footprint acceptance
+                    # surface — O(ops since last snapshot) under GC)
+                    "segment_bytes": wal_segment_bytes,
+                    "segments_sealed": journal.segments_sealed,
+                    "gc_segments": journal.gc_segments,
+                    "disk_bytes": journal.on_disk_bytes(),
                 },
+                "longhaul": longhaul,
+                # measured recovery-time objective (None unless the
+                # recovery leg ran): recover_ms + redo-span +
+                # chain-depth breakdown, gated by bench_compare
+                "recovery": recovery_block,
                 "faults": fault_summary,
                 "boundary_syncs": boundary_syncs,
                 "thread_crossings": thread_crossings,
@@ -734,7 +935,7 @@ def run_serve_bench(
             },
         )
         kw = {"results_dir": results_dir} if results_dir else {}
-        path = save_results([r], save_name or f"serve_{mix_name}_{n_docs}", **kw)
+        path = save_results([r], save_name or default_name, **kw)
         log(f"serve: wrote {path}")
         return r, {
             "verify_ok": verify_ok,

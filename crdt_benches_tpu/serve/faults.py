@@ -34,6 +34,14 @@ Spec grammar (comma-separated ``key=value`` tokens)::
                      forcing an explicit shed/defer decision
   ``poison_rebuild`` make the targeted doc's rebuild fail (tests the
                      quarantine path; normally test-constructed)
+  ``crash_compact``  kill the WAL segment GC pass mid-flight — between
+                     its crash-safe manifest write and the unlinks
+                     (journal mode only); the torn pass must be
+                     completed by the next barrier, open, or recovery
+  ``delta_corrupt``  flip bytes inside the newest delta snapshot's
+                     member (journal mode with delta barriers only);
+                     recovery must fall back down the CRC chain and
+                     still byte-verify against the oracle
   ``replica_partition`` drop one replica's broadcast deliveries for a
                      span of rounds (serve/replicate/ only): the
                      replica's divergence window grows while its
@@ -68,9 +76,18 @@ KINDS = (
     "stall",
     "queue_overflow",
     "poison_rebuild",
+    "crash_compact",
+    "delta_corrupt",
     "replica_partition",
     "merge_reorder",
 )
+
+#: Kinds that need the write-ahead journal armed (``--serve-journal``):
+#: they target the durability subsystem itself — a journal-less drain
+#: never reaches their injection points, so ``run_serve_bench`` rejects
+#: the combination up front instead of failing the chaos gate with a
+#: confusing not_fired at drain end.
+JOURNAL_KINDS = ("crash_compact", "delta_corrupt")
 
 #: Kinds only the replicated scheduler (serve/replicate/) polls.  A
 #: plain serve drain never fires them, so ``run_serve_bench`` rejects a
@@ -259,6 +276,17 @@ class FaultInjector:
 
     def spool_event(self, rnd: int) -> FaultEvent | None:
         return self._pending(rnd, "spool_corrupt", "spool_truncate")
+
+    def compact_crash_event(self, rnd: int) -> FaultEvent | None:
+        """Kill the WAL GC pass between its manifest write and the
+        unlinks (polled by the journal's crash hook at each barrier;
+        pending until a pass actually has victims to delete)."""
+        return self._pending(rnd, "crash_compact")
+
+    def delta_corrupt_event(self, rnd: int) -> FaultEvent | None:
+        """Flip bytes in the newest delta snapshot member (polled after
+        each barrier; pending until a delta link exists)."""
+        return self._pending(rnd, "delta_corrupt")
 
     def partition_event(self, rnd: int) -> FaultEvent | None:
         """A replica's broadcast link drops for a span (polled by the

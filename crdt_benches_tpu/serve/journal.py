@@ -6,32 +6,46 @@ last consistent snapshot set and replays the journal tail through the
 existing macro-round path, and the oracle byte-verify confirms the
 result is exactly the converged state an uninterrupted run produces.
 
-Three persistent artifacts live under one journal directory:
+Durability v2 turns the machinery from a correctness device into a
+bounded-footprint subsystem.  Three persistent artifacts live under one
+journal directory:
 
-- **op journal** (``journal.log``): an append-only record stream.  Every
-  macro-round, the scheduler journals the per-class lane set — one
-  ``(doc, start_cursor, end_cursor)`` triple per scheduled document —
-  BEFORE dispatching the staged tensors (write-ahead).  Because every
-  doc's op stream is deterministic host data, a cursor interval IS the
-  op batch: replaying ``[start, end)`` of the stream reproduces the
-  exact device work.  Records are one line each, ``<crc32hex> <json>``;
-  a torn tail (crash mid-write) fails CRC/JSON and is dropped at read
-  time, never propagated.  Quarantine / load-shed decisions are also
-  journaled — they change what the converged state *is*, so recovery
-  must re-apply them.
+- **op journal** (``journal.log`` + sealed ``wal_<seq>.log`` segments):
+  an append-only record stream.  Every macro-round, the scheduler
+  journals the per-class lane set — one ``(doc, start_cursor,
+  end_cursor)`` triple per scheduled document — BEFORE dispatching the
+  staged tensors (write-ahead).  Because every doc's op stream is
+  deterministic host data, a cursor interval IS the op batch: replaying
+  ``[start, end)`` of the stream reproduces the exact device work.
+  Records are one line each, ``<crc32hex> <json>``; a torn tail (crash
+  mid-write) fails CRC/JSON and is dropped at read time, never
+  propagated.  The active file rolls into a numbered **segment** once it
+  passes ``segment_bytes``, and a **GC pass** after each committed
+  snapshot deletes segments whose every record is older than the
+  barrier — the WAL footprint is O(ops since the last committed
+  snapshot), not O(history).  GC is crash-safe: the victim list is
+  committed to ``GC_MANIFEST.json`` before any unlink, and a torn pass
+  (crash between manifest and unlink) is completed on the next open,
+  compaction, or recovery.
 - **snapshot barriers** (``snap_<round>/``): every ``snapshot_every``
-  macro-rounds the scheduler pulls each bucket once (a sync barrier —
-  the same boundary discipline as row moves), writes one CRC-verified
-  ``.npz`` per capacity class plus copies of every live eviction spool,
-  and commits the set atomically by renaming the staging directory.
-  A snapshot bounds the journal tail a recovery must replay.
-- **recovery** (:func:`recover_fleet`): pick the newest loadable
-  snapshot (older ones are fallbacks; cold start from round 0 is the
-  last resort — streams are deterministic, so a fleet is recoverable
-  from nothing), restore residency/cursors/spools into a fresh pool,
-  re-apply journaled quarantine/shed decisions from the tail, and
-  report the redo span (``ops_replayed``).  Resumed serving then drives
-  the tail through the normal macro-round path.
+  macro-rounds the scheduler persists a consistent fleet state, staged
+  in ``<dir>.tmp`` with the manifest written LAST and committed by a
+  single directory rename.  A barrier is either **full** (one CRC'd
+  .npz per capacity class — the whole bucket) or a **delta** (only the
+  rows the pool marked dirty since the previous barrier), CRC-chained
+  to its base: the delta's manifest records its base snapshot's name
+  plus the CRC of the base's manifest bytes, down to the full snapshot
+  that roots the chain.  A periodic full barrier re-roots the chain so
+  depth stays bounded.  Snapshots are pruned by CHAIN — a delta's base
+  is never deleted out from under it.
+- **recovery** (:func:`recover_fleet`): pick the newest snapshot whose
+  whole chain verifies (base links CRC-checked, every member's arrays
+  CRC-checked), composing root → deltas newest-last so the latest write
+  to each row wins; any broken link falls back DOWN the chain — older
+  delta, then the full root, then an older chain, then a cold start
+  (streams are deterministic, so a fleet is recoverable from nothing).
+  Restored cursors sit at the chosen barrier; resumed serving drives
+  the journal tail through the normal macro-round path.
 
 :func:`rebuild_doc` is the in-run repair primitive shared by the
 scheduler's fault handling (corrupt spool, device-state loss): rebuild
@@ -51,7 +65,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..lint.race_sanitizer import published
-from ..obs.metrics import Counter
+from ..obs.metrics import Counter, Gauge
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
     CorruptCheckpointError,
@@ -60,10 +74,39 @@ from ..utils.checkpoint import (
 )
 
 SNAP_PREFIX = "snap_"
+WAL_PREFIX = "wal_"
+WAL_ACTIVE = "journal.log"
+GC_MANIFEST = "GC_MANIFEST.json"
+
+#: Roll the active WAL file into a sealed segment past this many bytes.
+DEFAULT_SEGMENT_BYTES = 1 << 20
+
+#: Safety valve: a delta chain deeper than this is re-rooted with a full
+#: snapshot regardless of the caller's cadence (recovery walks the whole
+#: chain, so unbounded depth would unbound the RTO).
+MAX_CHAIN_DEPTH = 64
+
+
+class ChainError(CorruptCheckpointError):
+    """A snapshot chain failed verification: missing base directory,
+    base-manifest CRC mismatch, depth overflow, or an unreadable link
+    manifest.  Subclasses :class:`CorruptCheckpointError` so every
+    fallback path that already degrades on member damage degrades the
+    same way on link damage."""
+
+
+#: What a recovery candidate may raise before the walk falls back to an
+#: older snapshot.  Wider than CorruptCheckpointError on purpose: a
+#: bit-flipped manifest can stay PARSEABLE json with garbled values
+#: (a resident row index past the bucket, a non-int round), which
+#: surfaces as IndexError/KeyError/TypeError deep in the restore — a
+#: designed-recoverable corruption must degrade to the next candidate,
+#: never crash the recovery itself.
+_RECOVER_ERRORS = (ValueError, KeyError, IndexError, TypeError, OSError)
 
 
 # ---------------------------------------------------------------------------
-# the op journal (append-only, CRC-framed JSON lines)
+# the op journal (append-only, CRC-framed JSON lines, rolled segments)
 # ---------------------------------------------------------------------------
 
 
@@ -84,30 +127,80 @@ class OpJournal:  # graftlint: thread=hot
     (the strict WAL discipline); the default leaves flushing to the OS —
     a lost *suffix* is exactly what recovery tolerates, torn or not.
 
-    Reopening an existing log first truncates any torn tail: appending
-    new records BEHIND a damaged line would hide them from the next
-    recovery (readers stop at the first bad line)."""
+    ``segment_bytes`` bounds the active file: once it has passed the
+    threshold, the next roll point (:meth:`maybe_roll` — invoked by
+    every :meth:`compact`, i.e. at each snapshot barrier) seals it as
+    ``wal_<seq>.log`` and opens a fresh active file.  Sealed segments
+    are immutable, which is what makes the GC pass safe; rolling lives
+    OFF the append hot path because a segment can only ever be
+    collected at a barrier anyway.
 
-    def __init__(self, journal_dir: str, fsync: bool = False):
+    Reopening an existing log first completes any torn GC pass, sweeps
+    abandoned snapshot staging directories, and truncates a torn tail
+    of the ACTIVE file: appending new records BEHIND a damaged line
+    would hide them from the next recovery (readers stop at the first
+    bad line).  Sealed segments are only ever complete records — a
+    crash can only tear the file that was being appended."""
+
+    def __init__(self, journal_dir: str, fsync: bool = False,
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES):
         os.makedirs(journal_dir, exist_ok=True)
         self.dir = journal_dir
-        self.path = os.path.join(journal_dir, "journal.log")
+        self.path = os.path.join(journal_dir, WAL_ACTIVE)
         self.fsync = fsync
+        self.segment_bytes = max(0, int(segment_bytes))
+        self.torn_gc_completed = finish_torn_gc(journal_dir)
+        self.staging_swept = len(sweep_staging(journal_dir))
         if os.path.exists(self.path):
             good = _valid_prefix_bytes(self.path)
             if good < os.path.getsize(self.path):
                 with open(self.path, "r+b") as f:
                     f.truncate(good)
+        self._seq = 1 + max(
+            (_segment_seq(s) for s in wal_segments(journal_dir)),
+            default=0,
+        )
         self._f = open(self.path, "a", encoding="utf-8")
+        self._active_bytes = os.path.getsize(self.path)
+        self._since_snapshot = 0
+        # per-segment GC-eligibility cache: max round of a SEALED
+        # segment (None = has a round-less/unparseable record, never
+        # eligible).  Sealed segments are immutable, so the value is
+        # computed once — tracked live for segments this process seals
+        # (append -> roll), lazily parsed for ones found on open.
+        self._seg_max: dict[str, int | None] = {}
+        self._active_max_r = -1
+        self._active_roundless = False
+        self._active_records = 0
+        if self._active_bytes:
+            # surviving pre-crash records: parse once to seed the
+            # tracker (the file was just truncated to its valid prefix)
+            recs, _n, _clean = _file_records(self.path)
+            self._active_records = len(recs)
+            for rec in recs:
+                r = rec.get("r")
+                if isinstance(r, int):
+                    self._active_max_r = max(self._active_max_r, r)
+                else:
+                    self._active_roundless = True
         self._m_records = Counter("serve.journal.records")
         self._m_bytes = Counter("serve.journal.bytes")
         self._m_snap_bytes = Counter("serve.journal.snapshot_bytes")
+        self._m_sealed = Counter("serve.journal.segments_sealed")
+        self._m_gc_passes = Counter("serve.journal.gc_passes")
+        self._m_gc_segments = Counter("serve.journal.gc_segments")
+        self._g_segments = Gauge("serve.journal.wal_segments")
+        self._g_since = Gauge("serve.journal.bytes_since_snapshot")
+        self._g_segments.set(1 + len(wal_segments(journal_dir)))
 
     def bind_metrics(self, registry) -> None:
-        """Attach the journal's counters to a drain's MetricsRegistry."""
-        registry.attach(self._m_records)
-        registry.attach(self._m_bytes)
-        registry.attach(self._m_snap_bytes)
+        """Attach the journal's counters + durability gauges to a
+        drain's MetricsRegistry (pre-registered here, off the hot path —
+        G013; they render on /metrics as ``serve_journal_*``)."""
+        for m in (self._m_records, self._m_bytes, self._m_snap_bytes,
+                  self._m_sealed, self._m_gc_passes, self._m_gc_segments,
+                  self._g_segments, self._g_since):
+            registry.attach(m)
 
     @property
     def records(self) -> int:
@@ -119,16 +212,39 @@ class OpJournal:  # graftlint: thread=hot
 
     @property
     def bytes_total(self) -> int:
-        """WAL bytes plus committed snapshot bytes — the journal's full
-        on-disk footprint rate, which is what the soak leak detector
-        watches (WAL bytes alone would hide snapshot bloat)."""
+        """Cumulative WAL bytes appended plus committed snapshot bytes —
+        the journal's write-rate surface, which is what the soak leak
+        detector watches (monotonic by construction; GC shrinks the
+        on-disk footprint, never this)."""
         return self._m_bytes.value + self._m_snap_bytes.value
+
+    @property
+    def segments_sealed(self) -> int:
+        return self._m_sealed.value
+
+    @property
+    def gc_segments(self) -> int:
+        return self._m_gc_segments.value
+
+    def on_disk_bytes(self) -> int:
+        """Live WAL footprint: sealed segments + the active file (cold
+        path — walks the directory).  This is the number the bounded-
+        footprint acceptance gates on: with GC it tracks ops since the
+        last committed snapshot, not history."""
+        total = 0
+        for name in wal_segments(self.dir) + [WAL_ACTIVE]:
+            try:
+                total += os.path.getsize(os.path.join(self.dir, name))
+            except OSError:
+                pass
+        return total
 
     def note_snapshot(self, snap_dir: str) -> int:
         """Account a committed snapshot barrier's on-disk bytes (walked
         once per barrier — cold path).  Hard-linked spool members count
         at full size: the number tracks what a recovery would read, not
-        unique blocks."""
+        unique blocks.  Also resets the bytes-since-snapshot gauge —
+        the WAL tail a recovery would replay restarts here."""
         total = 0
         for root, _dirs, files in os.walk(snap_dir):
             for f in files:
@@ -137,6 +253,8 @@ class OpJournal:  # graftlint: thread=hot
                 except OSError:
                     pass  # pruned concurrently by keep= rotation
         self._m_snap_bytes.inc(total)
+        self._since_snapshot = 0
+        self._g_since.set(0)
         return total
 
     def append(self, obj: dict) -> None:
@@ -148,6 +266,45 @@ class OpJournal:  # graftlint: thread=hot
             os.fsync(self._f.fileno())
         self._m_records.inc()
         self._m_bytes.inc(len(line))
+        self._active_bytes += len(line)
+        self._since_snapshot += len(line)
+        self._g_since.set(self._since_snapshot)
+        self._active_records += 1
+        r = obj.get("r")
+        if isinstance(r, int):
+            if r > self._active_max_r:
+                self._active_max_r = r
+        else:
+            self._active_roundless = True
+
+    def maybe_roll(self) -> bool:
+        """Seal the active file as the next numbered segment (once it
+        has passed ``segment_bytes``) and open a fresh one.  NOT called
+        from the append hot path: a segment can only be GC'd at a
+        snapshot barrier, so sealing between barriers buys nothing —
+        :meth:`compact` rolls first, inside the barrier fence.  Crash
+        windows are benign: after the rename but before the new open
+        there is simply no active file, and the next append (or
+        reopen) creates one."""
+        if not self.segment_bytes \
+                or self._active_bytes < self.segment_bytes:
+            return False
+        self._f.close()
+        name = _segment_name(self._seq)
+        os.replace(self.path, os.path.join(self.dir, name))
+        self._seg_max[name] = (
+            None if self._active_roundless or not self._active_records
+            else self._active_max_r
+        )
+        self._seq += 1
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._active_bytes = 0
+        self._active_max_r = -1
+        self._active_roundless = False
+        self._active_records = 0
+        self._m_sealed.inc()
+        self._g_segments.set(1 + len(wal_segments(self.dir)))
+        return True
 
     @published
     def round_record(  # graftlint: publish=journal
@@ -174,9 +331,184 @@ class OpJournal:  # graftlint: thread=hot
     def event(self, kind: str, **fields) -> None:
         self.append({"t": kind, **fields})
 
+    # ---- segment GC (cold path: runs inside the barrier fence) ----
+
+    def compact(self, covered_round: int, crash_hook=None) -> dict:
+        """Delete sealed segments fully covered at ``covered_round``: a
+        segment whose every record carries ``r < covered_round`` is
+        durable below that barrier (decisions live in the manifest,
+        cursors at the barrier) and a recovery landing at or above it
+        would ignore the records anyway.  Segments with any record at
+        or above the round — or any record without a round — survive.
+        Callers must pass the :func:`retained_floor` (the OLDEST
+        retained snapshot's round), not the newest barrier's: chain
+        fallback may land recovery on any retained snapshot, and its
+        redo tail starts there.
+
+        Crash-safe two-phase delete: the victim list is committed to
+        ``GC_MANIFEST.json`` (tmp + ``os.replace``) BEFORE the first
+        unlink; a crash mid-pass leaves the manifest, and the next
+        open / compaction / recovery completes the pass
+        (:func:`finish_torn_gc`).  ``crash_hook`` sits exactly in that
+        window — the chaos injector's ``crash_compact`` kill point.
+
+        Rolls the active file first (:meth:`maybe_roll`): the records
+        below the barrier it seals become this pass's own victims, so
+        the WAL footprint after a barrier is exactly the uncovered
+        tail."""
+        self.maybe_roll()
+        torn = self.finish_torn_gc()
+        victims: list[str] = []
+        freed = 0
+        for name in wal_segments(self.dir):
+            path = os.path.join(self.dir, name)
+            if name not in self._seg_max:  # sealed before this open
+                self._seg_max[name] = _segment_max_round(path)
+            max_r = self._seg_max[name]
+            if max_r is not None and max_r < covered_round:
+                victims.append(name)
+                try:
+                    freed += os.path.getsize(path)
+                except OSError:
+                    pass
+        info = {
+            "round": covered_round,
+            "checked": len(wal_segments(self.dir)),
+            "deleted": 0,
+            "freed_bytes": 0,
+            "torn_completed": torn,
+            "crashed": False,
+        }
+        if not victims:
+            return info
+        manifest = {"round": int(covered_round), "segments": victims}
+        mpath = os.path.join(self.dir, GC_MANIFEST)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, separators=(",", ":"))
+        os.replace(tmp, mpath)  # the GC commit point
+        if crash_hook is not None and crash_hook():
+            # simulated crash between manifest write and unlink: the
+            # torn pass is recovered on the next open/compact/recovery
+            info["crashed"] = True
+            return info
+        for name in victims:
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
+            self._seg_max.pop(name, None)
+        os.unlink(mpath)
+        self._m_gc_passes.inc()
+        self._m_gc_segments.inc(len(victims))
+        self._g_segments.set(1 + len(wal_segments(self.dir)))
+        info["deleted"] = len(victims)
+        info["freed_bytes"] = freed
+        return info
+
+    def finish_torn_gc(self) -> int:
+        """Complete a GC pass torn by a crash (instance-side wrapper:
+        same repair as the module helper, plus the metrics every GC
+        path must report — :meth:`compact` routes through here so a
+        crash-repaired pass and a clean pass count identically)."""
+        n = finish_torn_gc(self.dir)
+        if n:
+            live = set(wal_segments(self.dir))
+            for name in list(self._seg_max):
+                if name not in live:
+                    del self._seg_max[name]
+            self._m_gc_passes.inc()
+            self._m_gc_segments.inc(n)
+            self._g_segments.set(1 + len(live))
+        return n
+
+    def status_fields(self) -> dict:
+        """Small-scalar durability view for ``/status.json`` (no disk
+        walk — gauge/counter reads only)."""
+        return {
+            "wal_segments": int(self._g_segments.value),
+            "bytes_since_snapshot": int(self._g_since.value),
+            "segments_sealed": self._m_sealed.value,
+            "gc_segments": self._m_gc_segments.value,
+        }
+
     def close(self) -> None:
         if not self._f.closed:
             self._f.close()
+
+
+def _segment_name(seq: int) -> str:
+    return f"{WAL_PREFIX}{seq:08d}.log"
+
+
+def _segment_seq(name: str) -> int:
+    return int(name[len(WAL_PREFIX):-len(".log")])
+
+
+def wal_segments(journal_dir: str) -> list[str]:
+    """Sealed WAL segment file names, oldest first."""
+    if not os.path.isdir(journal_dir):
+        return []
+    return sorted(
+        f for f in os.listdir(journal_dir)
+        if f.startswith(WAL_PREFIX) and f.endswith(".log")
+    )
+
+
+def finish_torn_gc(journal_dir: str) -> int:
+    """Complete a GC pass that crashed between its manifest write and
+    the unlinks: delete every victim the manifest lists that still
+    exists, then retire the manifest.  Idempotent; returns the number
+    of segments removed now.  A half-written ``GC_MANIFEST.json.tmp``
+    (crash before the manifest commit) is simply discarded — the pass
+    never started, all segments survive."""
+    tmp = os.path.join(journal_dir, GC_MANIFEST + ".tmp")
+    if os.path.exists(tmp):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    mpath = os.path.join(journal_dir, GC_MANIFEST)
+    if not os.path.exists(mpath):
+        return 0
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            manifest = json.load(f)
+        victims = [str(s) for s in manifest.get("segments", [])]
+    except (OSError, json.JSONDecodeError, AttributeError):
+        victims = []  # unreadable manifest: drop it, keep every segment
+    removed = 0
+    for name in victims:
+        path = os.path.join(journal_dir, name)
+        if os.path.exists(path):
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                pass
+    try:
+        os.unlink(mpath)
+    except OSError:
+        pass
+    return removed
+
+
+def sweep_staging(journal_dir: str) -> list[str]:
+    """Remove snapshot staging directories abandoned by a crash before
+    the atomic rename (``snap_*.tmp``).  They may contain a
+    valid-looking manifest — the rename IS the commit, so anything
+    still carrying the ``.tmp`` suffix was never committed and must
+    neither be listed as a candidate nor left to accumulate."""
+    if not os.path.isdir(journal_dir):
+        return []
+    removed = []
+    for d in sorted(os.listdir(journal_dir)):
+        if d.startswith(SNAP_PREFIX) and d.endswith(".tmp") and \
+                os.path.isdir(os.path.join(journal_dir, d)):
+            shutil.rmtree(os.path.join(journal_dir, d),
+                          ignore_errors=True)
+            removed.append(d)
+    return removed
 
 
 def _valid_prefix_bytes(path: str) -> int:
@@ -197,15 +529,13 @@ def _valid_prefix_bytes(path: str) -> int:
     return good
 
 
-def read_journal(journal_dir: str) -> tuple[list[dict], int]:
-    """All CRC-valid records, in order.  Reading stops at the first
-    damaged line (a crash can only tear the TAIL of an append-only
-    file); returns ``(records, dropped_lines)``."""
-    path = os.path.join(journal_dir, "journal.log")
+def _file_records(path: str) -> tuple[list[dict], int, bool]:
+    """CRC-valid records of one journal file: ``(records, total_lines,
+    clean)`` where ``clean`` is False when a damaged line stopped the
+    read early."""
     records: list[dict] = []
-    dropped = 0
     if not os.path.exists(path):
-        return records, dropped
+        return records, 0, True
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         lines = f.readlines()
     for i, line in enumerate(lines):
@@ -215,25 +545,123 @@ def read_journal(journal_dir: str) -> tuple[list[dict], int]:
                 raise ValueError("crc mismatch")
             records.append(json.loads(payload))
         except (ValueError, json.JSONDecodeError):
-            dropped = len(lines) - i
+            return records, len(lines), False
+    return records, len(lines), True
+
+
+def _segment_max_round(path: str) -> int | None:
+    """Highest round any CRC-valid record of a sealed segment carries;
+    None when the segment holds no records, a damaged line, or a record
+    without a round — all of which make it ineligible for GC (keep is
+    always the safe answer)."""
+    records, _n, clean = _file_records(path)
+    if not clean or not records:
+        return None
+    max_r = -1
+    for rec in records:
+        r = rec.get("r")
+        if not isinstance(r, int):
+            return None
+        max_r = max(max_r, r)
+    return max_r
+
+
+def read_journal(journal_dir: str) -> tuple[list[dict], int]:
+    """All CRC-valid records across sealed segments + the active file,
+    in append order.  Reading stops at the first damaged line (a crash
+    can only tear the tail of the file that was being appended; once a
+    line is suspect, so is everything after it — including later
+    files).  An empty trailing segment or a missing active file reads
+    as zero records, cleanly.  Returns ``(records, dropped_lines)``."""
+    records: list[dict] = []
+    dropped = 0
+    files = wal_segments(journal_dir) + [WAL_ACTIVE]
+    for i, name in enumerate(files):
+        path = os.path.join(journal_dir, name)
+        recs, total, clean = _file_records(path)
+        records.extend(recs)
+        if not clean:
+            dropped = total - len(recs)
+            for later in files[i + 1:]:
+                _r, t, _c = _file_records(
+                    os.path.join(journal_dir, later)
+                )
+                dropped += t
             break
     return records, dropped
 
 
 # ---------------------------------------------------------------------------
-# snapshot barriers
+# snapshot barriers (full + CRC-chained deltas)
 # ---------------------------------------------------------------------------
 
 
+def _manifest_crc(snap_dir: str) -> str | None:
+    """CRC32 (8 hex chars) of a snapshot's manifest FILE BYTES — the
+    chain link fingerprint: a delta records its base's manifest CRC, so
+    a re-written / damaged / swapped base breaks the chain loudly
+    instead of composing the wrong rows."""
+    try:
+        with open(os.path.join(snap_dir, "MANIFEST.json"), "rb") as f:
+            return f"{zlib.crc32(f.read()):08x}"
+    except OSError:
+        return None
+
+
 def write_snapshot(journal_dir: str, pool, streams, rnd: int,
-                   keep: int = 2) -> str:
-    """One fleet snapshot: per-class bucket states (CRC'd .npz), copies
-    of all live eviction spools, and a manifest of cursors/residency.
-    The set is staged in ``<dir>.tmp`` with the manifest written LAST,
-    then committed by a single directory rename — a crash mid-snapshot
-    leaves only an ignorable ``.tmp`` directory, never a half snapshot
-    that recovery could mistake for consistent."""
+                   keep: int = 2, kind: str = "full"
+                   ) -> tuple[str, dict]:
+    """One fleet snapshot barrier: per-class bucket state (CRC'd .npz),
+    hard links of all live eviction spools, and a manifest of
+    cursors/residency.  The set is staged in ``<dir>.tmp`` with the
+    manifest written LAST, then committed by a single directory rename —
+    a crash mid-snapshot leaves only an ignorable ``.tmp`` directory
+    (swept by the next open/recovery), never a half snapshot that
+    recovery could mistake for consistent.
+
+    ``kind="full"`` persists every used class's whole bucket (the chain
+    root).  ``kind="delta"`` persists only the rows the pool marked
+    dirty since the previous barrier (``DocPool.take_dirty``), chained
+    to the newest committed snapshot: the manifest records the base's
+    name + manifest CRC and the chain's full root.  A delta with no
+    committed base — or a base whose manifest no longer verifies, or a
+    chain already at :data:`MAX_CHAIN_DEPTH` — silently upgrades to a
+    full snapshot (re-rooting is always safe).  Either kind consumes
+    the pool's dirty set: the barrier IS the reset point.
+
+    Old snapshots are pruned by CHAIN (a delta's base is never deleted
+    from under it): the newest ``keep`` chains survive (``keep <= 0``
+    = never prune).  Returns ``(path, manifest)`` — the manifest as
+    committed, so callers read the re-rooted kind/depth without a disk
+    round-trip."""
     from .pool import PackedState  # local: avoid import cycle at module load
+
+    if kind not in ("full", "delta"):
+        raise ValueError(f"unknown snapshot kind {kind!r}")
+    dirty = pool.take_dirty()  # consumed by EVERY barrier kind
+
+    base_name = None
+    base_crc = None
+    chain_root = None
+    depth = 1
+    if kind == "delta":
+        snaps = list_snapshots(journal_dir)
+        base_name = snaps[-1] if snaps else None
+        m_base = (
+            _read_manifest(os.path.join(journal_dir, base_name))
+            if base_name else None
+        )
+        if m_base is None:
+            kind, base_name = "full", None  # no usable base: re-root
+        else:
+            depth = int(m_base.get("depth", 1)) + 1
+            if depth > MAX_CHAIN_DEPTH:
+                kind, base_name, depth = "full", None, 1
+            else:
+                base_crc = _manifest_crc(
+                    os.path.join(journal_dir, base_name)
+                )
+                chain_root = m_base.get("chain", base_name)
 
     final = os.path.join(journal_dir, f"{SNAP_PREFIX}{rnd:08d}")
     tmp = final + ".tmp"
@@ -258,14 +686,46 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
                 shutil.copy2(rec.spool, dst)
             spooled[str(doc_id)] = fname
 
-    used_classes = sorted({int(v[0]) for v in resident.values()})
-    for cls in used_classes:
-        doc, length, nvis = pool.pull_bucket(cls)  # the sync barrier
-        save_state(
-            os.path.join(tmp, f"class_{cls}.npz"),
-            PackedState(doc=doc, length=length, nvis=nvis),
-            compress=False,
+    class_shapes: dict[str, list[int]] = {}
+    delta_rows: dict[str, list[int]] = {}
+    if kind == "full":
+        used_classes = sorted({int(v[0]) for v in resident.values()})
+        for cls in used_classes:
+            doc, length, nvis = pool.pull_bucket(cls)  # the sync barrier
+            save_state(
+                os.path.join(tmp, f"class_{cls}.npz"),
+                PackedState(doc=doc, length=length, nvis=nvis),
+                compress=False,
+            )
+            class_shapes[str(cls)] = [int(doc.shape[0]),
+                                      int(doc.shape[1])]
+    else:
+        used_classes = sorted(
+            cls for cls, rows in dirty.items() if rows
         )
+        for cls in used_classes:
+            rows = [r for r in dirty[cls]
+                    if 0 <= r < pool.buckets[cls].R]
+            if not rows:
+                continue
+            doc, length, nvis = pool.pull_bucket(cls)  # sync: dirty only
+            rows_a = np.asarray(rows, np.int64)
+            # trim to the dirty rows' used prefix (the tail is the
+            # constant beyond-length coding 2 that compose re-pads)
+            ltrim = max(1, int(length[rows_a].max(initial=0)))
+            save_state(
+                os.path.join(tmp, f"delta_{cls}.npz"),
+                PackedState(
+                    doc=np.ascontiguousarray(doc[rows_a, :ltrim]),
+                    length=np.asarray(length[rows_a], np.int32),
+                    nvis=np.asarray(nvis[rows_a], np.int32),
+                ),
+                compress=False,
+            )
+            delta_rows[str(cls)] = [int(r) for r in rows]
+            class_shapes[str(cls)] = [int(doc.shape[0]),
+                                      int(doc.shape[1])]
+        used_classes = sorted(int(c) for c in delta_rows)
 
     docs = {}
     for doc_id, st in streams.items():
@@ -274,9 +734,17 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
             "lim": None if st.limit is None else int(st.limit),
             "lossy": bool(st.lossy),
         }
+    name = os.path.basename(final)
     manifest = {
         "round": int(rnd),
+        "kind": kind,
+        "base": base_name,
+        "base_crc": base_crc,
+        "chain": chain_root if kind == "delta" else name,
+        "depth": depth,
         "classes": used_classes,
+        "class_shapes": class_shapes,
+        "delta_rows": delta_rows,
         "resident": resident,
         "spooled": spooled,
         "docs": docs,
@@ -287,13 +755,55 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
     os.replace(mtmp, os.path.join(tmp, "MANIFEST.json"))
     os.rename(tmp, final)  # the commit point
 
-    for old in list_snapshots(journal_dir)[:-keep]:
-        shutil.rmtree(os.path.join(journal_dir, old), ignore_errors=True)
-    return final
+    _prune_chains(journal_dir, keep)
+    return final, manifest
+
+
+def _prune_chains(journal_dir: str, keep: int) -> None:
+    """Prune committed snapshots by CHAIN: group directories into
+    chains (a full snapshot starts one; a delta whose base is the
+    previous member continues it; anything orphaned is its own
+    prunable group) and delete everything but the newest ``keep``
+    chains — a retained delta's base links always survive with it."""
+    names = list_snapshots(journal_dir)
+    chains: list[list[str]] = []
+    for n in names:
+        m = _read_manifest(os.path.join(journal_dir, n))
+        if (
+            m is not None
+            and m.get("kind", "full") == "delta"
+            and chains
+            and m.get("base") == chains[-1][-1]
+        ):
+            chains[-1].append(n)
+        else:
+            chains.append([n])
+    # keep <= 0 = never prune (the historical keep-all contract)
+    for chain in (chains[:-keep] if keep > 0 else []):
+        for n in chain:
+            shutil.rmtree(os.path.join(journal_dir, n),
+                          ignore_errors=True)
+
+
+def retained_floor(journal_dir: str) -> int | None:
+    """The OLDEST retained snapshot's round — the WAL GC floor.  Chain
+    fallback may land recovery on ANY retained snapshot, and a
+    landing at round R re-applies journaled decisions (quarantine /
+    shed) from records with ``r >= R``; GC below the newest barrier
+    alone would delete records a fallback still needs.  Decisions
+    older than a snapshot are durable in its manifest, so the floor is
+    exactly the oldest retained barrier.  (A cold start below the
+    floor — every retained chain dead — may still lose GC'd decisions;
+    that takes multiple independent corruptions and full replay keeps
+    the oracle gate honest.)"""
+    snaps = list_snapshots(journal_dir)
+    return int(snaps[0][len(SNAP_PREFIX):]) if snaps else None
 
 
 def list_snapshots(journal_dir: str) -> list[str]:
-    """Committed snapshot directory names, oldest first."""
+    """Committed snapshot directory names, oldest first.  Staging
+    directories (``.tmp`` suffix — abandoned by a crash before the
+    atomic rename) are never candidates, whatever they contain."""
     if not os.path.isdir(journal_dir):
         return []
     return sorted(
@@ -308,8 +818,137 @@ def _read_manifest(snap_dir: str) -> dict | None:
         with open(os.path.join(snap_dir, "MANIFEST.json"),
                   encoding="utf-8") as f:
             return json.load(f)
-    except (OSError, json.JSONDecodeError):
+    except (OSError, UnicodeDecodeError, ValueError):
+        # ValueError covers JSONDecodeError; bit-flip damage can also
+        # surface as undecodable UTF-8 before the parser even runs
         return None
+
+
+def chain_members(journal_dir: str, name: str,
+                  manifests: dict | None = None) -> list[str]:
+    """The snapshot chain ending at ``name``, root first.  Every link
+    is verified: the base directory must exist, its manifest must
+    parse, and its manifest-file CRC must match what the dependent
+    delta recorded.  Raises :class:`ChainError` on any broken link —
+    callers fall back to an older candidate."""
+    members: list[str] = []
+    cur = name
+    for _ in range(MAX_CHAIN_DEPTH + 1):
+        sd = os.path.join(journal_dir, cur)
+        if manifests is not None and cur in manifests:
+            m = manifests[cur]
+        else:
+            m = _read_manifest(sd)
+            if manifests is not None:
+                manifests[cur] = m
+        if m is None:
+            raise ChainError(f"snapshot {cur}: unreadable manifest")
+        members.append(cur)
+        if m.get("kind", "full") != "delta":
+            members.reverse()
+            return members
+        base = m.get("base")
+        if not base:
+            raise ChainError(f"delta {cur}: no base link")
+        got = _manifest_crc(os.path.join(journal_dir, base))
+        if got is None or got != m.get("base_crc"):
+            raise ChainError(
+                f"delta {cur}: base {base} manifest CRC mismatch "
+                f"(chain link broken)"
+            )
+        cur = base
+    raise ChainError(f"snapshot {name}: chain deeper than "
+                     f"{MAX_CHAIN_DEPTH}")
+
+
+def _compose_class(journal_dir: str, members: list[str],
+                   manifests: dict, cls: int) -> tuple | None:
+    """Materialize one capacity class's full ``(doc, length, nvis)``
+    bucket arrays as of the chain tip: start from the full root's
+    member (or a fresh all-empty bucket when the class first appears in
+    a delta) and overlay each delta's dirty rows in chain order —
+    the latest write to a row wins, exactly the dirty-tracking
+    invariant.  Returns None when no member of the chain mentions the
+    class.  Raises CorruptCheckpointError on member damage."""
+    key = str(cls)
+    state = None  # (doc, length, nvis) np arrays, (R, C)
+    for name in members:
+        m = manifests[name]
+        sd = os.path.join(journal_dir, name)
+        if m.get("kind", "full") != "delta":
+            if int(cls) in [int(c) for c in m.get("classes", [])]:
+                st = load_state(os.path.join(sd, f"class_{cls}.npz"))
+                state = (
+                    np.array(st.doc, np.int32),
+                    np.array(st.length, np.int32),
+                    np.array(st.nvis, np.int32),
+                )
+            continue
+        rows = m.get("delta_rows", {}).get(key)
+        if not rows:
+            continue
+        if state is None:
+            R, C = m["class_shapes"][key]
+            state = (
+                np.full((R, C), 2, np.int32),
+                np.zeros(R, np.int32),
+                np.zeros(R, np.int32),
+            )
+        st = load_state(os.path.join(sd, f"delta_{cls}.npz"))
+        doc, length, nvis = state
+        d = np.asarray(st.doc, np.int32)
+        rows_a = np.asarray(rows, np.int64)
+        doc[rows_a, :d.shape[1]] = d
+        doc[rows_a, d.shape[1]:] = 2
+        length[rows_a] = np.asarray(st.length, np.int32)
+        nvis[rows_a] = np.asarray(st.nvis, np.int32)
+    return state
+
+
+def load_chain_states(journal_dir: str, name: str,
+                      manifests: dict | None = None
+                      ) -> tuple[dict, dict, list[str]]:
+    """Materialize snapshot ``name`` by walking its chain: returns
+    ``(manifest, states, members)`` where ``states`` maps every class
+    the tip's residency needs to composed host arrays.  Raises
+    :class:`ChainError` / :class:`CorruptCheckpointError` on any broken
+    link or damaged member — the caller's cue to fall back down."""
+    manifests = {} if manifests is None else manifests
+    members = chain_members(journal_dir, name, manifests)
+    tip = manifests[name]
+    needed = sorted({
+        int(v[0]) for v in tip.get("resident", {}).values()
+    })
+    states = {}
+    for cls in needed:
+        st = _compose_class(journal_dir, members, manifests, cls)
+        if st is None:
+            raise ChainError(
+                f"snapshot {name}: class {cls} resident but absent "
+                "from every chain member"
+            )
+        states[cls] = st
+    return tip, states, members
+
+
+def probe_recovery(journal_dir: str) -> tuple[str | None, int]:
+    """Dry-run the snapshot selection recovery performs: walk
+    candidates newest-first, materializing each chain, and return
+    ``(first_usable_snapshot, fallbacks)`` — ``fallbacks`` counts
+    candidates skipped over damage.  ``(None, n)`` means cold start.
+    Used by the chaos finalizer to prove ``delta_corrupt`` recovery
+    (chain fallback exercised, state materializable) without building
+    a pool."""
+    manifests: dict = {}
+    fallbacks = 0
+    for snap in reversed(list_snapshots(journal_dir)):
+        try:
+            load_chain_states(journal_dir, snap, manifests)
+        except _RECOVER_ERRORS:
+            fallbacks += 1
+            continue
+        return snap, fallbacks
+    return None, fallbacks
 
 
 class SnapshotBases:
@@ -320,6 +959,10 @@ class SnapshotBases:
     the caller's target capacity by :func:`rebuild_doc`.  Returns None
     when no snapshot holds the doc (fresh rebuild from cursor 0).
 
+    Chain-aware: a doc resident at a delta snapshot resolves through
+    the composed chain (root + dirty-row overlays); any damaged link
+    falls back to the next older snapshot, same as full recovery.
+
     Manifests are cached per snapshot (a class-loss recovery calls
     ``base`` once per resident doc); the per-class state cache can hold
     whole bucket arrays, so callers ``release()`` it once a recovery
@@ -327,7 +970,7 @@ class SnapshotBases:
 
     def __init__(self, journal_dir: str | None):
         self.dir = journal_dir
-        self._class_cache: dict[str, object] = {}
+        self._class_cache: dict[tuple, object] = {}
         self._manifests: dict[str, dict | None] = {}
 
     def release(self) -> None:
@@ -341,6 +984,20 @@ class SnapshotBases:
             self._manifests[snap] = _read_manifest(sd)
         return self._manifests[snap]
 
+    def _class_state(self, snap: str, cls: int):
+        """Composed (doc, length, nvis) for ``cls`` as of ``snap``
+        (chain-walked, cached).  Raises on damage."""
+        ck = (snap, int(cls))
+        if ck not in self._class_cache:
+            members = chain_members(self.dir, snap, self._manifests)
+            st = _compose_class(self.dir, members, self._manifests, cls)
+            if st is None:
+                raise ChainError(
+                    f"snapshot {snap}: class {cls} absent from chain"
+                )
+            self._class_cache[ck] = st
+        return self._class_cache[ck]
+
     def base(self, doc_id: int):
         if self.dir is None:
             return None
@@ -353,16 +1010,11 @@ class SnapshotBases:
             try:
                 if key in m.get("resident", {}):
                     cls, row = m["resident"][key]
-                    ck = f"{snap}/class_{cls}"
-                    if ck not in self._class_cache:
-                        self._class_cache[ck] = load_state(
-                            os.path.join(sd, f"class_{cls}.npz")
-                        )
-                    st = self._class_cache[ck]
+                    doc, length, nvis = self._class_state(snap, cls)
                     return (
-                        np.array(st.doc[row]),
-                        int(st.length[row]),
-                        int(st.nvis[row]),
+                        np.array(doc[row]),
+                        int(length[row]),
+                        int(nvis[row]),
                         int(m["docs"][key]["c"]),
                     )
                 if key in m.get("spooled", {}):
@@ -375,8 +1027,8 @@ class SnapshotBases:
                         int(st.nvis[0]),
                         int(m["docs"][key]["c"]),
                     )
-            except CorruptCheckpointError:
-                continue  # damaged snapshot member: fall back to older
+            except _RECOVER_ERRORS:
+                continue  # damaged member/link: fall back to older
         return None
 
 
@@ -507,39 +1159,55 @@ class RecoveryReport:
     quarantined: list[int] = field(default_factory=list)
     shed_ops: int = 0
     records: int = 0
+    chain_depth: int = 0  # members composed for the chosen snapshot
+    chain_fallbacks: int = 0  # damaged candidates skipped on the way down
+    gc_segments_completed: int = 0  # torn GC finished by this recovery
+    staging_removed: int = 0  # abandoned snap_*.tmp dirs swept
 
 
 def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:
     """Restore a crashed fleet into a FRESH pool + stream set (built by
-    the same ``prepare_streams`` the original run used): load the newest
-    intact snapshot, re-apply journaled quarantine/shed decisions from
-    the tail, and leave cursors at the snapshot barrier so resumed
-    serving replays the journal tail through the normal macro-round
-    path.  Falls back to older snapshots on damage, and to a cold start
-    (round 0) when none is usable — per-doc streams are deterministic,
-    so the fleet is recoverable from nothing but the workload."""
+    the same ``prepare_streams`` the original run used): complete any
+    GC pass torn by the crash, sweep abandoned staging directories,
+    materialize the newest snapshot whose whole chain verifies
+    (delta → older delta → full root), re-apply journaled
+    quarantine/shed decisions from the tail, and leave cursors at the
+    chosen barrier so resumed serving replays the journal tail through
+    the normal macro-round path.  Falls back down the chain — and
+    across chains — on damage, and to a cold start (round 0) when
+    nothing is usable: per-doc streams are deterministic, so the fleet
+    is recoverable from nothing but the workload."""
     report = RecoveryReport()
+    report.gc_segments_completed = finish_torn_gc(journal_dir)
+    report.staging_removed = len(sweep_staging(journal_dir))
     records, dropped = read_journal(journal_dir)
     report.torn_records = dropped
     report.records = len(records)
 
-    # ---- newest intact snapshot ----
+    # ---- newest snapshot whose chain verifies end to end ----
     manifest = None
+    manifests: dict = {}
     for snap in reversed(list_snapshots(journal_dir)):
         sd = os.path.join(journal_dir, snap)
-        m = _read_manifest(sd)
-        if m is None:
+        try:
+            m, states, members = load_chain_states(
+                journal_dir, snap, manifests
+            )
+        except _RECOVER_ERRORS:
+            report.chain_fallbacks += 1
             continue
         try:
-            _restore_snapshot(pool, streams, sd, m)
-        except CorruptCheckpointError:
+            _restore_snapshot(pool, streams, sd, m, states)
+        except _RECOVER_ERRORS:
             _reset_fleet(pool, streams)
+            report.chain_fallbacks += 1
             continue
         manifest = m
         report.snapshot_dir = sd
         report.snapshot_round = int(m["round"])
         report.docs_restored = len(m["resident"])
         report.spools_restored = len(m["spooled"])
+        report.chain_depth = len(members)
         break
 
     # ---- journal tail: redo span + re-applied decisions ----
@@ -600,27 +1268,25 @@ def _reset_fleet(pool, streams) -> None:
             st.delivered = 0
 
 
-def _restore_snapshot(pool, streams, snap_dir: str, manifest: dict) -> None:
-    """Apply one snapshot to a fresh pool/streams.  Raises
-    CorruptCheckpointError on any damaged member (caller falls back)."""
-    # per-class bucket states first (so damage aborts before bookkeeping)
-    states = {
-        cls: load_state(os.path.join(snap_dir, f"class_{cls}.npz"))
-        for cls in manifest["classes"]
-    }
+def _restore_snapshot(pool, streams, snap_dir: str, manifest: dict,
+                      states: dict) -> None:
+    """Apply one materialized snapshot (``states`` = chain-composed
+    per-class host arrays) to a fresh pool/streams.  Raises
+    CorruptCheckpointError on any damaged spool member... the caller
+    falls back down the chain."""
     by_class: dict[int, list[tuple[int, int]]] = {}
     for key, (cls, row) in manifest["resident"].items():
         by_class.setdefault(int(cls), []).append((int(key), int(row)))
     for cls, docs in by_class.items():
         b = pool.buckets[cls]
-        st = states[cls]
+        st_doc, st_len, st_nvis = states[cls]
         doc_w = np.full((b.R, b.C), 2, np.int32)
         len_w = np.zeros(b.R, np.int32)
         nvis_w = np.zeros(b.R, np.int32)
         for doc_id, row in docs:
-            doc_w[row] = np.asarray(st.doc[row], np.int32)
-            len_w[row] = int(st.length[row])
-            nvis_w[row] = int(st.nvis[row])
+            doc_w[row] = np.asarray(st_doc[row], np.int32)
+            len_w[row] = int(st_len[row])
+            nvis_w[row] = int(st_nvis[row])
             b.rows[row] = doc_id
             b.take_row(row)
             rec = pool.docs[doc_id]

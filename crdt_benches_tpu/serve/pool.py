@@ -320,6 +320,11 @@ class DocPool:
             for name in ("evictions", "restores", "promotions",
                          "fresh_admits")
         }
+        # per-row dirty tracking (durability v2): rows whose device
+        # content changed since the last snapshot barrier.  Pure host
+        # set arithmetic — delta snapshots persist exactly these rows,
+        # and the barrier consumes the set (take_dirty).
+        self._dirty: dict[int, set[int]] = {c: set() for c in classes}
 
     def bind_metrics(self, registry) -> None:
         """Attach this pool's counters to a drain's MetricsRegistry
@@ -359,6 +364,52 @@ class DocPool:
     @fresh_admits.setter
     def fresh_admits(self, v: int) -> None:
         self._counters["fresh_admits"].value = int(v)
+
+    # ---- dirty tracking (delta-snapshot substrate) ----
+
+    def note_rows_dirty(self, cls: int, rows) -> None:
+        """Mark rows of ``cls`` as touched since the last barrier."""
+        self._dirty[cls].update(int(r) for r in rows)
+
+    def take_dirty(self) -> dict[int, list[int]]:
+        """Consume the dirty set: ``{cls: sorted rows}`` for classes
+        with any dirty row, cleared as a unit — the snapshot barrier is
+        the reset point (full barriers consume it too: they capture
+        everything, so the chain restarts clean)."""
+        out = {
+            c: sorted(s) for c, s in self._dirty.items() if s
+        }
+        for s in self._dirty.values():
+            s.clear()
+        return out
+
+    def dirty_rows(self, cls: int) -> set[int]:
+        """Read-only view for tests/diagnostics."""
+        return set(self._dirty[cls])
+
+    def _mark_op_rows(self, cls: int, kind, Rt: int) -> None:
+        """Mark the rows an op tensor actually touches.  ``kind`` is
+        the staged host array ((K, Rt, B) or (R, B)); rows whose every
+        lane is PAD are no-ops end to end and stay clean.  Tier-sliced
+        indices map back to global rows via the shard layout.  A
+        non-host tensor (direct jnp callers) marks the whole tier
+        conservatively — correctness over delta size, and never a
+        device sync on the hot path."""
+        b = self.buckets[cls]
+        dd = self._dirty[cls]
+        if not isinstance(kind, np.ndarray):
+            rows = range(Rt)
+        elif kind.ndim == 3:
+            rows = np.flatnonzero((kind != PAD).any(axis=(0, 2)))
+        else:
+            rows = np.flatnonzero((kind != PAD).any(axis=1))
+        if Rt == b.R:
+            dd.update(int(r) for r in rows)
+            return
+        rt = Rt // b.n_sh
+        for r in rows:
+            s, l = divmod(int(r), rt)
+            dd.add(s * b.Rg + l)
 
     # ---- registration / class arithmetic ----
 
@@ -435,6 +486,7 @@ class DocPool:
         )
         b.rows[row] = rec.doc_id
         rec.cls, rec.row = cls, row
+        self._dirty[cls].add(row)
         return cls, row
 
     def _spool_path(self, doc_id: int) -> str:
@@ -540,10 +592,17 @@ class DocPool:
         )
 
     def upload_bucket(self, cls: int, doc: np.ndarray, length: np.ndarray,
-                      nvis: np.ndarray) -> None:
+                      nvis: np.ndarray, dirty_rows=None) -> None:
         """Replace a bucket's device state from host arrays (the write
-        half of a boundary compose; re-applies the mesh sharding)."""
+        half of a boundary compose; re-applies the mesh sharding).
+        ``dirty_rows`` scopes the delta-snapshot dirty marks to the
+        rows the compose actually rewrote; the default (None) marks
+        every row — conservative, never wrong."""
         b = self.buckets[cls]
+        self._dirty[cls].update(
+            range(b.R) if dirty_rows is None
+            else (int(r) for r in dirty_rows)
+        )
         state = PackedState(
             doc=jnp.asarray(doc), length=jnp.asarray(length),
             nvis=jnp.asarray(nvis),
@@ -561,6 +620,7 @@ class DocPool:
         """Apply one (R, B) UNIT-op batch to class ``cls`` (row r = ops
         for the doc resident in row r; PAD rows are no-ops)."""
         b = self.buckets[cls]
+        self._mark_op_rows(cls, kind, b.R)
         args = [jnp.asarray(a) for a in (kind, pos, slot)]
         if self._sharding is not None:
             args = [jax.device_put(a, self._sharding) for a in args]
@@ -1011,6 +1071,7 @@ class DocPool:
         K, Rt, B = kind.shape
         if Rt % b.n_sh or not b.n_sh <= Rt <= b.R:
             raise ValueError(f"tier {Rt} incompatible with bucket {b.R}")
+        self._mark_op_rows(cls, kind, Rt)
         if self.serve_kernel == "fused":
             fresh = self._fused_macro(cls, kind, pos, rlen, slot0, nbits)
             b.steps += K

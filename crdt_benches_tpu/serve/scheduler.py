@@ -95,7 +95,15 @@ from ..traces.tensorize import (
 )
 from .pool import DocPool, _fresh_row_np
 from ..utils.checkpoint import CorruptCheckpointError, load_state
-from .journal import SnapshotBases, rebuild_doc, write_snapshot
+from .journal import (
+    SnapshotBases,
+    _read_manifest,
+    list_snapshots,
+    probe_recovery,
+    rebuild_doc,
+    retained_floor,
+    write_snapshot,
+)
 
 
 @dataclass
@@ -289,6 +297,8 @@ class ServeStats:
     faults_seen: int = 0  # faults the engine observed (incl. organic)
     faults_injected: int = 0  # events the injector fired
     snapshots: int = 0
+    snapshots_full: int = 0  # chain-rooting full barriers
+    snapshots_delta: int = 0  # dirty-row delta barriers
     snapshot_time: float = 0.0
 
     def __post_init__(self):
@@ -408,6 +418,7 @@ class FleetScheduler:
                  queue_cap: int = 0, overflow_policy: str = "defer",
                  faults=None, journal=None,
                  snapshot_every: int = 0, snapshot_keep: int = 2,
+                 snapshot_full_every: int = 4,
                  degrade_after: int = 3, degrade_window: int = 8,
                  degrade_rounds: int = 4,
                  start_round: int = 0, profiler=None, telemetry=None,
@@ -432,6 +443,12 @@ class FleetScheduler:
         self.journal = journal  # serve/journal.py OpJournal (or None)
         self.snapshot_every = snapshot_every
         self.snapshot_keep = snapshot_keep
+        #: every Nth barrier is a chain-rooting FULL snapshot; the ones
+        #: between persist only rows dirty since the previous barrier
+        #: (<=1 = every barrier full, the pre-delta behavior)
+        self.snapshot_full_every = max(0, snapshot_full_every)
+        self._barrier_count = 0
+        self._pending_gc_ev = None  # crash_compact fired, GC torn
         self.degrade_after = degrade_after
         self.degrade_window = degrade_window
         self.degrade_rounds = degrade_rounds
@@ -477,6 +494,15 @@ class FleetScheduler:
             slo.bind(reg)  # burn-rate gauges pre-registered (G013)
         self.reqtrace.bind(self.stats)
         self._m_faults_seen = reg.counter("serve.faults.seen")
+        # durability gauges (pre-registered off the hot path, G013):
+        # delta-chain depth of the newest barrier and the round of the
+        # last WAL compaction pass — with the journal's own gauges
+        # (segment count, bytes since snapshot) these are the live
+        # bounded-footprint view on /metrics + /status.json
+        self._g_chain_depth = reg.gauge("serve.durability.chain_depth")
+        self._g_last_compact = reg.gauge(
+            "serve.durability.last_compaction_round"
+        )
         # continuous telemetry (obs/timeseries.py ServeTelemetry, or
         # None): per-round time-series windows, per-shard series, the
         # status endpoint and the soak anomaly detectors all hang off
@@ -1040,6 +1066,11 @@ class FleetScheduler:
                     "heal", r=self.round, doc=doc_id,
                     ops=st.cursor - start, why="spool",
                 )
+            if self.telemetry is not None:
+                self.telemetry.note_event(
+                    "recovery", round=self.round, doc=doc_id,
+                    why="spool", ops=st.cursor - start,
+                )
             return row_v, L, nv
         except Exception as e2:  # rebuild itself failed: isolate the doc
             self._quarantine(
@@ -1108,12 +1139,40 @@ class FleetScheduler:
                 "device_loss", r=self.round, cls=cls, docs=len(affected),
                 ops=replayed,
             )
+        if self.telemetry is not None:
+            self.telemetry.note_event(
+                "recovery", round=self.round, cls=cls,
+                why="device_loss", ops=replayed,
+            )
 
     def finalize_faults(self) -> None:
         """End-of-drain sweep: a corrupted spool whose doc was never
         rehydrated again is healed NOW (rebuild + rewrite the spool), so
         a chaos run never ends with an undecodable doc or a fired fault
-        left unrecovered."""
+        left unrecovered.  Durability kinds close here too: a torn GC
+        pass still pending is completed (the exact repair the next open
+        would perform), and a corrupted delta link is proven recoverable
+        by dry-running the chain-fallback snapshot selection."""
+        for e in self.faults.plan.events:
+            if e.kind == "crash_compact" and e.fired and not e.recovered \
+                    and self.journal is not None:
+                n = self.journal.finish_torn_gc()
+                e.recover(completed="finalize", segments=n)
+                if e is self._pending_gc_ev:
+                    self._pending_gc_ev = None
+            if e.kind == "delta_corrupt" and e.fired and not e.recovered \
+                    and self.journal is not None:
+                used, fallbacks = probe_recovery(self.journal.dir)
+                if used is not None:
+                    # a usable snapshot materialized despite the damage:
+                    # either the walk fell back below the corrupt link
+                    # (fallbacks > 0) or a later full barrier re-rooted
+                    # the chain past it — both are the designed repair
+                    e.recover(fallback_to=used, fallbacks=fallbacks)
+                if self.telemetry is not None:
+                    self.telemetry.note_event(
+                        "recovery_probe", used=used, fallbacks=fallbacks,
+                    )
         for e in self.faults.plan.events:
             if e.kind not in ("spool_corrupt", "spool_truncate"):
                 continue
@@ -1212,7 +1271,10 @@ class FleetScheduler:
                     doc_w[row, L:] = 2
                     len_w[row] = L
                     nvis_w[row] = int(snvis[src_row])
-            pool.upload_bucket(cls, doc_w, len_w, nvis_w)
+            pool.upload_bucket(
+                cls, doc_w, len_w, nvis_w,
+                dirty_rows=[row for _d, row, _s in items],
+            )
 
     # ---- dispatch + mirrors ----
 
@@ -1304,20 +1366,125 @@ class FleetScheduler:
 
     @fenced
     def _snapshot_barrier(self) -> None:  # graftlint: fence=journal
-        """Periodic fleet snapshot barrier (journal mode): pull every
-        bucket once and persist the consistent set.  The barrier is a
+        """Periodic fleet snapshot barrier (journal mode): persist a
+        consistent set — a chain-rooting FULL barrier every
+        ``snapshot_full_every``-th time, a dirty-rows-only DELTA
+        (CRC-chained to its base) in between — then run the WAL
+        segment GC pass the barrier just made safe.  The barrier is a
         forced sync — its round is flagged so steady-state latency
         quantiles exclude it, like compile rounds."""
         t0 = time.perf_counter()
-        d = write_snapshot(
+        self._barrier_count += 1
+        kind = "full"
+        if (self.snapshot_full_every > 1
+                and (self._barrier_count - 1) % self.snapshot_full_every):
+            kind = "delta"
+        d, m = write_snapshot(
             self.journal.dir, self.pool, self.streams, self.round,
-            keep=self.snapshot_keep,
+            keep=self.snapshot_keep, kind=kind,
         )
         self.stats.snapshots += 1
         self.stats.snapshot_time += time.perf_counter() - t0
+        # write_snapshot may have silently re-rooted (no usable base /
+        # depth cap) — the committed manifest is the truth
+        kind = m["kind"]
+        depth = int(m["depth"])
+        if kind == "full":
+            self.stats.snapshots_full += 1
+        else:
+            self.stats.snapshots_delta += 1
+        self._g_chain_depth.set(depth)
         self.journal.note_snapshot(d)
-        self.journal.event("snap", r=self.round, dir=os.path.basename(d))
         self._bases.release()  # the new barrier may have pruned old dirs
+        if self.telemetry is not None:
+            self.telemetry.note_event(
+                "snapshot", round=self.round, snap_kind=kind,
+                depth=depth,
+            )
+        # ---- WAL segment GC: safe exactly now (the barrier committed).
+        # Covered round = the OLDEST retained snapshot's round, not
+        # this barrier's: chain fallback may land recovery on any
+        # retained snapshot and its redo tail (incl. journaled
+        # quarantine/shed decisions) starts there.  Crash-safe
+        # two-phase delete; the chaos injector's crash_compact kills
+        # it between the GC-manifest write and the unlinks.  The
+        # barrier's own "snap" marker is appended AFTER the pass:
+        # compact rolls the active file first, and a marker inside the
+        # sealed segment at the covered round would pin it for one
+        # extra barrier. ----
+        floor = retained_floor(self.journal.dir)
+        info = self.journal.compact(
+            self.round if floor is None else floor,
+            crash_hook=self._gc_crash_hook,
+        )
+        self.journal.event(
+            "snap", r=self.round, dir=os.path.basename(d),
+            snap_kind=kind, depth=depth,
+        )
+        if not info["crashed"]:
+            # a pass killed mid-flight did NOT complete — the gauge
+            # answers "when did a compaction last finish"
+            self._g_last_compact.set(self.round)
+        if info["torn_completed"] and self._pending_gc_ev is not None:
+            self._pending_gc_ev.recover(
+                completed_round=self.round,
+                segments=info["torn_completed"],
+            )
+            self._pending_gc_ev = None
+        if self.telemetry is not None and (
+                info["deleted"] or info["torn_completed"]
+                or info["crashed"]):
+            self.telemetry.note_event("compaction", **info)
+        if self.faults is not None:
+            self._fire_delta_corrupt()
+
+    def _gc_crash_hook(self) -> bool:
+        """The ``crash_compact`` kill point: polled by the journal's GC
+        pass between its manifest commit and the unlinks.  Returning
+        True abandons the pass mid-flight — exactly the torn state the
+        next open/compaction/recovery must repair."""
+        if self.faults is None:
+            return False
+        ev = self.faults.compact_crash_event(self.round)
+        if ev is None:
+            return False
+        ev.fire(self.round, stage="post_manifest_pre_unlink")
+        self.stats.faults_injected += 1
+        self._note_fault()
+        self._pending_gc_ev = ev
+        return True
+
+    def _fire_delta_corrupt(self) -> None:
+        """The ``delta_corrupt`` chaos kind: flip bytes inside the
+        newest delta snapshot's member (runs inside the barrier fence —
+        pure file damage).  Stays pending until a delta exists.
+        Recovery must fall back down the chain — proven by
+        :meth:`finalize_faults`'s probe or the bench recovery leg."""
+        ev = self.faults.delta_corrupt_event(self.round)
+        if ev is None:
+            return
+        jd = self.journal.dir
+        target = None
+        for snap in reversed(list_snapshots(jd)):
+            m = _read_manifest(os.path.join(jd, snap))
+            if m is not None and m.get("kind") == "delta":
+                target = snap
+                break
+        if target is None:
+            return  # no delta committed yet: retried next barrier
+        sd = os.path.join(jd, target)
+        members = sorted(
+            f for f in os.listdir(sd)
+            if f.startswith("delta_") and f.endswith(".npz")
+        )
+        path = os.path.join(
+            sd, members[0] if members else "MANIFEST.json"
+        )
+        detail = self.faults.corrupt_file(path, "delta_corrupt")
+        ev.fire(self.round, dir=target,
+                member=os.path.basename(path), **detail)
+        self.stats.faults_injected += 1
+        self._note_fault()
 
     # ---- continuous telemetry taps (host-only; see obs/timeseries) ----
 
@@ -1367,6 +1534,16 @@ class FleetScheduler:
             "snapshots": s.snapshots,
             "done": False,
         }
+        if self.journal is not None:
+            # live bounded-footprint view: WAL segments, bytes since
+            # the last committed barrier, chain depth, last GC round
+            # (gauge/counter reads only — no disk walk per round)
+            d = self.journal.status_fields()
+            d["chain_depth"] = int(self._g_chain_depth.value)
+            d["last_compaction_round"] = int(self._g_last_compact.value)
+            d["snapshots_full"] = s.snapshots_full
+            d["snapshots_delta"] = s.snapshots_delta
+            out["durability"] = d
         if self.slo is not None:
             # burn rates + top-K slowest docs with segment breakdowns
             # (pure host arithmetic over pre-registered state, G013)
@@ -1514,7 +1691,12 @@ class FleetScheduler:
                 dt + time.perf_counter() - tail0, c, b
             )
         self._flush_round()
-        if self.faults is not None and max_rounds is None:
+        if self.faults is not None and self.done:
+            # gate on DONE, not on max_rounds: a --serve-crash-round
+            # larger than the natural drain length completes the drain,
+            # and a completed drain must always sweep its faults — only
+            # a genuinely interrupted run leaves recovery to the
+            # journal (the bench recovery leg closes its events there)
             with span("serve.finalize_faults"):
                 self.finalize_faults()
         self.stats.wall_time += time.perf_counter() - t0

@@ -231,6 +231,7 @@ def build_fleet(
     arrival_span: int = 8,
     bands: dict | None = None,
     delivery: str | None = None,
+    horizon: int = 1,
 ) -> list[Session]:
     """N sessions drawn from the mix's band weights, with arrival rounds
     staggered uniformly over ``arrival_span`` rounds.  ``mix`` is a name
@@ -238,7 +239,17 @@ def build_fleet(
     the band sizing table (tests use tiny bands).
     ``delivery="banded"`` attaches each band's :data:`DELIVERY_BURST`
     producer rate to its sessions (consumed by the scheduler's bounded
-    admission queue); the default delivers each stream whole."""
+    admission queue); the default delivers each stream whole.
+
+    ``horizon`` is the **longhaul** multiplier (``serve/longhaul``
+    family): synthetic sessions carry ``horizon``-times the band's op
+    count — the days-of-edits-scale stream a long-lived document
+    accumulates, generated as one valid edit history (synth streams are
+    position-consistent at any length, so the oracle stays exact).
+    Real-trace windows are bounded by their trace, so they keep the
+    band's sizing and supply the capacity-class spread; the synthetic
+    streams supply the history depth that stresses WAL growth, delta
+    chains, and the recovery-time objective."""
     weights = MIXES[mix] if isinstance(mix, str) else dict(mix)
     table = BANDS if bands is None else bands
     names = sorted(weights)
@@ -259,7 +270,7 @@ def build_fleet(
         source, sizing = table[band]
         if source == "synth":
             lo, hi = sizing
-            n_ops = int(rng.integers(lo, hi + 1))
+            n_ops = int(rng.integers(lo, hi + 1)) * max(1, int(horizon))
             trace = synth_trace(seed=int(rng.integers(1 << 31)), n_ops=n_ops)
             src = "synth"
         else:

@@ -1,0 +1,700 @@
+"""Durability v2: WAL segmentation + GC, delta snapshot chains, the
+measured recovery-time objective, and the crash-during-compaction /
+delta-corruption chaos kinds.
+
+The invariants under test:
+
+- the on-disk WAL stays O(ops since the last committed snapshot): a
+  segment fully covered by a barrier is deleted, crash-safely;
+- a delta barrier persists exactly the rows dirty since the previous
+  barrier, CRC-chained to its base; recovery composes root -> deltas
+  and falls back DOWN the chain on any broken link — always ending
+  byte-identical to an uninterrupted run and to the oracle;
+- ``read_journal`` drops torn tails, empty trailing segments, and
+  GC'd-round resurrections cleanly, never propagating them.
+"""
+
+import json
+import os
+import shutil
+import zlib
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.faults import FaultEvent, FaultInjector, FaultPlan
+from crdt_benches_tpu.serve.journal import (
+    GC_MANIFEST,
+    OpJournal,
+    chain_members,
+    finish_torn_gc,
+    list_snapshots,
+    probe_recovery,
+    read_journal,
+    recover_fleet,
+    sweep_staging,
+    wal_segments,
+)
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, prepare_streams
+from crdt_benches_tpu.serve.workload import build_fleet
+
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+def _fleet(tmp_path, sub, n=10, seed=7, **kw):
+    sessions = build_fleet(
+        n, mix=TINY_MIX, seed=seed, arrival_span=3, bands=TINY_BANDS
+    )
+    pool = DocPool(classes=(256, 1024), slots=(6, 3),
+                   spool_dir=str(tmp_path / f"spool_{sub}"))
+    streams = prepare_streams(sessions, pool, batch=16, batch_chars=64)
+    sched = FleetScheduler(pool, streams, batch=16, macro_k=4,
+                           batch_chars=64, **kw)
+    return sessions, pool, streams, sched
+
+
+def _oracle(sessions):
+    return {s.doc_id: replay_trace(s.trace) for s in sessions}
+
+
+# ---------------------------------------------------------------------------
+# WAL segmentation + read_journal edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_wal_rolls_into_segments_and_reads_in_order(tmp_path):
+    jd = str(tmp_path / "j")
+    j = OpJournal(jd, segment_bytes=256)
+    for r in range(20):
+        j.round_record(r, {256: [[1, r * 4, r * 4 + 4]]})
+        j.maybe_roll()  # the barrier-time roll point
+    j.close()
+    segs = wal_segments(jd)
+    assert len(segs) >= 2  # tiny threshold: the active file rolled
+    assert segs == sorted(segs)
+    recs, dropped = read_journal(jd)
+    assert dropped == 0
+    assert [rec["r"] for rec in recs] == list(range(20))
+    # a roll below the threshold is a no-op
+    j3 = OpJournal(jd, segment_bytes=1 << 20)
+    assert j3.maybe_roll() is False
+    j3.close()
+    # reopening continues the sequence instead of reusing a seal
+    j2 = OpJournal(jd, segment_bytes=256)
+    for r in range(20, 28):
+        j2.round_record(r, {256: [[1, r, r + 1]]})
+        j2.maybe_roll()
+    j2.close()
+    recs2, _ = read_journal(jd)
+    assert [rec["r"] for rec in recs2] == list(range(28))
+
+
+def test_torn_tail_at_segment_boundary_drops_cleanly(tmp_path):
+    """A partial CRC line right at a segment boundary (the active file
+    torn just after a roll) drops cleanly — the sealed prefix
+    survives, nothing after the tear is trusted."""
+    jd = str(tmp_path / "j")
+    j = OpJournal(jd, segment_bytes=200)
+    for r in range(10):
+        j.round_record(r, {256: [[1, r, r + 1]]})
+        j.maybe_roll()
+    j.close()
+    assert wal_segments(jd)
+    # tear the ACTIVE file's first line (boundary position: byte 0 of
+    # the post-roll file)
+    with open(os.path.join(jd, "journal.log"), "r+", encoding="utf-8") as f:
+        lines = f.readlines()
+    n_active = len(lines)
+    with open(os.path.join(jd, "journal.log"), "w", encoding="utf-8") as f:
+        f.write('deadbeef {"t":"round"')  # no newline, bad crc
+    recs, dropped = read_journal(jd)
+    assert dropped == 1
+    assert all("r" in r for r in recs)
+    # reopening truncates the torn tail so appends stay visible
+    j2 = OpJournal(jd, segment_bytes=200)
+    j2.round_record(99, {256: [[1, 0, 1]]})
+    j2.close()
+    recs2, dropped2 = read_journal(jd)
+    assert dropped2 == 0 and recs2[-1]["r"] == 99
+    assert n_active >= 1  # the tear really replaced live records
+
+
+def test_empty_trailing_segment_and_fsync_off_crash(tmp_path):
+    """An empty active file after a roll reads as zero records; an
+    fsync-off crash (arbitrary byte truncation mid-record) drops only
+    the damaged suffix."""
+    jd = str(tmp_path / "j")
+    j = OpJournal(jd, segment_bytes=120)
+    for r in range(8):
+        j.round_record(r, {256: [[2, r, r + 1]]})
+        j.maybe_roll()
+    j.close()
+    n_active = len(open(os.path.join(jd, "journal.log")).readlines())
+    # empty trailing active file (crash right after a roll)
+    open(os.path.join(jd, "journal.log"), "w").close()
+    recs, dropped = read_journal(jd)
+    assert dropped == 0 and len(recs) == 8 - n_active
+    assert [r["r"] for r in recs] == list(range(8 - n_active))
+    # fsync-off crash: the LAST file with data loses an arbitrary
+    # suffix mid-record
+    last_seg = os.path.join(jd, wal_segments(jd)[-1])
+    size = os.path.getsize(last_seg)
+    with open(last_seg, "r+b") as f:
+        f.truncate(size - 7)
+    recs2, dropped2 = read_journal(jd)
+    assert dropped2 >= 1
+    assert len(recs2) < 8 - n_active
+    for rec in recs2:  # every surviving record is fully intact
+        assert rec["t"] == "round" and "lanes" in rec
+
+
+def test_gc_deletes_covered_segments_and_survives_crash(tmp_path):
+    """compact() deletes sealed segments whose records are all below
+    the covering barrier round — two-phase: a pass killed between the
+    GC-manifest write and the unlinks is completed on the next open."""
+    jd = str(tmp_path / "j")
+    j = OpJournal(jd, segment_bytes=150)
+    for r in range(12):
+        j.round_record(r, {256: [[1, r, r + 1]]})
+        j.maybe_roll()
+    n_before = len(wal_segments(jd))
+    assert n_before >= 2
+    # crash mid-GC: manifest written, unlink skipped
+    info = j.compact(12, crash_hook=lambda: True)
+    assert info["crashed"] and os.path.exists(os.path.join(jd, GC_MANIFEST))
+    assert len(wal_segments(jd)) == n_before  # nothing unlinked yet
+    j.close()
+    # reopening completes the torn pass
+    j2 = OpJournal(jd, segment_bytes=150)
+    assert j2.torn_gc_completed >= 1
+    assert not os.path.exists(os.path.join(jd, GC_MANIFEST))
+    assert len(wal_segments(jd)) < n_before
+    # a second pass with nothing covered is a no-op
+    info2 = j2.compact(0)
+    assert info2["deleted"] == 0 and not info2["crashed"]
+    j2.close()
+    # recovery-side completion works standalone too
+    assert finish_torn_gc(jd) == 0
+
+
+def test_resurrected_gcd_segment_is_ignored_by_recovery(tmp_path):
+    """A CRC-valid record from a GC'd round (a segment that escaped
+    deletion — torn GC, backup restore) must not double-apply: the
+    recovery redo rule skips records below the snapshot round."""
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", journal=OpJournal(str(tmp_path / "j"),
+                                         segment_bytes=200),
+        snapshot_every=2, snapshot_full_every=2,
+    )
+    jd = str(tmp_path / "j")
+    sched.run(max_rounds=4)
+    # copy a sealed segment aside, run to completion (GC eats it), put
+    # it back — the resurrection
+    segs = wal_segments(jd)
+    saved = None
+    if segs:
+        saved = os.path.join(str(tmp_path), "resurrect.log")
+        shutil.copy2(os.path.join(jd, segs[0]), saved)
+    sched.run()
+    assert sched.done
+    want = _oracle(sessions)
+    if saved is not None:
+        shutil.copy2(saved, os.path.join(jd, segs[0]))
+    pool_b = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "spool_b"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16,
+                                batch_chars=64)
+    rep = recover_fleet(pool_b, streams_b, jd)
+    assert rep.snapshot_round >= 0
+    FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                   batch_chars=64,
+                   start_round=rep.resume_round).run()
+    for s in sessions:
+        assert pool_b.decode(s.doc_id) == want[s.doc_id]
+
+
+# ---------------------------------------------------------------------------
+# delta snapshot chains
+# ---------------------------------------------------------------------------
+
+
+def test_delta_captures_only_dirty_rows(tmp_path):
+    """A delta barrier persists exactly the rows touched since the
+    previous barrier — and is byte-smaller than the full it chains to
+    on a mostly-idle fleet."""
+    jd = str(tmp_path / "j")
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", journal=OpJournal(jd), snapshot_every=1,
+        snapshot_full_every=4,
+    )
+    sched.run(max_rounds=3)
+    snaps = list_snapshots(jd)
+    manifests = {
+        s: json.load(open(os.path.join(jd, s, "MANIFEST.json")))
+        for s in snaps
+    }
+    kinds = [manifests[s]["kind"] for s in snaps]
+    assert kinds[0] == "full" and "delta" in kinds[1:]
+    for s in snaps:
+        m = manifests[s]
+        if m["kind"] != "delta":
+            continue
+        # chain link verified: base present, CRC matches
+        assert chain_members(jd, s)[0] == m["chain"]
+        # delta rows are a subset of the class's rows, with shapes
+        for cls, rows in m["delta_rows"].items():
+            R, C = m["class_shapes"][cls]
+            assert all(0 <= r < R for r in rows)
+            assert C == int(cls)  # C is the class capacity
+        # member bytes strictly below the chain root's
+        root = m["chain"]
+        d_bytes = sum(
+            os.path.getsize(os.path.join(jd, s, f))
+            for f in os.listdir(os.path.join(jd, s))
+            if f.endswith(".npz") and f.startswith("delta_")
+        )
+        r_bytes = sum(
+            os.path.getsize(os.path.join(jd, root, f))
+            for f in os.listdir(os.path.join(jd, root))
+            if f.endswith(".npz") and f.startswith("class_")
+        )
+        if d_bytes and r_bytes:
+            assert d_bytes < r_bytes
+
+
+def test_chain_recovery_parity_with_deltas(tmp_path):
+    """THE durability v2 recovery gate: kill a fleet mid-drain under
+    delta barriers + tiny WAL segments + GC, recover into a FRESH pool
+    by composing the chain, resume — byte-identical to an uninterrupted
+    run and to the oracle."""
+    sessions = build_fleet(
+        10, mix=TINY_MIX, seed=3, arrival_span=3, bands=TINY_BANDS
+    )
+    pool_a = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sa"))
+    streams_a = prepare_streams(sessions, pool_a, batch=16, batch_chars=64)
+    FleetScheduler(pool_a, streams_a, batch=16, macro_k=4,
+                   batch_chars=64).run()
+    want = {s.doc_id: pool_a.decode(s.doc_id) for s in sessions}
+
+    jd = str(tmp_path / "j")
+    pool_b = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sb"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16, batch_chars=64)
+    sb = FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                        batch_chars=64,
+                        journal=OpJournal(jd, segment_bytes=300),
+                        snapshot_every=1, snapshot_full_every=3)
+    sb.run(max_rounds=5)
+    assert not sb.done
+    assert sb.stats.snapshots_delta >= 1  # deltas actually exercised
+    del pool_b, streams_b, sb
+
+    pool_c = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sc"))
+    streams_c = prepare_streams(sessions, pool_c, batch=16, batch_chars=64)
+    rep = recover_fleet(pool_c, streams_c, jd)
+    assert rep.snapshot_round >= 0
+    assert rep.chain_depth >= 1
+    FleetScheduler(pool_c, streams_c, batch=16, macro_k=4,
+                   batch_chars=64,
+                   start_round=rep.resume_round).run()
+    for s in sessions:
+        assert pool_c.decode(s.doc_id) == want[s.doc_id]
+        assert want[s.doc_id] == replay_trace(s.trace)
+
+
+def test_chain_fallback_on_corrupt_delta_and_root(tmp_path):
+    """Damage at each chain level falls back exactly one level: a
+    corrupt delta member -> the link below it; a corrupt full root ->
+    an older chain or cold start.  Parity holds either way."""
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a",
+        journal=OpJournal(str(tmp_path / "j"), segment_bytes=400),
+        snapshot_every=1, snapshot_full_every=4, snapshot_keep=2,
+    )
+    jd = str(tmp_path / "j")
+    # 4 barriers under full_every=4: full, delta, delta, delta — the
+    # chain TIP is a delta, so corrupting the newest delta forces the
+    # candidate walk to fall back at least one link
+    sched.run(max_rounds=4)
+    want = _oracle(sessions)
+    snaps = list_snapshots(jd)
+    manifests = {
+        s: json.load(open(os.path.join(jd, s, "MANIFEST.json")))
+        for s in snaps
+    }
+    deltas = [s for s in snaps if manifests[s]["kind"] == "delta"]
+    assert deltas
+    victim = deltas[-1]
+    members = [
+        f for f in os.listdir(os.path.join(jd, victim))
+        if f.startswith("delta_")
+    ]
+    target = os.path.join(
+        jd, victim, members[0] if members else "MANIFEST.json"
+    )
+    with open(target, "r+b") as f:
+        f.seek(max(0, os.path.getsize(target) // 2))
+        f.write(b"\xff" * 12)
+
+    pool_c = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sc"))
+    streams_c = prepare_streams(sessions, pool_c, batch=16,
+                                batch_chars=64)
+    rep = recover_fleet(pool_c, streams_c, jd)
+    assert rep.chain_fallbacks >= 1  # fell back DOWN the chain
+    FleetScheduler(pool_c, streams_c, batch=16, macro_k=4,
+                   batch_chars=64, start_round=rep.resume_round).run()
+    for s in sessions:
+        assert pool_c.decode(s.doc_id) == want[s.doc_id]
+
+    # now kill every chain root: recovery degrades to cold start and
+    # STILL converges (streams are deterministic)
+    for s in list_snapshots(jd):
+        m = json.load(open(os.path.join(jd, s, "MANIFEST.json")))
+        if m["kind"] == "full":
+            for f in os.listdir(os.path.join(jd, s)):
+                if f.startswith("class_"):
+                    p = os.path.join(jd, s, f)
+                    with open(p, "r+b") as fh:
+                        fh.seek(os.path.getsize(p) // 2)
+                        fh.write(b"\xff" * 12)
+    pool_d = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sd"))
+    streams_d = prepare_streams(sessions, pool_d, batch=16,
+                                batch_chars=64)
+    rep_d = recover_fleet(pool_d, streams_d, jd)
+    assert rep_d.chain_fallbacks >= 1
+    FleetScheduler(pool_d, streams_d, batch=16, macro_k=4,
+                   batch_chars=64, start_round=rep_d.resume_round).run()
+    for s in sessions:
+        assert pool_d.decode(s.doc_id) == want[s.doc_id]
+
+
+def test_staging_dir_with_valid_manifest_is_never_a_candidate(tmp_path):
+    """The crash-window satellite: a staging directory abandoned before
+    the atomic rename — even one containing a fully valid-looking
+    manifest — is never listed, never recovered from, and is cleaned
+    up by the sweep."""
+    jd = str(tmp_path / "j")
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", journal=OpJournal(jd), snapshot_every=2,
+    )
+    sched.run()
+    want = _oracle(sessions)
+    snaps = list_snapshots(jd)
+    assert snaps
+    # plant an abandoned staging dir NEWER than every committed
+    # snapshot, with a valid-looking manifest copied from a real one
+    fake = os.path.join(jd, "snap_99999990.tmp")
+    shutil.copytree(os.path.join(jd, snaps[-1]), fake)
+    m = json.load(open(os.path.join(fake, "MANIFEST.json")))
+    m["round"] = 99999990  # poison: using it would skip every redo op
+    json.dump(m, open(os.path.join(fake, "MANIFEST.json"), "w"))
+    assert "snap_99999990.tmp" not in list_snapshots(jd)
+
+    pool_b = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sb"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16,
+                                batch_chars=64)
+    rep = recover_fleet(pool_b, streams_b, jd)
+    assert rep.snapshot_round < 99999990
+    assert rep.staging_removed >= 1
+    assert not os.path.exists(fake)  # swept, not just skipped
+    FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                   batch_chars=64, start_round=rep.resume_round).run()
+    for s in sessions:
+        assert pool_b.decode(s.doc_id) == want[s.doc_id]
+    # the standalone sweep is idempotent
+    assert sweep_staging(jd) == []
+
+
+def test_dirty_tracking_marks_exactly_touched_rows(tmp_path):
+    """Unit-level dirty contract: installs and op-carrying dispatch
+    rows mark; PAD-only lanes don't; take_dirty consumes."""
+    pool = DocPool(classes=(256,), slots=(4,),
+                   spool_dir=str(tmp_path / "s"))
+    sessions = build_fleet(2, mix={"synth-small": 1.0}, seed=1,
+                           arrival_span=1,
+                           bands={"synth-small": ("synth", (10, 20))})
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    for s in sessions:
+        pool.admit(s.doc_id, 16)
+    assert pool.dirty_rows(256) == {0, 1}  # installs mark
+    assert pool.take_dirty() == {256: [0, 1]}
+    assert pool.take_dirty() == {}  # consumed
+    # an all-PAD macro dispatch marks nothing
+    from crdt_benches_tpu.traces.tensorize import PAD
+
+    K, Rt, B = 2, 4, 8
+    dts = pool.op_dtypes
+    kind = np.full((K, Rt, B), PAD, dts[0])
+    pos = np.zeros((K, Rt, B), dts[1])
+    rlen = np.zeros((K, Rt, B), dts[2])
+    slot0 = np.zeros((K, Rt, B), dts[3])
+    pool.macro_step(256, kind, pos, rlen, slot0, nbits=6)
+    assert pool.take_dirty() == {}
+    # ops in one row mark exactly that row
+    st = streams[sessions[0].doc_id]
+    take = min(4, st.n_total)
+    kind[0, 1, :take] = st.kind[:take]
+    pos[0, 1, :take] = st.pos[:take]
+    rlen[0, 1, :take] = st.rlen[:take]
+    slot0[0, 1, :take] = st.slot0[:take]
+    pool.macro_step(256, kind, pos, rlen, slot0, nbits=6)
+    assert pool.take_dirty() == {256: [1]}
+
+
+# ---------------------------------------------------------------------------
+# chaos: crash_compact + delta_corrupt
+# ---------------------------------------------------------------------------
+
+
+def test_crash_compact_fires_and_recovers(tmp_path):
+    """The GC pass is killed between its manifest write and the
+    unlinks; the torn pass must complete (next barrier or finalize)
+    and the drain stays oracle-green."""
+    jd = str(tmp_path / "j")
+    plan = FaultPlan([FaultEvent(kind="crash_compact", round=2)], seed=3)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", faults=FaultInjector(plan),
+        journal=OpJournal(jd, segment_bytes=200),
+        snapshot_every=1, snapshot_full_every=2,
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired and ev.recovered, ev.to_dict()
+    assert not os.path.exists(os.path.join(jd, GC_MANIFEST))
+    for s in sessions:
+        assert pool.decode(s.doc_id) == replay_trace(s.trace)
+
+
+def test_delta_corrupt_fires_and_recovery_falls_back(tmp_path):
+    """A mid-chain delta member is bit-flipped; the finalizer's
+    recovery probe must materialize a usable snapshot (chain fallback
+    or re-root) and a real recovery must byte-verify green."""
+    jd = str(tmp_path / "j")
+    plan = FaultPlan([FaultEvent(kind="delta_corrupt", round=3)], seed=5)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", faults=FaultInjector(plan),
+        journal=OpJournal(jd, segment_bytes=400),
+        snapshot_every=1, snapshot_full_every=4,
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired, ev.to_dict()
+    assert ev.detail.get("member"), ev.detail
+    assert ev.recovered, ev.to_dict()
+    used, _fallbacks = probe_recovery(jd)
+    assert used is not None
+    # the real thing: recover a fresh fleet over the damaged chain
+    want = _oracle(sessions)
+    pool_b = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sb"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16,
+                                batch_chars=64)
+    rep = recover_fleet(pool_b, streams_b, jd)
+    FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                   batch_chars=64, start_round=rep.resume_round).run()
+    for s in sessions:
+        assert pool_b.decode(s.doc_id) == want[s.doc_id]
+
+
+def test_journal_kinds_rejected_without_preconditions(tmp_path):
+    """Durability chaos kinds whose injection points are unreachable
+    must be rejected up front — a loud config error, never a
+    drain-end not_fired."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    common = dict(mix=TINY_MIX, n_docs=4, bands=TINY_BANDS,
+                  results_dir=str(tmp_path), log=lambda *_: None)
+    with pytest.raises(ValueError, match="serve-journal"):
+        run_serve_bench(faults="crash_compact=1", **common)
+    with pytest.raises(ValueError, match="snapshot-every"):
+        run_serve_bench(faults="crash_compact=1",
+                        journal_dir=str(tmp_path / "j1"),
+                        snapshot_every=0, **common)
+    with pytest.raises(ValueError, match="full-every"):
+        run_serve_bench(faults="delta_corrupt=1",
+                        journal_dir=str(tmp_path / "j2"),
+                        snapshot_every=2, snapshot_full_every=1,
+                        **common)
+    with pytest.raises(ValueError, match="recovery leg"):
+        run_serve_bench(longhaul=2, **common)
+
+
+def test_parseable_garbage_manifest_falls_back(tmp_path):
+    """A bit-flip that leaves the tip manifest PARSEABLE but garbled
+    (a resident row index past the bucket) must still degrade to the
+    next candidate — recovery never crashes on designed-recoverable
+    corruption."""
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", journal=OpJournal(str(tmp_path / "j")),
+        snapshot_every=1, snapshot_full_every=2,
+    )
+    jd = str(tmp_path / "j")
+    sched.run(max_rounds=4)
+    want = _oracle(sessions)
+    snaps = list_snapshots(jd)
+    mpath = os.path.join(jd, snaps[-1], "MANIFEST.json")
+    m = json.load(open(mpath))
+    for key in list(m["resident"]):
+        m["resident"][key][1] = 9999  # valid JSON, impossible row
+    json.dump(m, open(mpath, "w"), separators=(",", ":"))
+    pool_b = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sb"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16,
+                                batch_chars=64)
+    rep = recover_fleet(pool_b, streams_b, jd)
+    assert rep.chain_fallbacks >= 1
+    FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                   batch_chars=64, start_round=rep.resume_round).run()
+    for s in sessions:
+        assert pool_b.decode(s.doc_id) == want[s.doc_id]
+
+
+def test_gc_floor_preserves_decisions_for_fallback(tmp_path):
+    """A journaled shed decision must survive WAL GC for as long as
+    ANY retained snapshot predates it: chain fallback landing below
+    the decision's round re-applies it from the WAL — deleting the
+    segment on the newest barrier's say-so would silently un-shed the
+    doc on fallback (the GC-floor regression)."""
+    jd = str(tmp_path / "j")
+    plan = FaultPlan([FaultEvent(kind="queue_overflow", round=8)],
+                     seed=1)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", faults=FaultInjector(plan),
+        journal=OpJournal(jd, segment_bytes=200),
+        snapshot_every=1, snapshot_full_every=2, snapshot_keep=0,
+        queue_cap=8, overflow_policy="shed",
+    )
+    sched.run()
+    assert sched.done
+    (ev,) = plan.events
+    assert ev.fired and ev.detail.get("shed", 0) > 0
+    shed_round = ev.fired_round
+    want = {s.doc_id: pool.decode(s.doc_id) for s in sessions}
+    lossy_docs = sorted(d for d, st in streams.items() if st.lossy)
+    assert lossy_docs
+    # corrupt every snapshot committed AFTER the decision: recovery
+    # must land below it and recover the decision from the WAL alone
+    for snap in list_snapshots(jd):
+        if int(snap[len("snap_"):]) > shed_round:
+            mp = os.path.join(jd, snap, "MANIFEST.json")
+            with open(mp, "r+b") as f:
+                f.seek(max(0, os.path.getsize(mp) // 2))
+                f.write(b"\xff" * 8)
+    pool_b = DocPool(classes=(256, 1024), slots=(6, 3),
+                     spool_dir=str(tmp_path / "sb"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16,
+                                batch_chars=64)
+    rep = recover_fleet(pool_b, streams_b, jd)
+    assert rep.snapshot_round <= shed_round
+    assert rep.shed_ops > 0  # the decision came back from the WAL
+    assert sorted(
+        d for d, st in streams_b.items() if st.lossy
+    ) == lossy_docs
+    FleetScheduler(pool_b, streams_b, batch=16, macro_k=4,
+                   batch_chars=64, queue_cap=8,
+                   overflow_policy="shed",
+                   start_round=rep.resume_round).run()
+    for s in sessions:  # INCLUDING lossy docs: the truncation must
+        # reproduce byte-exactly, not just the clean docs
+        assert pool_b.decode(s.doc_id) == want[s.doc_id], s.doc_id
+
+
+def test_snapshot_keep_zero_never_prunes(tmp_path):
+    """keep <= 0 is the historical keep-all contract: every barrier's
+    snapshot survives."""
+    jd = str(tmp_path / "j")
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", journal=OpJournal(jd), snapshot_every=1,
+        snapshot_keep=0, snapshot_full_every=2,
+    )
+    sched.run(max_rounds=5)
+    assert sched.stats.snapshots >= 4
+    assert len(list_snapshots(jd)) == sched.stats.snapshots
+
+
+# ---------------------------------------------------------------------------
+# the serve/longhaul family + measured RTO (bench level)
+# ---------------------------------------------------------------------------
+
+
+def test_longhaul_bench_crash_recovery_and_artifact(tmp_path):
+    """End to end at smoke scale: a longhaul drain with an injected
+    crash, durability chaos, tiny WAL segments and delta barriers —
+    the recovery leg restores, resumes, byte-verifies, and the
+    artifact carries the recovery / durability blocks bench_compare
+    gates on."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=8, bands=TINY_BANDS, seed=5,
+        batch=16, batch_chars=64, macro_k=4,
+        classes=(256, 1024), slots=(6, 3),
+        arrival_span=2, verify_sample=6,
+        journal_dir=str(tmp_path / "j"),
+        snapshot_every=2, snapshot_full_every=2,
+        wal_segment_bytes=128,
+        longhaul=4, crash_after=5,
+        faults="crash_compact@2=1,delta_corrupt@2=1",
+        results_dir=str(tmp_path / "res"),
+        save_name="longhaul_test",
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"], "recovered fleet failed the oracle gate"
+    assert info["faults_ok"], r.extra["faults"]
+    assert r.bench_id.startswith("serve/longhaul/"), r.bench_id
+    rec = r.extra["recovery"]
+    assert rec is not None and rec["verify_ok"]
+    assert rec["recover_ms"] > 0 and rec["redo_ops"] > 0
+    assert rec["chain_depth"] >= 1
+    j = r.extra["journal"]
+    assert j["segments_sealed"] >= 1
+    assert j["disk_bytes"] > 0
+    assert j["snapshots_delta"] >= 1
+    # durability gauges landed in the run's registry
+    gauges = r.extra["metrics"]["gauges"]
+    assert "serve.journal.wal_segments" in gauges
+    assert "serve.journal.bytes_since_snapshot" in gauges
+    assert "serve.durability.chain_depth" in gauges
+    assert "serve.durability.last_compaction_round" in gauges
+
+
+def test_durability_status_fields_and_flight_events(tmp_path):
+    """The /status.json durability block and the flight recorder's
+    snapshot/compaction event ring."""
+    from crdt_benches_tpu.obs.flight import FlightRecorder, validate_flight
+    from crdt_benches_tpu.obs.timeseries import ServeTelemetry
+
+    flight = FlightRecorder(str(tmp_path / "flight.json"), ring=16)
+    telemetry = ServeTelemetry(flight=flight)
+    jd = str(tmp_path / "j")
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, "a", journal=OpJournal(jd, segment_bytes=300),
+        snapshot_every=1, snapshot_full_every=2, telemetry=telemetry,
+    )
+    sched.run()
+    st = sched.status_fields()
+    d = st["durability"]
+    assert d["wal_segments"] >= 1
+    assert d["snapshots_full"] >= 1
+    assert "bytes_since_snapshot" in d and "chain_depth" in d
+    assert "last_compaction_round" in d
+    kinds = {e["kind"] for e in flight.events}
+    assert "snapshot" in kinds
+    assert flight.events_seen >= sched.stats.snapshots
+    # events ride the dump and the validator accepts them
+    flight.trigger("test", status=st)
+    dump = json.load(open(str(tmp_path / "flight.json")))
+    assert dump["events"] and validate_flight(dump) == []

@@ -97,21 +97,45 @@ def test_journal_records_crc_framed_and_torn_tail(tmp_path):
 
 def test_snapshot_commit_is_atomic(tmp_path):
     """A staging directory without the final rename is invisible to
-    recovery; committed snapshots are pruned to the keep count."""
+    recovery; committed snapshots are pruned by CHAIN to the keep
+    count — a retained delta's base links always survive with it."""
     sessions = _sessions()
     pool, streams = _fresh(sessions, tmp_path, "p")
     jd = str(tmp_path / "j")
     sched = FleetScheduler(pool, streams, batch=16, macro_k=4,
                            batch_chars=64, journal=OpJournal(jd),
-                           snapshot_every=1, snapshot_keep=2)
-    sched.run(max_rounds=4)
+                           snapshot_every=1, snapshot_keep=2,
+                           snapshot_full_every=2)
+    sched.run(max_rounds=6)
     snaps = list_snapshots(jd)
-    assert 1 <= len(snaps) <= 2  # pruned to keep=2
+    manifests = {
+        s: json.load(open(os.path.join(jd, s, "MANIFEST.json")))
+        for s in snaps
+    }
+    # pruned to keep=2 CHAINS (full_every=2 -> chains of <= 2 members)
+    fulls = [s for s in snaps if manifests[s]["kind"] == "full"]
+    assert 1 <= len(fulls) <= 2
+    assert 1 <= len(snaps) <= 4
+    # every retained delta's base link is retained with it and the
+    # recorded CRC matches the base manifest on disk
+    import zlib as _zlib
+    for s in snaps:
+        m = manifests[s]
+        if m["kind"] != "delta":
+            continue
+        assert m["base"] in snaps, (s, m["base"], snaps)
+        raw = open(
+            os.path.join(jd, m["base"], "MANIFEST.json"), "rb"
+        ).read()
+        assert m["base_crc"] == f"{_zlib.crc32(raw):08x}"
+        assert m["chain"] in snaps and manifests[m["chain"]]["kind"] \
+            == "full"
     # a torn (uncommitted) staging dir must be ignored
     os.makedirs(os.path.join(jd, "snap_99999999.tmp"))
     assert "snap_99999999.tmp" not in list_snapshots(jd)
-    m = json.load(open(os.path.join(jd, snaps[-1], "MANIFEST.json")))
-    assert set(m) >= {"round", "classes", "resident", "spooled", "docs"}
+    m = manifests[snaps[-1]]
+    assert set(m) >= {"round", "kind", "classes", "resident", "spooled",
+                      "docs"}
     assert len(m["docs"]) == len(sessions)
 
 
@@ -190,7 +214,7 @@ def test_recovery_falls_back_on_damaged_snapshot(tmp_path):
     newest = os.path.join(jd, snaps[-1])
     victim = next(
         os.path.join(newest, f) for f in sorted(os.listdir(newest))
-        if f.startswith("class_")
+        if f.startswith(("class_", "delta_")) or f == "MANIFEST.json"
     )
     with open(victim, "r+b") as f:
         f.seek(os.path.getsize(victim) // 2)
@@ -199,6 +223,7 @@ def test_recovery_falls_back_on_damaged_snapshot(tmp_path):
     pool_c, streams_c = _fresh(sessions, tmp_path, "c")
     rep = recover_fleet(pool_c, streams_c, jd)
     assert rep.snapshot_round < int(snaps[-1].split("_")[1])
+    assert rep.chain_fallbacks >= 1  # the damaged candidate was skipped
     sc = FleetScheduler(pool_c, streams_c, batch=16, macro_k=4,
                         batch_chars=64, start_round=rep.resume_round)
     sc.run()

@@ -125,8 +125,33 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: plain run against a replicated one) must also diff cleanly;
 #: ``reqtrace`` / ``slo`` / ``flight`` are the obs/ v3 request-tracing
 #: blocks.
+#: ``recovery`` is the durability v2 measured-RTO block (runs with the
+#: recovery leg armed).
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
-                    "convergence", "reqtrace", "slo", "flight")
+                    "convergence", "reqtrace", "slo", "flight",
+                    "recovery")
+
+
+def _recover_ms(extra: dict) -> float | None:
+    """The measured recovery-time objective: ``recover_fleet`` wall
+    time in ms from the ``recovery`` block (durability v2).  None when
+    the artifact predates the block or the leg did not run."""
+    rec = extra.get("recovery")
+    return rec.get("recover_ms") if isinstance(rec, dict) else None
+
+
+def _journal_disk_bytes(extra: dict) -> float | None:
+    """On-disk journal footprint at drain end — the bounded-footprint
+    number (O(ops since last committed snapshot) under segment GC, not
+    O(history)).  Prefers the recovery block's measurement (taken at
+    the recovery point), falls back to the journal block's."""
+    rec = extra.get("recovery")
+    if isinstance(rec, dict) and rec.get("journal_disk_bytes"):
+        return rec["journal_disk_bytes"]
+    j = extra.get("journal")
+    if isinstance(j, dict) and j.get("disk_bytes"):
+        return j["disk_bytes"]
+    return None
 
 
 def _drain_p999(extra: dict) -> float | None:
@@ -238,7 +263,9 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_syncs_regress: float,
             max_window_floor_regress: float = 30.0,
             max_drain_p999_regress: float = 75.0,
-            max_slo_regress: float = 5.0) -> list[Check]:
+            max_slo_regress: float = 5.0,
+            max_recover_regress: float = 75.0,
+            max_journal_disk_regress: float = 40.0) -> list[Check]:
     checks = [
         _regress(
             "throughput (patches/s)",
@@ -285,6 +312,23 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
                       "one artifact",
         ),
         _slo_check(new, base, max_slo_regress),
+        # durability v2 gates, one-sided like timeseries: the measured
+        # recovery-time objective and the on-disk journal footprint at
+        # fixed workload — history growth or a slower chain walk fails
+        # here before anyone notices a multi-minute recovery in prod
+        _regress(
+            "recovery time (ms, recover_fleet)",
+            _recover_ms(new), _recover_ms(base),
+            max_recover_regress, higher_is_better=False,
+            skip_note="recovery block missing in at least one artifact",
+        ),
+        _regress(
+            "journal on-disk bytes (segmented WAL after GC)",
+            _journal_disk_bytes(new), _journal_disk_bytes(base),
+            max_journal_disk_regress, higher_is_better=False,
+            skip_note="journal disk footprint missing in at least one "
+                      "artifact",
+        ),
     ]
     checks.extend(_block_presence_checks(new, base))
     return checks
@@ -330,6 +374,17 @@ def main(argv: list[str] | None = None) -> int:
                          "of requests; a >10x violation blow-up past "
                          "the noise floor fails regardless (checked "
                          "only when both artifacts carry an slo block)")
+    ap.add_argument("--max-recover-regress", type=float, default=75.0,
+                    metavar="PCT",
+                    help="max tolerated recover_fleet wall-time "
+                         "increase (recovery block; ms-scale host "
+                         "work jitters, the default is loose)")
+    ap.add_argument("--max-journal-disk-regress", type=float,
+                    default=40.0, metavar="PCT",
+                    help="max tolerated growth of the on-disk journal "
+                         "footprint at fixed workload (segment GC + "
+                         "snapshot pruning keep it O(ops since last "
+                         "barrier); unbounded history fails here)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -350,6 +405,8 @@ def main(argv: list[str] | None = None) -> int:
         max_window_floor_regress=args.max_window_floor_regress,
         max_drain_p999_regress=args.max_drain_p999_regress,
         max_slo_regress=args.max_slo_regress,
+        max_recover_regress=args.max_recover_regress,
+        max_journal_disk_regress=args.max_journal_disk_regress,
     )
     failed = [c for c in checks if c.status == "fail"]
     if args.json:

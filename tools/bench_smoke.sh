@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak]   (repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul]   (repo root)
 #
 # The serve family (the default) drains a tiny document fleet through the
 # macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
@@ -22,6 +22,17 @@
 # status server + time-series stream armed, scrapes /healthz +
 # /status.json + /metrics mid-run, and fails on any scrape error or any
 # anomaly at all.
+#
+# The serve-longhaul family is the DURABILITY smoke (durability v2): a
+# short longhaul drain (journal + delta snapshot chains + segmented WAL
+# with GC) ending in a measured recovery leg, gated against the
+# committed bench_results/serve_longhaul_baseline.json on recover_ms
+# and on-disk journal bytes — then a second leg under
+# CRDT_BENCH_SANITIZE_RACES=1 with an INJECTED CRASH plus the
+# crash-during-compaction and delta-chain-corruption chaos kinds:
+# recover_fleet must restore from the surviving chain, resume the redo
+# tail, and byte-verify against the oracle (the runner's exit code
+# carries the gate).
 #
 # Artifacts land in bench_results/ under smoke-specific names so they
 # never clobber committed headline numbers.
@@ -438,8 +449,88 @@ assert ts["windows"], "soak produced no time-series windows"
 print(f"soak: {ts['drains']} drain(s), {len(ts['windows'])} windows, 0 anomalies")
 PYEOF
     ;;
+  serve-longhaul)
+    # Clean longhaul leg: days-of-edits-scale synth streams (x4
+    # horizon), WAL segments rolled at 4 KiB with GC at every barrier,
+    # delta barriers every 2 rounds (chain re-rooted every 3rd), and
+    # the measured recovery leg at drain end.  The runner exits
+    # nonzero on a verify failure in EITHER the live drain or the
+    # recovered fleet.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 16 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 2 \
+        --serve-full-every 3 --serve-wal-segment-bytes 4096 \
+        --serve-longhaul 4 \
+        --serve-save-name serve_longhaul_smoke
+    # The durability regression gate: recover_ms + on-disk journal
+    # bytes vs the committed baseline (same recipe).  Thresholds are
+    # loose where wall time is box-dependent; the BYTE columns are
+    # workload-determined, so real history-growth regressions fail
+    # well inside them.
+    python tools/bench_compare.py \
+      bench_results/serve_longhaul_smoke.json \
+      bench_results/serve_longhaul_baseline.json \
+      --max-throughput-regress 60 --max-p99-regress 200 \
+      --max-drain-p999-regress 200 \
+      --max-recover-regress 400 --max-journal-disk-regress 75
+    # Crash + durability-chaos leg under the race sanitizer: the GC
+    # pass is killed between its manifest write and the unlinks
+    # (crash_compact), the newest delta member is bit-flipped
+    # (delta_corrupt at barrier 2 — the DELTA barrier), and the whole
+    # drain is killed right after it (crash round 4), so the recovery
+    # tip IS the corrupted delta: recover_fleet must complete the torn
+    # GC, fall back down the snapshot chain (chain_fallbacks >= 1,
+    # asserted below), resume the redo tail, and byte-verify green,
+    # all with zero undeclared cross-thread accesses.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 16 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 2 \
+        --serve-full-every 2 --serve-wal-segment-bytes 256 \
+        --serve-longhaul 4 --serve-crash-round 4 \
+        --serve-faults "seed=3,crash_compact@2=1,delta_corrupt@2=1" \
+        --serve-save-name serve_longhaul_crash_smoke
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_longhaul_crash_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+f = {e["kind"]: e for e in x["faults"]["events"]}
+assert f["crash_compact"]["fired"] and f["crash_compact"]["recovered"], f
+assert f["delta_corrupt"]["fired"] and f["delta_corrupt"]["recovered"], f
+rec = x["recovery"]
+assert rec and rec["verify_ok"], rec
+assert rec["recover_ms"] > 0, rec
+# the crash lands on the corrupted delta tip: recovery must have
+# actually walked DOWN the chain, not found a clean full on top
+assert rec["chain_fallbacks"] >= 1, rec
+j = x["journal"]
+assert j["segments_sealed"] >= 1 and j["snapshots_delta"] >= 1, j
+g = x["metrics"]["gauges"]
+for name in ("serve.journal.wal_segments",
+             "serve.journal.bytes_since_snapshot",
+             "serve.durability.chain_depth",
+             "serve.durability.last_compaction_round"):
+    assert name in g, (name, sorted(g))
+print(f"longhaul crash smoke: crash_compact + delta_corrupt fired and "
+      f"recovered; recovery {rec['recover_ms']:.1f}ms restore "
+      f"(chain depth {rec['chain_depth']}, {rec['chain_fallbacks']} "
+      f"fallbacks, {rec['gc_segments_completed']} torn-GC segments "
+      f"completed) + {rec['redo_ops']} redo ops, WAL "
+      f"{rec['journal_disk_bytes']} B on disk, oracle verify green")
+PYEOF
+    ;;
   *)
-    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak)" >&2
+    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul)" >&2
     exit 2
     ;;
 esac
