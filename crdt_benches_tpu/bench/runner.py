@@ -677,6 +677,7 @@ def run_serve(args) -> int:
             ("--serve-recover", args.serve_recover),
             ("--serve-crash-round", args.serve_crash_round > 0),
             ("--serve-mesh", args.serve_mesh > 1),
+            ("--serve-tiers", args.serve_tiers is not None),
             ("--serve-queue-cap", args.serve_queue_cap > 0),
             ("--serve-status", args.serve_status is not None),
             ("--serve-timeseries", args.serve_timeseries is not None),
@@ -736,11 +737,13 @@ def run_serve(args) -> int:
         classes=args.serve_classes,
         slots=args.serve_slots,
         arrival_span=args.serve_arrival_span,
+        arrival_dist=args.serve_arrival_dist,
         mesh_devices=mesh_devices,
         verify_sample=args.serve_verify_sample,
         macro_k=args.serve_macro,
         batch_chars=args.serve_batch_chars,
         serve_kernel=args.serve_kernel,
+        serve_tiers=args.serve_tiers,
         journal_dir=args.serve_journal,
         snapshot_every=args.serve_snapshot_every,
         snapshot_keep=args.serve_snapshot_keep,
@@ -791,6 +794,16 @@ def run_serve(args) -> int:
         f"coalesce x{r.extra['coalesce_ratio']:.2f}, "
         f"pad {r.extra['pad_fraction']:.3f})"
     )
+    if r.extra.get("residency") is not None:
+        res = r.extra["residency"]
+        hr = res.get("hit_rate")
+        print(
+            f"  residency: hot {res['hot_rows_budget']} rows / warm "
+            f"{res['warm_budget']} docs / cold compressed; warm hits "
+            f"{res['warm_hits']} (prefetched {res['prefetch_hits']}), "
+            f"cold restores {res['cold_restores']}, hit rate "
+            + (f"{hr:.3f}" if hr is not None else "n/a")
+        )
     if r.extra["faults"] is not None:
         f = r.extra["faults"]
         mttr = r.extra["mttr_rounds"]
@@ -933,6 +946,23 @@ def main(argv=None) -> int:
                     help="resident rows per capacity class")
     ap.add_argument("--serve-mesh", type=int, default=0,
                     help="shard docs over N (virtual CPU) mesh devices")
+    ap.add_argument("--serve-tiers", default=None, metavar="SPEC",
+                    help="tiered state residency, 'hot=ROWS,warm=DOCS': "
+                         "scale the per-class device-row budget to "
+                         "~ROWS total (>= 2 rows per class; omit hot= "
+                         "to keep --serve-slots) and bound the pinned-"
+                         "host warm tier at DOCS ready-to-upload rows "
+                         "(arms the serve/prefetch.py async "
+                         "prefetcher; cold spool writes become "
+                         "compressed).  Bench ids become "
+                         "serve/tier/<mix>/<fleet>")
+    ap.add_argument("--serve-arrival-dist", default="uniform",
+                    choices=("uniform", "zipf"),
+                    help="session arrival staggering over "
+                         "--serve-arrival-span: 'uniform' (legacy) or "
+                         "'zipf' — a dense early head plus a long "
+                         "trickling tail, the skew that makes the "
+                         "warm tier's hot set real")
     ap.add_argument("--serve-trace", default=None, metavar="PATH",
                     help="arm the obs/trace.py span tracer for the "
                          "drain and write Perfetto-loadable Chrome "

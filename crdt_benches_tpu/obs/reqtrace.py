@@ -48,6 +48,7 @@ server's published snapshots, never the tracker.
 
 from __future__ import annotations
 
+import threading
 import time
 from bisect import bisect_left
 from collections import deque
@@ -179,6 +180,11 @@ class RequestTracker:  # graftlint: thread=hot
         self.reopened = 0  # episodes > 1: fresh contexts on re-admission
         self._next_id = 0
         self._installed = False
+        # the tracker's owning (hot) thread: the publish observer fires
+        # on the PUBLISHING thread, and since the prefetch thread
+        # gained its own declared publish point (serve/prefetch.py),
+        # not every entry is hot-side anymore — see _on_publish
+        self._owner = threading.get_ident()
         if self.armed:
             from ..lint import race_sanitizer
 
@@ -209,6 +215,14 @@ class RequestTracker:  # graftlint: thread=hot
     # which for every declared point in this stack is the hot thread) --
 
     def _on_publish(self, point: str) -> None:
+        if threading.get_ident() != self._owner:
+            # a publisher-side entry from ANOTHER thread (the prefetch
+            # worker's result swap): by definition not part of any
+            # request's causal path — prefetch runs BEFORE admission
+            # opens a context — and folding it here would mutate
+            # hot-owned accumulators cross-thread.  Dropped by design;
+            # the race sanitizer's own counters still record the entry.
+            return
         self._round_hops.add(point)
         self.hop_counts[point] = self.hop_counts.get(point, 0) + 1
 

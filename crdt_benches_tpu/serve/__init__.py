@@ -13,6 +13,12 @@ arxiv 2310.18220):
   admit/evict that round-trips cold docs through ``utils/checkpoint.py``
   and a device-resident MACRO step: K staged rounds of per-row range ops
   consumed by one jitted ``lax.scan`` over a compacted row tier;
+- :mod:`.prefetch`   — ``Prefetcher``: the tiered pool's predictive
+  cold→warm rehydrate thread (``# graftlint: thread=prefetch``) —
+  reads the scheduler's look-ahead admission plan, rehydrates cold
+  spools off the drain, and hands rows back through a declared
+  ``# graftlint: publish`` swap point on a bounded queue (G014–G017
+  gated; the hot thread never blocks on it);
 - :mod:`.scheduler`  — ``FleetScheduler``: macro-round admission +
   batching; drains per-doc RLE-coalesced range-op queues into
   ``(K, Rt, B)`` staged tensors (idle lanes padded with no-ops, staging
@@ -50,7 +56,8 @@ tests/test_serve_faults.py).
 
 from .faults import FaultInjector, FaultPlan
 from .journal import OpJournal, RecoveryReport, recover_fleet
-from .pool import DocPool
+from .pool import DocPool, WarmTier
+from .prefetch import Prefetcher
 from .replicate import ReplicatedScheduler, build_writer_groups
 from .scheduler import FleetScheduler, ServeStats, prepare_streams
 from .workload import BANDS, MIXES, build_fleet, split_turns
@@ -61,8 +68,10 @@ __all__ = [
     "FaultPlan",
     "FleetScheduler",
     "OpJournal",
+    "Prefetcher",
     "RecoveryReport",
     "ReplicatedScheduler",
+    "WarmTier",
     "ServeStats",
     "build_writer_groups",
     "prepare_streams",

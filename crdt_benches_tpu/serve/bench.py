@@ -61,6 +61,7 @@ from ..oracle.text_oracle import replay_trace
 from .faults import (
     JOURNAL_KINDS,
     REPLICATION_KINDS,
+    TIER_KINDS,
     FaultInjector,
     FaultPlan,
 )
@@ -187,6 +188,52 @@ def _parse_int_tuple(s: str | tuple) -> tuple[int, ...]:
     return tuple(int(x) for x in str(s).split(",") if x)
 
 
+def parse_tier_spec(spec: str, slots: tuple[int, ...]
+                    ) -> tuple[tuple[int, ...], int]:
+    """The ``--serve-tiers hot=ROWS,warm=DOCS`` grammar.
+
+    ``hot=ROWS`` scales the per-class slot table proportionally so the
+    total device-row budget lands at ~ROWS (each class keeps >= 2 rows
+    so every capacity class stays servable); ``warm=DOCS`` bounds the
+    pinned-host warm tier (and arms the async prefetcher).  Either key
+    may be omitted: ``warm=256`` alone keeps the explicit
+    ``--serve-slots`` hot budget.  Returns ``(slots, warm_docs)``."""
+    hot = None
+    warm = None
+    for tok in str(spec).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" not in tok:
+            raise ValueError(f"tier spec token {tok!r}: expected k=v")
+        key, val = tok.split("=", 1)
+        key = key.strip()
+        if key == "hot":
+            hot = int(val)
+        elif key == "warm":
+            warm = int(val)
+        else:
+            raise ValueError(
+                f"tier spec: unknown key {key!r} (expected hot/warm)"
+            )
+    if warm is None or warm <= 0:
+        raise ValueError(
+            f"tier spec {spec!r}: warm=DOCS (> 0) is required — the "
+            "three-tier pool IS the warm tier"
+        )
+    if hot is not None:
+        if hot < 2 * len(slots):
+            raise ValueError(
+                f"tier spec: hot={hot} below the floor of 2 rows per "
+                f"capacity class ({2 * len(slots)})"
+            )
+        total = sum(slots)
+        slots = tuple(
+            max(2, round(s * hot / total)) for s in slots
+        )
+    return slots, warm
+
+
 def run_serve_bench(
     mix="mixed",
     n_docs: int = 4096,
@@ -195,12 +242,14 @@ def run_serve_bench(
     slots=(2048, 512, 128, 32, 16),
     seed: int = 0,
     arrival_span: int = 8,
+    arrival_dist: str = "uniform",
     mesh_devices: int = 0,
     verify_sample: int = 8,
     bands: dict | None = None,
     macro_k: int = 8,
     batch_chars: int = 256,
     serve_kernel: str = "fused",
+    serve_tiers: str | None = None,
     spool_dir: str | None = None,
     journal_dir: str | None = None,
     snapshot_every: int = 32,
@@ -263,6 +312,17 @@ def run_serve_bench(
     classes = _parse_int_tuple(classes)
     slots = _parse_int_tuple(slots)
     mix_name = mix if isinstance(mix, str) else "custom"
+    # tiered residency (--serve-tiers): three-tier DocPool + the async
+    # prefetcher; its own bench-id family serve/tier/<mix>/<fleet>
+    warm_docs = 0
+    if serve_tiers:
+        slots, warm_docs = parse_tier_spec(serve_tiers, slots)
+        if mesh_devices > 1:
+            raise ValueError(
+                "--serve-tiers is single-host for now (the warm tier "
+                "composes through host boundary moves; the mesh form "
+                "is the silicon-campaign item, see ROADMAP)"
+            )
     # longhaul (serve/longhaul/<mix>/<fleet>): days-of-edits-scale
     # streams + a measured recovery-time objective — the durability
     # family, so the journal is mandatory and the recovery leg implied
@@ -282,7 +342,14 @@ def run_serve_bench(
             "--serve-mesh is not supported with the measured recovery "
             "leg (the recovered fleet is rebuilt single-host)"
         )
-    mix_label = f"longhaul/{mix_name}" if longhaul else mix_name
+    if warm_docs and longhaul:
+        raise ValueError(
+            "--serve-tiers and --serve-longhaul are separate bench "
+            "families (serve/tier/* vs serve/longhaul/*); pick one"
+        )
+    mix_label = f"longhaul/{mix_name}" if longhaul else (
+        f"tier/{mix_name}" if warm_docs else mix_name
+    )
 
     plan = None
     if faults is not None:
@@ -297,6 +364,15 @@ def run_serve_bench(
                 f"fault kinds {repl_kinds} need a replicated fleet "
                 "(--serve-writers >= 2, serve/replicate/); a plain "
                 "serve drain never polls them"
+            )
+        tier_kinds = sorted({
+            e.kind for e in plan.events if e.kind in TIER_KINDS
+        })
+        if tier_kinds and not warm_docs:
+            raise ValueError(
+                f"fault kinds {tier_kinds} target the warm tier / "
+                "prefetcher: --serve-tiers is required — a two-tier "
+                "drain never reaches their injection points"
             )
         if queue_cap <= 0 and any(
             e.kind == "queue_overflow" for e in plan.events
@@ -342,6 +418,7 @@ def run_serve_bench(
 
     default_name = (
         f"serve_longhaul_{mix_name}_{n_docs}" if longhaul
+        else f"serve_tier_{mix_name}_{n_docs}" if warm_docs
         else f"serve_{mix_name}_{n_docs}"
     )
 
@@ -395,9 +472,18 @@ def run_serve_bench(
         sessions = build_fleet(
             n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands,
             delivery=delivery, horizon=max(1, longhaul),
+            arrival_dist=arrival_dist,
         )
         pool = DocPool(classes=classes, slots=slots, mesh=mesh,
-                       spool_dir=spool_dir, serve_kernel=serve_kernel)
+                       spool_dir=spool_dir, serve_kernel=serve_kernel,
+                       warm_docs=warm_docs)
+        if warm_docs:
+            log(
+                f"serve: tiered residency — hot {sum(slots)} rows "
+                f"({'/'.join(str(s) for s in slots)}), warm {warm_docs} "
+                f"docs, cold spool compressed, prefetch "
+                f"{'armed' if pool.prefetcher is not None else 'off'}"
+            )
         streams = prepare_streams(
             sessions, pool, batch=batch, batch_chars=batch_chars
         )
@@ -541,6 +627,23 @@ def run_serve_bench(
             f"pad {stats.pad_fraction:.3f}; evictions {stats.evictions} "
             f"restores {stats.restores} promotions {stats.promotions}"
         )
+        if warm_docs:
+            pf = pool.prefetcher
+            hits, miss = pool.warm_hits, pool.restores
+            log(
+                f"serve: residency — hot {pool.hot_rows}/{sum(slots)} "
+                f"rows, warm {len(pool.warm)}/{warm_docs} docs, cold "
+                f"{pool.cold_docs}; warm hits {hits} (prefetched "
+                f"{pool.prefetch_hits}), cold restores {miss}, "
+                f"warm→cold {pool.warm_evictions}; hit rate "
+                + (f"{hits / (hits + miss):.3f}" if hits + miss else "n/a")
+                + (
+                    f"; prefetch {pf.submitted} submitted / "
+                    f"{pf.harvested} back / {pf.dropped} dropped / "
+                    f"{sched.prefetch_wasted} stale"
+                    if pf is not None else ""
+                )
+            )
         if plan is not None or stats.recoveries or stats.shed_ops:
             log(
                 f"serve: faults — injected {stats.faults_injected}, "
@@ -616,7 +719,8 @@ def run_serve_bench(
             if telemetry is not None:
                 telemetry.note_phase("recovering")
             rpool = DocPool(classes=classes, slots=slots,
-                            serve_kernel=serve_kernel)
+                            serve_kernel=serve_kernel,
+                            warm_docs=warm_docs)
             rstreams = prepare_streams(
                 sessions, rpool, batch=batch, batch_chars=batch_chars
             )
@@ -662,6 +766,7 @@ def run_serve_bench(
                 "cold_start": rep.snapshot_round < 0,
                 "docs_restored": rep.docs_restored,
                 "spools_restored": rep.spools_restored,
+                "warm_restored": rep.warm_restored,
                 "journal_disk_bytes": wal_disk,
                 "verified_docs": len(rsample),
                 "verify_ok": recovered_ok,
@@ -786,6 +891,9 @@ def run_serve_bench(
             "journal": journal is not None,
             "bus": False,  # only the replicated family drives the bus
             # (its artifact arms the surface; see replicate/bench.py)
+            # the prefetch surface (serve/prefetch.py publish=prefetch)
+            # is armed exactly when the tiered pool ran its worker
+            "prefetch": pool.prefetcher is not None,
             "publishes": race_counts["publishes"],
             "crossings": (
                 race_counts["crossings"] if race_sanitized else None
@@ -876,6 +984,50 @@ def run_serve_bench(
                     "disk_bytes": journal.on_disk_bytes(),
                 },
                 "longhaul": longhaul,
+                # tiered residency (None unless --serve-tiers armed):
+                # tier budgets + hit/miss/prefetch accounting — the
+                # warm+prefetch hit rate is the number bench_compare
+                # gates (one-sided skip-with-note, like timeseries)
+                "residency": None if not warm_docs else {
+                    "version": 1,
+                    "tiers": serve_tiers,
+                    "hot_rows_budget": sum(slots),
+                    "warm_budget": warm_docs,
+                    "arrival_dist": arrival_dist,
+                    "hot_rows_final": pool.hot_rows,
+                    "warm_docs_final": len(pool.warm),
+                    "cold_docs_final": pool.cold_docs,
+                    "evictions": stats.evictions,
+                    "warm_hits": pool.warm_hits,
+                    "warm_evictions": pool.warm_evictions,
+                    "cold_restores": pool.restores,
+                    "prefetch_hits": pool.prefetch_hits,
+                    "prefetch_submitted": (
+                        pool.prefetcher.submitted
+                        if pool.prefetcher is not None else 0
+                    ),
+                    "prefetch_harvested": (
+                        pool.prefetcher.harvested
+                        if pool.prefetcher is not None else 0
+                    ),
+                    "prefetch_dropped": (
+                        pool.prefetcher.dropped
+                        if pool.prefetcher is not None else 0
+                    ),
+                    "prefetch_errors": (
+                        pool.prefetcher.errors
+                        if pool.prefetcher is not None else 0
+                    ),
+                    "prefetch_wasted": sched.prefetch_wasted,
+                    "prefetch_missed": sched.prefetch_missed,
+                    # of the admissions that needed a doc's state back,
+                    # how many avoided the synchronous cold read
+                    "hit_rate": (
+                        (pool.warm_hits)
+                        / (pool.warm_hits + pool.restores)
+                        if (pool.warm_hits + pool.restores) else None
+                    ),
+                },
                 # measured recovery-time objective (None unless the
                 # recovery leg ran): recover_ms + redo-span +
                 # chain-depth breakdown, gated by bench_compare

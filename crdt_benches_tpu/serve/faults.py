@@ -52,6 +52,16 @@ Spec grammar (comma-separated ``key=value`` tokens)::
                      permuted writer order (serve/replicate/ only);
                      sequence-keyed reassembly makes delivery order
                      commute, so byte-verify must stay green
+  ``tier_evict_pressure`` force warm-tier churn under load (tiered
+                     pool only): LRU warm entries are demoted to the
+                     compressed cold spool mid-drain, so following
+                     admissions pay the cold path (``param`` = entries
+                     demoted, default half the tier)
+  ``prefetch_miss``  drop one round's planned prefetch batch (tiered
+                     pool only): the rehydrates never start, admission
+                     takes the synchronous cold path and must stay
+                     verify-green — the prefetcher is opportunism,
+                     never a dependency
   =================  ======================================================
 
 Every event records whether it fired and whether the engine recovered
@@ -80,6 +90,8 @@ KINDS = (
     "delta_corrupt",
     "replica_partition",
     "merge_reorder",
+    "tier_evict_pressure",
+    "prefetch_miss",
 )
 
 #: Kinds that need the write-ahead journal armed (``--serve-journal``):
@@ -95,6 +107,12 @@ JOURNAL_KINDS = ("crash_compact", "delta_corrupt")
 #: configuration error instead of a whole drain ending in a confusing
 #: not_fired chaos-gate failure.
 REPLICATION_KINDS = ("replica_partition", "merge_reorder")
+
+#: Kinds that need the tiered pool (``--serve-tiers`` / warm_docs > 0):
+#: they target the warm tier and the prefetcher — a two-tier drain
+#: never reaches their injection points, so ``run_serve_bench`` rejects
+#: the combination up front instead of ending in a confusing not_fired.
+TIER_KINDS = ("tier_evict_pressure", "prefetch_miss")
 
 
 @dataclass
@@ -287,6 +305,16 @@ class FaultInjector:
         """Flip bytes in the newest delta snapshot member (polled after
         each barrier; pending until a delta link exists)."""
         return self._pending(rnd, "delta_corrupt")
+
+    def tier_pressure_event(self, rnd: int) -> FaultEvent | None:
+        """Force warm-tier churn (polled each macro-round by the
+        tiered scheduler; pending until the warm tier holds entries)."""
+        return self._pending(rnd, "tier_evict_pressure")
+
+    def prefetch_miss_event(self, rnd: int) -> FaultEvent | None:
+        """Drop one round's planned prefetch batch (polled at prefetch
+        planning; pending until a round actually plans prefetches)."""
+        return self._pending(rnd, "prefetch_miss")
 
     def partition_event(self, rnd: int) -> FaultEvent | None:
         """A replica's broadcast link drops for a span (polled by the

@@ -668,23 +668,37 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
     shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp)
 
+    def _adopt(src: str, fname: str) -> None:
+        # spools are immutable once written (save_state lands them
+        # via os.replace, so a re-eviction swaps in a NEW inode):
+        # hard-link the snapshot member instead of copying — a
+        # thousands-of-cold-docs fleet barrier stays cheap
+        dst = os.path.join(tmp, fname)
+        try:
+            os.link(src, dst)
+        except OSError:  # cross-device / unsupported fs
+            shutil.copy2(src, dst)
+
     resident: dict[str, list[int]] = {}
     spooled: dict[str, str] = {}
+    warm: dict[str, str] = {}
     for doc_id, rec in pool.docs.items():
         if rec.cls is not None:
             resident[str(doc_id)] = [int(rec.cls), int(rec.row)]
         elif rec.spool is not None and os.path.exists(rec.spool):
             fname = f"doc{doc_id}.npz"
-            dst = os.path.join(tmp, fname)
-            # spools are immutable once written (save_state lands them
-            # via os.replace, so a re-eviction swaps in a NEW inode):
-            # hard-link the snapshot member instead of copying — a
-            # thousands-of-cold-docs fleet barrier stays cheap
-            try:
-                os.link(rec.spool, dst)
-            except OSError:  # cross-device / unsupported fs
-                shutil.copy2(rec.spool, dst)
+            _adopt(rec.spool, fname)
             spooled[str(doc_id)] = fname
+    # warm tier (tiered pool): the barrier and the tiers share ONE
+    # residency story — every warm doc gets a durable on-disk shadow
+    # (written once per warm lifetime; entries are immutable) and the
+    # shadow rides the snapshot exactly like a cold spool member.
+    warm_tier = getattr(pool, "warm", None)
+    if warm_tier is not None:
+        for doc_id in sorted(warm_tier.entries):
+            fname = f"doc{doc_id}.npz"
+            _adopt(pool.ensure_warm_shadow(doc_id), fname)
+            warm[str(doc_id)] = fname
 
     class_shapes: dict[str, list[int]] = {}
     delta_rows: dict[str, list[int]] = {}
@@ -747,6 +761,7 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
         "delta_rows": delta_rows,
         "resident": resident,
         "spooled": spooled,
+        "warm": warm,
         "docs": docs,
     }
     mtmp = os.path.join(tmp, "MANIFEST.tmp")
@@ -1154,6 +1169,7 @@ class RecoveryReport:
     resume_round: int = 0
     docs_restored: int = 0  # residency/cursor restored from the snapshot
     spools_restored: int = 0
+    warm_restored: int = 0  # warm-tier members restored (tiered pool)
     ops_replayed: int = 0  # journal-tail redo span (snap cursor -> WAL tip)
     torn_records: int = 0  # damaged journal tail lines dropped
     quarantined: list[int] = field(default_factory=list)
@@ -1207,7 +1223,9 @@ def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:
         report.snapshot_round = int(m["round"])
         report.docs_restored = len(m["resident"])
         report.spools_restored = len(m["spooled"])
+        report.warm_restored = len(m.get("warm", {}))
         report.chain_depth = len(members)
+        pool.recount_cold()  # bulk restore wrote spools directly
         break
 
     # ---- journal tail: redo span + re-applied decisions ----
@@ -1251,21 +1269,25 @@ def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:
 def _reset_fleet(pool, streams) -> None:
     """Undo a partially applied snapshot restore (damage discovered
     mid-restore): drop all residency/cursor state back to cold."""
+    warm_tier = getattr(pool, "warm", None)
     for rec in pool.docs.values():
         if rec.cls is not None:
             b = pool.buckets[rec.cls]
             b.rows[rec.row] = None
             b.release_row(rec.row)
         rec.cls = rec.row = None
-        rec.spool = None
+        rec.spool = None  # bulk reset; recount below restores the counter
         rec.length = rec.n_init
         rec.last_sched = -1
+        if warm_tier is not None:
+            warm_tier.take(rec.doc_id)
     for st in streams.values():
         st.cursor = 0
         st.limit = None
         st.lossy = False
         if st.delivered is not None:
             st.delivered = 0
+    pool.recount_cold()
 
 
 def _restore_snapshot(pool, streams, snap_dir: str, manifest: dict,
@@ -1305,8 +1327,32 @@ def _restore_snapshot(pool, streams, snap_dir: str, manifest: dict,
             damaged.add(doc_id)
             continue
         rec = pool.docs[doc_id]
-        rec.spool = pool._spool_path(doc_id)
+        rec.spool = pool._spool_path(doc_id)  # bulk restore; recount below
         shutil.copy2(src, rec.spool)
+    # warm members (tiered pool): restored back into the warm tier
+    # when the recovering pool has one (shadowed by the copied member,
+    # so a later demotion is free); a warm-less pool — or a damaged
+    # member — degrades them to cold / cold-restart, same ladder as
+    # spooled members.
+    for key, fname in manifest.get("warm", {}).items():
+        doc_id = int(key)
+        src = os.path.join(snap_dir, fname)
+        try:
+            st = load_state(src)
+        except CorruptCheckpointError:
+            damaged.add(doc_id)
+            continue
+        rec = pool.docs[doc_id]
+        dst = pool._spool_path(doc_id)
+        shutil.copy2(src, dst)
+        warm_tier = getattr(pool, "warm", None)
+        if warm_tier is not None and warm_tier.budget > 0:
+            pool.warm_restore(
+                doc_id, np.asarray(st.doc[0], np.int32),
+                int(st.length[0]), int(st.nvis[0]), shadow=dst,
+            )
+        else:
+            rec.spool = dst  # bulk restore; recount below
     for key, d in manifest["docs"].items():
         doc_id = int(key)
         st = streams.get(doc_id)

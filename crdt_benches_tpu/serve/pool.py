@@ -16,9 +16,27 @@ slots): a small doc must not pay a 4096-wide apply pass, so each class is
 its own (R_class, C_class) stack.  Docs are admitted into a free row of
 their class, **promoted** to the next class when their slot need outgrows
 the current one (capacity need is host-known: n_init + cumulative insert
-count, so promotion never requires a device sync), and **evicted** to a
-checkpoint spool (``utils/checkpoint.py`` .npz round-trip) when their
-bucket is full — cold docs rehydrate into *any* free row later.
+count, so promotion never requires a device sync), and evicted when
+their bucket is full — cold docs rehydrate into *any* free row later.
+
+Residency is an explicit THREE-tier story (``warm_docs > 0``):
+
+- **hot** — the device-resident capacity-class rows above;
+- **warm** — a bounded pinned-host tier (:class:`WarmTier`) of
+  ready-to-upload packed rows (numpy, class-shaped, trimmed to their
+  used prefix).  Evictions land here as pure host copies — no disk
+  I/O — and a warm admission is a memory compose, LRU-by-last-scheduled
+  eviction demotes overflow to cold;
+- **cold** — the checkpoint spool (``utils/checkpoint.py`` .npz),
+  COMPRESSED for cold-tier writes (the deflate cost is off the hot
+  eviction path now that evictions land warm).  A cold admission pays
+  the synchronous rehydrate — unless the predictive prefetcher
+  (serve/prefetch.py, armed with the warm tier) already rehydrated the
+  doc into warm ahead of the scheduler's admission plan.
+
+With ``warm_docs == 0`` the pool is exactly the historical two-tier
+store (hot rows + uncompressed spool): the tier machinery costs nothing
+when everything fits.
 
 The serving hot path is the **macro step**: K rounds of per-class
 ``(R, B)`` range-op tensors staged (in packed narrow lane dtypes —
@@ -57,7 +75,8 @@ import numpy as np
 from ..engine.merge_fleet import merge_rows_body
 from ..lint.boundary import boundary
 from ..lint.sanitizer import fenced
-from ..obs.metrics import Counter
+from ..obs.metrics import Counter, Gauge
+from .prefetch import Prefetcher
 from ..ops.apply2 import LANE, PackedState, apply_batch3
 from ..ops.packing import op_lane_dtypes, widen_ops
 from ..ops.resolve import resolve_batch
@@ -236,6 +255,72 @@ class Bucket:
         heapq.heappush(self._heaps[s], l)
 
 
+@dataclass
+class WarmEntry:
+    """One warm-tier document: a ready-to-upload packed row (host
+    numpy, trimmed to its used ``length`` prefix — the tail is the
+    constant beyond-length coding ``2`` that ``_install`` re-pads).
+    Entries are IMMUTABLE once deposited (the doc's state only evolves
+    while hot), which is what makes the ``shadow`` — a durable on-disk
+    copy written lazily by snapshot barriers — valid for the entry's
+    whole warm lifetime: a shadowed entry demotes to cold for free."""
+
+    doc_row: np.ndarray
+    length: int
+    nvis: int
+    origin: str = "evict"  # "evict" | "prefetch" | "recover"
+    shadow: str | None = None  # durable spool copy (None = memory only)
+    last_sched: int = -1  # LRU key: round the doc was last scheduled
+    token: int = 0  # heap-entry invalidation tag
+
+
+class WarmTier:
+    """Bounded pinned-host tier: doc_id -> :class:`WarmEntry`, with
+    LRU-by-last-scheduled eviction order.  The eviction heap is lazily
+    invalidated (a doc re-deposited after a warm hit gets a new token;
+    stale heap entries are skipped on pop), so put/take stay O(log n).
+    Owned by the hot thread — the prefetch thread never touches it;
+    prefetched rows arrive through the pool's harvest path."""
+
+    def __init__(self, budget: int):
+        self.budget = max(0, int(budget))
+        self.entries: dict[int, WarmEntry] = {}
+        self._heap: list[tuple[int, int, int]] = []  # (last_sched, doc, token)
+        self._tokens = 0
+
+    def __contains__(self, doc_id: int) -> bool:
+        return doc_id in self.entries
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def put(self, doc_id: int, entry: WarmEntry) -> None:
+        self._tokens += 1
+        entry.token = self._tokens
+        self.entries[doc_id] = entry
+        heapq.heappush(
+            self._heap, (entry.last_sched, doc_id, entry.token)
+        )
+
+    def take(self, doc_id: int) -> WarmEntry | None:
+        """Remove and return the doc's entry (heap entry invalidated
+        lazily)."""
+        return self.entries.pop(doc_id, None)
+
+    def pop_lru(self) -> tuple[int, WarmEntry] | None:
+        """Remove and return the least-recently-scheduled entry."""
+        while self._heap:
+            last_sched, doc_id, token = heapq.heappop(self._heap)
+            e = self.entries.get(doc_id)
+            if e is not None and e.token == token:
+                del self.entries[doc_id]
+                return doc_id, e
+        return None
+
+    def over_budget(self) -> int:
+        return max(0, len(self.entries) - self.budget)
+
+
 class DocPool:
     """The document fleet: buckets + admit/evict/promote + macro step.
 
@@ -253,6 +338,9 @@ class DocPool:
         mesh=None,
         spool_dir: str | None = None,
         serve_kernel: str = "fused",
+        warm_docs: int = 0,
+        prefetch: bool = True,
+        prefetch_capacity: int = 256,
     ):
         if len(classes) != len(slots):
             raise ValueError("classes and slots must have equal length")
@@ -320,6 +408,31 @@ class DocPool:
             for name in ("evictions", "restores", "promotions",
                          "fresh_admits")
         }
+        # ---- tiered residency (hot / pinned-host warm / compressed
+        # cold).  warm_docs == 0 = the historical two-tier pool; > 0
+        # arms the warm tier and (prefetch=True) the async prefetcher.
+        # Counters/gauges are pre-registered HERE, off the hot path
+        # (G013); the scheduler refreshes the gauges once per round.
+        self.warm = WarmTier(warm_docs)
+        for name in ("warm_hits", "warm_evictions", "prefetch_hits"):
+            self._counters[name] = Counter("serve.tier." + name)
+        self._gauges = {
+            name: Gauge("serve.tier." + name)
+            for name in ("hot_rows", "warm_docs", "cold_docs",
+                         "prefetch_inflight")
+        }
+        #: per-doc spool write generation: bumped at every spool_save,
+        #: so an in-flight prefetch read can be recognized as stale at
+        #: harvest (the doc was re-evicted while the read ran)
+        self._spool_gens: dict[int, int] = {}
+        #: live cold-tier population, maintained incrementally: every
+        #: rec.spool transition routes through :meth:`_set_spool`, so
+        #: the per-round gauge refresh never scans the fleet
+        self._n_cold = 0
+        self.prefetcher: Prefetcher | None = None
+        if warm_docs > 0 and prefetch:
+            self.prefetcher = Prefetcher(capacity=prefetch_capacity)
+            self.prefetcher.start()
         # per-row dirty tracking (durability v2): rows whose device
         # content changed since the last snapshot barrier.  Pure host
         # set arithmetic — delta snapshots persist exactly these rows,
@@ -332,6 +445,8 @@ class DocPool:
         objects the registry now serializes)."""
         for c in self._counters.values():
             registry.attach(c)
+        for g in self._gauges.values():
+            registry.attach(g)
 
     @property
     def evictions(self) -> int:
@@ -364,6 +479,21 @@ class DocPool:
     @fresh_admits.setter
     def fresh_admits(self, v: int) -> None:
         self._counters["fresh_admits"].value = int(v)
+
+    @property
+    def warm_hits(self) -> int:
+        """Admissions served from the warm tier (no disk I/O)."""
+        return self._counters["warm_hits"].value
+
+    @property
+    def prefetch_hits(self) -> int:
+        """Warm hits whose entry the prefetcher deposited."""
+        return self._counters["prefetch_hits"].value
+
+    @property
+    def warm_evictions(self) -> int:
+        """Warm→cold demotions (LRU overflow or forced pressure)."""
+        return self._counters["warm_evictions"].value
 
     # ---- dirty tracking (delta-snapshot substrate) ----
 
@@ -492,14 +622,41 @@ class DocPool:
     def _spool_path(self, doc_id: int) -> str:
         return os.path.join(self.spool_dir, f"doc{doc_id}.npz")
 
+    def _set_spool(self, rec: DocRecord, path: str | None) -> None:
+        """THE rec.spool transition point: every move of a doc into or
+        out of the cold tier goes through here so ``cold_docs`` stays
+        an O(1) counter (the per-round gauge refresh must never scan a
+        64k-doc fleet).  Idempotent on no-op transitions."""
+        if (rec.spool is None) != (path is None):
+            self._n_cold += 1 if path is not None else -1
+        rec.spool = path
+
+    def recount_cold(self) -> int:
+        """Re-derive the cold counter from ground truth (recovery /
+        reset paths, where bulk state lands outside the transition
+        helper)."""
+        self._n_cold = sum(
+            1 for rec in self.docs.values() if rec.spool is not None
+        )
+        return self._n_cold
+
+    def spool_gen(self, doc_id: int) -> int:
+        """The doc's spool write generation (bumped per spool_save):
+        the staleness tag a prefetch submission carries, so a harvest
+        can drop a read that raced a re-eviction."""
+        return self._spool_gens.get(doc_id, 0)
+
     def spool_save(
             self, doc_id: int, doc_row: np.ndarray, length: int,
-            nvis: int) -> str:
+            nvis: int, compress: bool = False) -> str:
         """Write one doc's checkpoint to the spool.  Only the used
         ``length`` prefix is stored (the tail is the constant
-        beyond-length coding ``2`` that ``_install`` re-pads), and the
-        .npz is uncompressed — zlib on the eviction path was the single
-        largest host cost of the round-loop engine.
+        beyond-length coding ``2`` that ``_install`` re-pads).
+        ``compress`` defaults off — zlib on the two-tier eviction path
+        was the single largest host cost of the round-loop engine;
+        COLD-tier writes of the three-tier pool (warm→cold demotions,
+        warm shadows, direct evictions with the warm tier armed) pass
+        True, where the deflate runs off the per-round eviction path.
 
         NOT a fence: every input is already a host array (callers pull
         through ``_pull_row``/``pull_bucket``, the real boundaries) and
@@ -515,8 +672,9 @@ class DocPool:
                 length=np.asarray([length], np.int32),
                 nvis=np.asarray([nvis], np.int32),
             ),
-            compress=False,
+            compress=compress,
         )
+        self._spool_gens[doc_id] = self._spool_gens.get(doc_id, 0) + 1
         return path
 
     @fenced
@@ -530,20 +688,20 @@ class DocPool:
         if rec.cls is None:
             raise ValueError(f"doc {doc_id} is not resident")
         st = self._pull_row(rec)
-        rec.spool = self.spool_save(
+        self._set_spool(rec, self.spool_save(
             doc_id, np.asarray(st.doc[0]), int(st.length[0]),
-            int(st.nvis[0]),
-        )
+            int(st.nvis[0]), compress=self.warm.budget > 0,
+        ))
         self._free_row(rec)
         self.evictions += 1
         return rec.spool
 
     def admit(self, doc_id: int, need: int) -> tuple[int, int]:
         """Make ``doc_id`` resident in the class covering ``need`` slots
-        (promoting a doc resident in a smaller class, rehydrating a
-        spooled doc, or installing a fresh one).  The target bucket must
-        have a free row — eviction policy lives in the scheduler.
-        Returns (class, row)."""
+        (promoting a doc resident in a smaller class, composing a warm
+        entry in, rehydrating a spooled doc, or installing a fresh
+        one).  The target bucket must have a free row — eviction policy
+        lives in the scheduler.  Returns (class, row)."""
         rec = self.docs[doc_id]
         cls = self.class_for(max(need, rec.length, 1))
         if rec.cls is not None:
@@ -556,6 +714,11 @@ class DocPool:
                 rec, cls, np.asarray(st.doc[0]),
                 int(st.length[0]), int(st.nvis[0]),
             )
+        entry = self.take_warm_hit(doc_id)
+        if entry is not None:
+            return self._install(
+                rec, cls, entry.doc_row, entry.length, entry.nvis
+            )
         if rec.spool is not None:
             try:
                 st = load_state(rec.spool)
@@ -565,17 +728,194 @@ class DocPool:
                 raise CorruptCheckpointError(
                     f"doc {doc_id}: eviction spool damaged: {e}"
                 ) from e
-            os.unlink(rec.spool)  # rehydrated: keep the spool bounded
-            rec.spool = None
             self.restores += 1
-            return self._install(
+            out = self._install(
                 rec, cls, np.asarray(st.doc[0]),
                 int(st.length[0]), int(st.nvis[0]),
             )
+            # DEFERRED unlink: the spool stays on disk until the doc is
+            # safely resident and dirty-tracked (_install marked the
+            # row).  Unlinking before the install (the historical
+            # order) opened a crash window where the only durable copy
+            # of the doc was gone with nothing device-resident yet —
+            # under the warm tier a doc cycles warm→cold repeatedly, so
+            # the window would reopen on every cycle.  The file itself
+            # is left behind (rec.spool = None marks it stale); a later
+            # re-eviction's save_state atomically replaces it, so the
+            # spool stays bounded at one file per doc.
+            rec.spool = None
+            return out
         self.fresh_admits += 1
         return self._install(
             rec, cls, _fresh_row_np(cls, rec.n_init), rec.n_init, rec.n_init
         )
+
+    # ---- the warm tier (pinned host; hot-thread owned) ----
+
+    def take_warm_hit(self, doc_id: int) -> WarmEntry | None:
+        """THE warm-hit admission rule, shared by :meth:`admit` and the
+        scheduler's ``_place``: remove the doc's warm entry (a pure
+        memory compose follows — no disk I/O on promotion), bump the
+        hit counters, and mark the doc tierless until its install
+        lands.  Any on-disk shadow stays behind as a stale file the
+        next eviction's atomic os.replace supersedes.  Returns None
+        when the doc is not warm."""
+        entry = self.warm.take(doc_id)
+        if entry is None:
+            return None
+        self._counters["warm_hits"].inc()
+        if entry.origin == "prefetch":
+            self._counters["prefetch_hits"].inc()
+        self._set_spool(self.docs[doc_id], None)
+        return entry
+
+    def warm_deposit(self, doc_id: int, doc_row: np.ndarray, length: int,
+                     nvis: int, origin: str = "evict",
+                     last_sched: int = -1) -> int:
+        """Deposit one evicted doc into the warm tier (a trimmed host
+        copy — no disk I/O) and enforce the budget: overflow demotes
+        LRU-by-last-scheduled entries to the compressed cold spool.
+        Returns the number of docs demoted to cold."""
+        rec = self.docs[doc_id]
+        self.warm.put(doc_id, WarmEntry(
+            doc_row=np.array(doc_row[:length], np.int32),
+            length=int(length), nvis=int(nvis), origin=origin,
+            last_sched=last_sched if last_sched >= 0 else rec.last_sched,
+        ))
+        return self._enforce_warm_budget()
+
+    def _enforce_warm_budget(self, extra: int = 0) -> int:
+        """Demote ``over_budget() + extra`` LRU entries warm→cold.  A
+        shadowed entry demotes for FREE (its durable copy already
+        exists — warm entries are immutable, so the shadow is exact);
+        an unshadowed one pays one compressed spool write."""
+        demoted = 0
+        n = self.warm.over_budget() + max(0, extra)
+        for _ in range(n):
+            hit = self.warm.pop_lru()
+            if hit is None:
+                break
+            doc_id, e = hit
+            rec = self.docs[doc_id]
+            self._set_spool(
+                rec,
+                e.shadow if e.shadow is not None else self.spool_save(
+                    doc_id, e.doc_row, e.length, e.nvis, compress=True
+                ),
+            )
+            self._counters["warm_evictions"].inc()
+            demoted += 1
+        return demoted
+
+    def warm_pressure(self, n: int) -> int:
+        """Force-demote up to ``n`` warm entries to cold (the
+        ``tier_evict_pressure`` chaos kind: warm-tier churn under
+        load).  Returns the demoted count."""
+        return self._enforce_warm_budget(extra=min(n, len(self.warm)))
+
+    def store_prefetched(self, doc_id: int, doc_row: np.ndarray,
+                         length: int, nvis: int, round_no: int,
+                         gen: int | None = None) -> bool:
+        """Adopt one harvested prefetch payload into the warm tier.
+        The caller (the scheduler's harvest) already dropped stale
+        generations; this guards residency — a doc that went hot (or
+        warm) while the read was in flight keeps its current state and
+        the payload is discarded.  The doc's spool file becomes the
+        entry's shadow: same bytes, so a later warm→cold demotion is
+        free.
+
+        Predictive PROMOTION, not just caching: the entry's LRU key is
+        ``round_no`` (the admission horizon it was prefetched for), so
+        it outranks genuinely-stale warm entries — a full tier demotes
+        its least-recently-scheduled entry to make room (free when
+        shadowed), it never refuses the doc the scheduler is about to
+        want."""
+        rec = self.docs.get(doc_id)
+        if rec is None or rec.cls is not None or doc_id in self.warm \
+                or rec.spool is None:
+            return False
+        if gen is not None and self.spool_gen(doc_id) != gen:
+            return False  # the read raced a re-eviction: superseded
+        shadow = rec.spool
+        self._set_spool(rec, None)
+        # the payload row is the worker's freshly-loaded array —
+        # exclusively ours once harvested, already trimmed: adopted
+        # as-is (no copy, and no spool write here: overflow past the
+        # budget is trimmed at the next boundary moves, inside the
+        # fence disk writes belong behind)
+        self.warm.put(doc_id, WarmEntry(
+            doc_row=doc_row[:length],
+            length=int(length), nvis=int(nvis), origin="prefetch",
+            shadow=shadow, last_sched=int(round_no),
+        ))
+        return True
+
+    def warm_restore(self, doc_id: int, doc_row: np.ndarray, length: int,
+                     nvis: int, shadow: str | None) -> None:
+        """Recovery-path deposit (journal ``_restore_snapshot``): the
+        snapshot's warm residency comes back as warm, shadowed by the
+        copied member so later demotion is free."""
+        rec = self.docs[doc_id]
+        self._set_spool(rec, None)
+        self.warm.put(doc_id, WarmEntry(
+            doc_row=np.asarray(doc_row[:length], np.int32),
+            length=int(length), nvis=int(nvis), origin="recover",
+            shadow=shadow, last_sched=rec.last_sched,
+        ))
+        self._enforce_warm_budget()
+
+    def ensure_warm_shadow(self, doc_id: int) -> str:
+        """Durable on-disk copy of a warm entry (snapshot barriers:
+        warm docs must be persistable through the SAME spool-member
+        path cold docs use — one composed residency story).  Written
+        once per warm lifetime; entries are immutable so the shadow
+        never goes stale."""
+        e = self.warm.entries[doc_id]
+        if e.shadow is None:
+            e.shadow = self.spool_save(
+                doc_id, e.doc_row, e.length, e.nvis, compress=True
+            )
+        return e.shadow
+
+    @property
+    def cold_docs(self) -> int:
+        """Docs whose only live copy is a cold spool (O(1): every
+        ``rec.spool`` transition routes through :meth:`_set_spool`)."""
+        return self._n_cold
+
+    @property
+    def hot_rows(self) -> int:
+        """Occupied device rows across every capacity class."""
+        return sum(b.R - b.n_free for b in self.buckets.values())
+
+    def update_tier_gauges(self) -> None:
+        """Refresh the residency gauges (scheduler: once per round —
+        pure host arithmetic on pre-registered objects, G013)."""
+        g = self._gauges
+        g["hot_rows"].set(self.hot_rows)
+        g["warm_docs"].set(len(self.warm))
+        g["cold_docs"].set(self.cold_docs)
+        g["prefetch_inflight"].set(
+            self.prefetcher.inflight if self.prefetcher is not None else 0
+        )
+
+    def tier_status(self) -> dict:
+        """Small-scalar residency view (``/status.json``)."""
+        pf = self.prefetcher
+        return {
+            "hot_rows": self.hot_rows,
+            "hot_budget": sum(b.R for b in self.buckets.values()),
+            "warm_docs": len(self.warm),
+            "warm_budget": self.warm.budget,
+            "cold_docs": self.cold_docs,
+            "warm_hits": self.warm_hits,
+            "warm_evictions": self.warm_evictions,
+            "cold_restores": self.restores,
+            "prefetch_hits": self.prefetch_hits,
+            "prefetch_inflight": pf.inflight if pf is not None else 0,
+            "prefetch_submitted": pf.submitted if pf is not None else 0,
+            "prefetch_dropped": pf.dropped if pf is not None else 0,
+        }
 
     # ---- boundary bulk movement (one sync, one upload per class) ----
 
@@ -1103,6 +1443,9 @@ class DocPool:
         rec = self.docs[doc_id]
         if rec.cls is not None:
             st = self._pull_row(rec)
+        elif doc_id in self.warm:
+            e = self.warm.entries[doc_id]
+            return decode_row_np(e.doc_row, e.length, e.nvis, rec.chars)
         elif rec.spool is not None:
             st = load_state(rec.spool)
         else:
@@ -1129,9 +1472,12 @@ class DocPool:
         return out
 
     def close(self) -> None:
-        """Delete the spool directory if this pool created it (a caller
-        who passed spool_dir owns its lifecycle).  Spooled docs become
-        undecodable afterwards — call only once served docs are done."""
+        """Stop the prefetch thread and delete the spool directory if
+        this pool created it (a caller who passed spool_dir owns its
+        lifecycle).  Spooled docs become undecodable afterwards — call
+        only once served docs are done."""
+        if self.prefetcher is not None:
+            self.prefetcher.stop()
         if self._owns_spool and os.path.isdir(self.spool_dir):
             import shutil
 

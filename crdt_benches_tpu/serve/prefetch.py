@@ -1,0 +1,206 @@
+"""Predictive async prefetch: the cold→warm rehydrate thread.
+
+The tiered ``DocPool`` (serve/pool.py) keeps a bounded pinned-host
+**warm** tier between the device-resident hot rows and the compressed
+cold spool.  A cold doc the scheduler is about to admit would pay a
+synchronous disk read (decompress + CRC verify) on the hot thread; this
+module moves that read OFF the drain: the scheduler's look-ahead plan
+(the front of its round-robin rotation plus the arrival horizon) is
+submitted here, a dedicated **prefetch thread** rehydrates the spools,
+and the rows come back to the hot thread through a declared publish
+point on a bounded queue — by the time ``_select`` wants the doc, it is
+a warm hit.
+
+Thread-confinement contract (graftlint G014–G017 + the runtime race
+sanitizer, the constraint ROADMAP pinned on this work):
+
+- the worker loop is its own declared root (``# graftlint:
+  thread=prefetch``) and touches NOTHING the hot thread owns — a
+  request is an immutable ``(doc_id, spool_path, generation)`` tuple
+  carrying everything the load needs, so ``pool.docs`` / streams /
+  buckets never cross;
+- rehydrated rows cross back ONLY through :meth:`Prefetcher._publish`,
+  a declared ``# graftlint: publish=prefetch`` swap point on the
+  bounded result queue.  Under ``CRDT_BENCH_SANITIZE_RACES=1`` each
+  payload becomes an ownership-tracking proxy published by that point;
+  the hot thread's :meth:`drain` is the ``reveal`` gate, so every
+  crossing is counted and an unpublished handoff raises at its
+  callsite.  The per-point counters land in the serve artifact's
+  ``thread_crossings`` block (surface key ``prefetch``) and G017
+  cross-checks them against these annotations;
+- the hot thread NEVER blocks on this thread (G016): submission is
+  ``put_nowait`` (queue full = the prefetch is dropped and counted),
+  harvest is ``get_nowait``, and an admission that misses warm falls
+  back to the synchronous rehydrate it always had — the prefetcher is
+  pure opportunism, never a dependency.
+
+Staleness is the hot thread's problem by design: a payload carries the
+doc's spool **generation** at submit time (``DocPool.spool_gen``), and
+the harvest drops any result whose generation moved — the doc was
+re-admitted and re-evicted while the read was in flight, so the bytes
+describe a superseded state.  ``save_state`` lands spools via
+``os.replace``, so an in-flight read races only ever against a
+complete old inode, never a torn file.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..lint.race_sanitizer import published, reveal, share
+from ..utils.checkpoint import load_state
+
+#: Default bound of the request/result queues: deep enough to cover one
+#: macro-round's admission fan-in, small enough that a wedged worker
+#: surfaces as dropped submissions, not unbounded memory.
+DEFAULT_CAPACITY = 256
+
+
+class Prefetcher:
+    """The cold→warm rehydrate worker (module docstring has the model).
+
+    Hot-thread surface: :meth:`submit` / :meth:`drain` / :meth:`stop`
+    (all non-blocking or bounded).  Worker surface: :meth:`_run` /
+    :meth:`_publish` (the declared prefetch thread).  All counters are
+    owned by the hot thread — the worker only ever touches the two
+    queues."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        cap = max(4, int(capacity))
+        #: the submission bound the scheduler respects: never more
+        #: than ``capacity`` reads outstanding, so the result queue
+        #: (same size) can always absorb every completion and the
+        #: worker's publish never times out in a healthy drain
+        self.capacity = cap
+        self._req: queue.Queue = queue.Queue(maxsize=cap)
+        self._res: queue.Queue = queue.Queue(maxsize=cap)
+        self._thread: threading.Thread | None = None
+        # hot-thread-owned accounting (never touched by the worker)
+        self.submitted = 0
+        self.dropped = 0  # request queue full: prefetch refused
+        self.harvested = 0
+        self.errors = 0  # payloads that came back with a load error
+        self.lost = 0  # reaped by the scheduler (publish-drop leak fix)
+        self.inflight = 0
+
+    def note_lost(self, n: int) -> None:
+        """The scheduler reaped ``n`` in-flight entries whose results
+        never arrived (a wedged round forced the worker's bounded
+        publish to time out and drop).  Without this, a dropped
+        payload would pin ``inflight`` — and shrink the submission
+        budget — for the rest of the run."""
+        self.lost += n
+        self.inflight = max(0, self.inflight - n)
+
+    # ---- driver-side lifecycle (G013: never constructed mid-drain) --
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="serve-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the worker (driver side).  Bounded waits only — a
+        wedged worker is abandoned as a daemon, never joined forever."""
+        if self._thread is None:
+            return
+        try:
+            self._req.put(None, timeout=1.0)
+        except queue.Full:
+            pass  # worker wedged mid-load: daemon thread, abandoned
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ---- hot-thread surface (non-blocking by contract, G016) ----
+
+    def submit(self, doc_id: int, spool_path: str, gen: int) -> bool:
+        """Queue one cold→warm rehydrate.  Never blocks: a full queue
+        refuses the prefetch (counted; admission will simply take the
+        synchronous path).  The request tuple is immutable — the only
+        mutable data crossing threads is the RESULT, through the
+        declared publish point."""
+        try:
+            self._req.put_nowait((int(doc_id), str(spool_path), int(gen)))
+        except queue.Full:
+            self.dropped += 1
+            return False
+        self.submitted += 1
+        self.inflight += 1
+        return True
+
+    def drain(self) -> list[dict]:
+        """Harvest every completed rehydrate (never blocks).  Each
+        payload passes the ``reveal`` gate — the reader side of the
+        publish contract — so armed runs attribute the crossing to
+        :meth:`_publish` (and raise on an unpublished handoff)."""
+        out: list[dict] = []
+        while True:
+            try:
+                item = self._res.get_nowait()
+            except queue.Empty:
+                break
+            payload = reveal(item)
+            self.inflight -= 1
+            self.harvested += 1
+            if payload.get("error") is not None:
+                self.errors += 1
+            out.append(payload)
+        return out
+
+    # ---- the prefetch thread ----
+
+    def _run(self) -> None:  # graftlint: thread=prefetch
+        """Worker loop: block on the request queue (this thread's ONLY
+        job is waiting on it — G016 polices the hot thread, not this
+        one), rehydrate the spool, publish the result.  A damaged spool
+        is not a failure here: the error rides back in the payload and
+        the hot thread's synchronous path (with its heal machinery)
+        owns the repair."""
+        while True:
+            item = self._req.get()
+            if item is None:
+                return
+            doc_id, path, gen = item
+            try:
+                st = load_state(path)
+                payload = {
+                    "doc": doc_id,
+                    "gen": gen,
+                    "row": np.asarray(st.doc[0], np.int32),
+                    "length": int(st.length[0]),
+                    "nvis": int(st.nvis[0]),
+                    "error": None,
+                }
+            except Exception as e:  # CRC damage, vanished file, ...
+                payload = {
+                    "doc": doc_id, "gen": gen, "row": None,
+                    "length": 0, "nvis": 0,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            try:
+                self._publish(payload)
+            except queue.Full:
+                # hot thread stopped draining (drain abandoned): the
+                # prefetch is best-effort, the payload is dropped
+                continue
+
+    @published
+    def _publish(self, payload: dict) -> None:  # graftlint: publish=prefetch  # graftlint: thread=prefetch
+        """THE declared swap point: one rehydrated row leaves the
+        prefetch thread.  ``share`` stamps the payload with this
+        point's publish generation (armed), and the bounded ``put``
+        carries a timeout so a wedged consumer can never park the
+        worker forever."""
+        self._res.put(
+            share(payload, "Prefetcher.result"), timeout=30.0
+        )

@@ -399,6 +399,11 @@ class _Plan:
     # data movement (executed at the sync boundary, planned host-side):
     pull_classes: set[int] = field(default_factory=set)
     evictions: list[tuple[int, int, int]] = field(default_factory=list)
+    # warm-mode victims whose state still lives in their old bucket row
+    # until the boundary: a LATER class selecting such a doc converts
+    # the eviction into a same-round pull (see _place)
+    limbo: dict[int, tuple[int, int]] = field(default_factory=dict)
+    cancelled_evictions: set[int] = field(default_factory=set)
     # target class -> [(doc_id, row, source)]; source is ('fresh',),
     # ('spool', path), or ('pull', src_cls, src_row)
     installs: dict[int, list[tuple[int, int, tuple]]] = field(
@@ -466,6 +471,14 @@ class FleetScheduler:
         self._rr = deque(sorted(
             streams, key=lambda d: (streams[d].arrival, d)
         ))
+        # static arrival schedule + ended-doc set: the O(1) inputs the
+        # _select early exit uses to count the unscanned tail's TRUE
+        # waiting docs (arrived and not drained) without touching it
+        self._arrivals_sorted = np.sort(np.fromiter(
+            (st.arrival for st in streams.values()), dtype=np.int64,
+            count=len(streams),
+        ))
+        self._ended: set[int] = set()
         if self.queue_cap > 0:
             for st in streams.values():
                 if st.delivered is None:
@@ -509,6 +522,21 @@ class FleetScheduler:
         # this one bundle — bound here so every series lives in THIS
         # drain's registry.
         self.telemetry = telemetry
+        # ---- predictive prefetch (tiered pool only): hot-thread-owned
+        # accounting; the worker thread sees only the queues.  The
+        # inflight table maps doc -> submit round so entries whose
+        # results never arrive (the worker's bounded publish dropped
+        # them during a wedged round) are reaped instead of pinning
+        # the submission budget forever ----
+        self._prefetch_inflight: dict[int, int] = {}
+        #: cold docs rehydrated ahead of admission per round: the next
+        #: macro-round's worth of admissions is the natural horizon
+        self._prefetch_lookahead = max(
+            32, sum(b.R for b in pool.buckets.values())
+        )
+        self.prefetch_wasted = 0  # harvested but stale/superseded
+        self.prefetch_missed = 0  # planned but dropped (chaos kind)
+        self.limbo_pulls = 0  # same-round victim→promotion conversions
         self._last_occ = 0.0
         self._last_queue = 0
         n_sh = pool.n_sh
@@ -606,6 +634,7 @@ class FleetScheduler:
         refill paths to come) opens a FRESH request context instead of
         being double-counted under its old one (the PR 6 ``_admit_t``
         doc-keyed scheme's bug, pinned by tests)."""
+        self._ended.add(st.doc_id)
         if tag is None:
             if st.lossy:
                 tag = "shed"
@@ -622,10 +651,35 @@ class FleetScheduler:
 
     def _select(self, plan: _Plan) -> None:
         """Pick this macro-round's lanes: {class: [_Lane]}, bounded by
-        each bucket's row count, in round-robin order."""
+        each bucket's row count, in round-robin order.
+
+        Early exit: once EVERY capacity class's lane set is full, no
+        remaining doc can be scheduled this round whatever its class —
+        the rest of the rotation stays in place (order preserved) and
+        counts as waiting.  On a fleet many times the hot-row budget
+        this turns the per-round scan from O(fleet) into O(selected +
+        the prefix that filled the buckets)."""
         scheduled: list[int] = []
         deferred: list[int] = []
+        open_classes = {
+            c for c in self.pool.classes if self.pool.buckets[c].R > 0
+        }
+        popped_live = 0  # arrived, undrained docs this scan handled
         while self._rr:
+            if not open_classes:
+                # every class is full: nothing in the unscanned tail
+                # can schedule.  Its waiting share is the docs that
+                # have ARRIVED and not drained — derived O(1) from the
+                # static arrival schedule minus the ended set and the
+                # live docs this scan already accounted, so the metric
+                # matches what a full scan would have counted.
+                arrived = int(np.searchsorted(
+                    self._arrivals_sorted, self.round, side="right"
+                ))
+                plan.waiting += max(
+                    0, arrived - len(self._ended) - popped_live
+                )
+                break
             doc_id = self._rr.popleft()
             st = self.streams[doc_id]
             self._deliver(st)
@@ -635,6 +689,7 @@ class FleetScheduler:
             if st.arrival > self.round:
                 deferred.append(doc_id)
                 continue
+            popped_live += 1
             if st.n_sched <= st.cursor:
                 # bounded queue empty under backpressure: wait a round
                 plan.waiting += 1
@@ -661,15 +716,19 @@ class FleetScheduler:
             if len(lanes) >= self.pool.buckets[cls].R:
                 plan.waiting += 1
                 deferred.append(doc_id)
+                open_classes.discard(cls)
                 continue
             lanes.append(_Lane(stream=st, takes=takes, end=end))
+            if len(lanes) >= self.pool.buckets[cls].R:
+                open_classes.discard(cls)
             # the admission edge: one request context per episode
             # (G012 allows context creation here, in the per-DOC
             # selection loop — never in per-op inner loops)
             self.reqtrace.open_request(doc_id, self.round, cap_cls=cls)
             scheduled.append(doc_id)
-        # rotation: scheduled docs go to the back; deferred keep order.
-        self._rr.extend(deferred)
+        # rotation: scheduled docs go to the back; deferred (and any
+        # unscanned early-exit tail, already in place) keep order.
+        self._rr.extendleft(reversed(deferred))
         self._rr.extend(scheduled)
 
     def _pick_victim(self, cls: int, selected: set[int],
@@ -728,21 +787,51 @@ class FleetScheduler:
                     b_old.release_row(rec.row)
                     rec.cls = rec.row = None
                     pool.promotions += 1
+                elif lane.stream.doc_id in plan.limbo:
+                    # evicted as a SMALLER class's victim earlier this
+                    # same round (warm mode defers the deposit to the
+                    # boundary, so unlike the two-tier path no spool
+                    # marks the state): its bytes still live in the old
+                    # bucket row until the moves execute — convert the
+                    # eviction into a direct same-round pull, exactly a
+                    # promotion (the pre-compose snapshot rule makes
+                    # the vacated row safe to read)
+                    src = plan.limbo.pop(lane.stream.doc_id)
+                    plan.cancelled_evictions.add(lane.stream.doc_id)
+                    pending.append((i, ("pull", *src)))
+                    pool.promotions += 1
+                    self.limbo_pulls += 1
+                elif lane.stream.doc_id in pool.warm:
+                    # warm hit: the entry composes in at the boundary —
+                    # no disk I/O.  Taken NOW (plan time) so nothing
+                    # between plan and execute can demote it under us.
+                    entry = pool.take_warm_hit(lane.stream.doc_id)
+                    pending.append((i, ("warm", entry)))
                 elif rec.spool is not None:
                     pending.append((i, ("spool", rec.spool)))
-                    rec.spool = None
+                    pool._set_spool(rec, None)
                     pool.restores += 1
                 else:
                     pending.append((i, ("fresh",)))
                     pool.fresh_admits += 1
                 self.stats.admissions += 1
-            # make room: one victim per missing free row
+            # make room: one victim per missing free row.  With the
+            # warm tier armed the victim's row lands there at the
+            # boundary (no spool write); the two-tier pool keeps the
+            # historical direct-to-spool path.
+            warm_mode = pool.warm.budget > 0
             while b.n_free < len(pending):
                 victim = self._pick_victim(cls, selected, selected_all)
                 vrec = pool.docs[victim]
                 plan.evictions.append((victim, cls, vrec.row))
                 plan.pull_classes.add(cls)
-                vrec.spool = pool._spool_path(victim)
+                if not warm_mode:
+                    pool._set_spool(vrec, pool._spool_path(victim))
+                else:
+                    # the state stays in the old row until the moves:
+                    # a later (larger) class selecting this doc THIS
+                    # round pulls it from there instead of fresh
+                    plan.limbo[victim] = (cls, vrec.row)
                 b.rows[vrec.row] = None
                 b.release_row(vrec.row)
                 vrec.cls = vrec.row = None
@@ -957,6 +1046,135 @@ class FleetScheduler:
                 policy=self.overflow_policy, shed=shed)
         ev.recover()  # the decision IS the recovery
 
+    # ---- predictive prefetch (cold→warm ahead of the admission plan;
+    # every hot-thread touch here is non-blocking by contract, G016) --
+
+    def _harvest_prefetch(self) -> None:
+        """Adopt completed rehydrates into the warm tier (start of
+        round, before planning — so this round's admissions see them).
+        Stale payloads — the doc went hot/warm while the read flew, or
+        its spool generation moved — are dropped and counted; the doc
+        simply stays on whatever path it took without the prefetcher."""
+        pf = self.pool.prefetcher
+        if pf is None:
+            return
+        for payload in pf.drain():
+            doc_id = payload["doc"]
+            self._prefetch_inflight.pop(doc_id, None)
+            if payload["error"] is not None:
+                # damaged/vanished spool: the synchronous admission
+                # path owns detection + heal; nothing to do here
+                continue
+            if not self.pool.store_prefetched(
+                doc_id, payload["row"], payload["length"],
+                payload["nvis"], round_no=self.round,
+                gen=payload["gen"],
+            ):
+                self.prefetch_wasted += 1  # superseded (went hot/warm
+                # on its own, or the read raced a re-eviction)
+
+    def _plan_prefetch(self) -> None:
+        """Submit the next admission horizon's cold docs for async
+        rehydrate: the front of the round-robin rotation IS the
+        scheduler's look-ahead plan (deterministic order), bounded by
+        the arrival model (docs arriving within the next macro-round's
+        span).  The ``prefetch_miss`` chaos kind drops the whole
+        planned batch — admission then takes the synchronous path,
+        which must stay verify-green."""
+        pf = self.pool.prefetcher
+        if pf is None:
+            return
+        pool = self.pool
+        horizon = self.round + self._k_round
+        # reap in-flight entries whose results never arrived (dropped
+        # by the worker's bounded publish during a wedged round): left
+        # in place they would pin the submission budget forever
+        reap_before = self.round - 32 * max(1, self._k_round)
+        stale = [
+            d for d, r0 in self._prefetch_inflight.items()
+            if r0 < reap_before
+        ]
+        if stale:
+            for d in stale:
+                del self._prefetch_inflight[d]
+            pf.note_lost(len(stale))
+        # outstanding work is bounded by the admission horizon AND the
+        # worker's queue capacity (never more reads in flight than the
+        # result queue can absorb), NOT by warm free space: a full
+        # tier makes room for predicted docs by demoting its stalest
+        # entries (store_prefetched)
+        space = min(self._prefetch_lookahead, pool.warm.budget,
+                    pf.capacity) - len(self._prefetch_inflight)
+        wanted: list[tuple[int, str, int]] = []
+        scanned = 0
+        for doc_id in self._rr:
+            scanned += 1
+            if scanned > self._prefetch_lookahead or len(wanted) >= space:
+                break
+            rec = pool.docs[doc_id]
+            if rec.spool is None or rec.cls is not None \
+                    or doc_id in pool.warm \
+                    or doc_id in self._prefetch_inflight:
+                continue
+            st = self.streams[doc_id]
+            if st.remaining == 0 or st.arrival > horizon:
+                continue
+            wanted.append((doc_id, rec.spool, pool.spool_gen(doc_id)))
+        if not wanted:
+            return
+        if self.faults is not None:
+            ev = self.faults.prefetch_miss_event(self.round)
+            if ev is not None:
+                # the planned prefetches are DROPPED: admission falls
+                # back to synchronous rehydrate (the G016 contract —
+                # a miss never blocks, it just pays the disk read)
+                self.prefetch_missed += len(wanted)
+                self.stats.faults_injected += 1
+                ev.fire(self.round, dropped=len(wanted))
+                ev.recover()  # the sync fallback IS the recovery
+                self._note_fault()
+                if self.telemetry is not None:
+                    self.telemetry.note_event(
+                        "tier", why="prefetch_miss", round=self.round,
+                        dropped=len(wanted),
+                    )
+                return
+        for doc_id, path, gen in wanted:
+            if pf.submit(doc_id, path, gen):
+                self._prefetch_inflight[doc_id] = self.round
+
+    def _fire_tier_pressure(self) -> None:
+        """The ``tier_evict_pressure`` chaos kind: force warm-tier
+        churn under load — LRU entries demoted to the compressed cold
+        spool so following admissions pay the cold path (and the
+        prefetcher has real misses to hide).  Pending until the warm
+        tier holds anything.  The poll stays open (a per-round no-op
+        fence crossing would drown the counters — the _maybe_snapshot
+        lesson); only the actual demotion below is the fence."""
+        ev = self.faults.tier_pressure_event(self.round)
+        if ev is None:
+            return
+        if not len(self.pool.warm):
+            return  # stays pending; retried next round
+        self._tier_pressure_barrier(ev)
+
+    @fenced
+    def _tier_pressure_barrier(self, ev) -> None:  # graftlint: fence=chaos
+        """Execute one forced warm→cold churn event (compressed spool
+        writes for unshadowed LRU entries — disk work, hence the
+        declared chaos fence, like the spool-tear injector)."""
+        n = ev.param or max(1, len(self.pool.warm) // 2)
+        demoted = self.pool.warm_pressure(n)
+        self.stats.faults_injected += 1
+        ev.fire(self.round, demoted=demoted)
+        ev.recover()  # churn is absorbed, not repaired
+        self._note_fault()
+        if self.telemetry is not None:
+            self.telemetry.note_event(
+                "tier", why="evict_pressure", round=self.round,
+                demoted=demoted,
+            )
+
     def _all_residents(self) -> list[tuple[int, int]]:
         return [
             (d, row) for cls in self.pool.classes
@@ -1013,7 +1231,8 @@ class FleetScheduler:
             b.rows[rec.row] = None
             b.release_row(rec.row)
             rec.cls = rec.row = None
-        rec.spool = None
+        self.pool._set_spool(rec, None)
+        self.pool.warm.take(doc_id)  # a quarantined doc holds no tier
         self._dead_lanes.add(doc_id)
         self._note_doc_drained(st, tag="quarantined")
         self.stats.quarantines.append({
@@ -1198,7 +1417,9 @@ class FleetScheduler:
             if healed is None:
                 continue  # quarantined (reported separately)
             row_v, L, nv = healed
-            rec.spool = self.pool.spool_save(doc_id, row_v, L, nv)
+            self.pool._set_spool(
+                rec, self.pool.spool_save(doc_id, row_v, L, nv)
+            )
             e.recover()
 
     # ---- boundary execution (the only device syncs) ----
@@ -1214,10 +1435,32 @@ class FleetScheduler:
         snaps = {
             cls: pool.pull_bucket(cls) for cls in sorted(plan.pull_classes)
         }
+        warm_mode = pool.warm.budget > 0
+        demoted = 0
         for doc_id, cls, row in plan.evictions:
+            if doc_id in plan.cancelled_evictions:
+                continue  # re-admitted this round: the install pulls it
             doc, length, nvis = snaps[cls]
-            pool.spool_save(
-                doc_id, doc[row], int(length[row]), int(nvis[row])
+            if warm_mode:
+                # hot→warm: a trimmed host copy, no disk I/O; LRU
+                # overflow demotes to the compressed cold spool
+                demoted += pool.warm_deposit(
+                    doc_id, doc[row], int(length[row]), int(nvis[row]),
+                    last_sched=pool.docs[doc_id].last_sched,
+                )
+            else:
+                pool.spool_save(
+                    doc_id, doc[row], int(length[row]), int(nvis[row])
+                )
+        if warm_mode:
+            # trim any harvest-time prefetch overflow too: disk writes
+            # belong inside this fence, so store_prefetched defers its
+            # budget enforcement here
+            demoted += pool._enforce_warm_budget()
+        if demoted and self.telemetry is not None:
+            self.telemetry.note_event(
+                "tier", why="warm_overflow", round=self.round,
+                demoted=demoted,
             )
         for cls, items in plan.installs.items():
             if not items:
@@ -1238,6 +1481,14 @@ class FleetScheduler:
                 if source[0] == "fresh":
                     doc_w[row] = _fresh_row_np(C, rec.n_init)
                     len_w[row] = nvis_w[row] = rec.n_init
+                elif source[0] == "warm":
+                    # warm compose: pure memory, no disk I/O
+                    entry = source[1]
+                    L = entry.length
+                    doc_w[row, :L] = entry.doc_row[:L]
+                    doc_w[row, L:] = 2
+                    len_w[row] = L
+                    nvis_w[row] = entry.nvis
                 elif source[0] == "spool":
                     try:
                         st = load_state(source[1])
@@ -1257,7 +1508,10 @@ class FleetScheduler:
                             len_w[row] = L
                             nvis_w[row] = nv
                         continue
-                    os.unlink(source[1])  # rehydrated: bound the spool
+                    # deferred unlink (see DocPool.admit): the spool
+                    # stays on disk as a stale file until the next
+                    # eviction's atomic save_state replaces it — the
+                    # doc is never without a durable copy mid-flight
                     L = int(st.length[0])
                     doc_w[row, :L] = st.doc[0, :L]
                     doc_w[row, L:] = 2
@@ -1345,6 +1599,7 @@ class FleetScheduler:
         if self._bp_round:
             self.stats.backpressure_rounds += 1
             self._bp_round = False
+        self.pool.update_tier_gauges()
         self.round = plan.base_round + max(plan.k_eff.values())
         self._n_rounds += 1
 
@@ -1534,6 +1789,13 @@ class FleetScheduler:
             "snapshots": s.snapshots,
             "done": False,
         }
+        if self.pool.warm.budget > 0:
+            # live tier-residency view (small scalars; the gauges
+            # carry the same numbers on /metrics)
+            res = self.pool.tier_status()
+            res["prefetch_wasted"] = self.prefetch_wasted
+            res["prefetch_missed"] = self.prefetch_missed
+            out["residency"] = res
         if self.journal is not None:
             # live bounded-footprint view: WAL segments, bytes since
             # the last committed barrier, chain depth, last GC round
@@ -1580,9 +1842,14 @@ class FleetScheduler:
             # point entries fold into every scheduled doc's context)
             t0 = time.perf_counter()
             with span("serve.round", round=self.round):
+                # adopt completed prefetches BEFORE planning: this
+                # round's admissions see them as warm hits (no-op
+                # without the tiered pool)
+                self._harvest_prefetch()
                 if self.faults is not None:
                     with span("serve.faults.inject"):
                         self._fire_overflow()
+                        self._fire_tier_pressure()
                 with span("serve.plan"), rt.segment("plan"):
                     plan = self._plan()
                 if plan is None:
@@ -1615,6 +1882,13 @@ class FleetScheduler:
                         self._maybe_stall(plan.base_round)
                 with span("serve.moves"), rt.segment("moves"):
                     self._execute_moves(plan)
+                # submit the NEXT horizon's cold docs now: _select
+                # already rotated the queue (deferred docs lead it), so
+                # the front IS next round's admission order, and the
+                # moves above just demoted this round's warm overflow —
+                # the worker rehydrates while the dispatch below drains
+                # on device (both GIL-releasing)
+                self._plan_prefetch()
                 if self.faults is not None:
                     with span("serve.faults.inject"):
                         self._fire_spool_fault(plan)

@@ -224,6 +224,15 @@ def _fitting_traces(slot_budget: int, window_cap: int | None) -> tuple:
     return tuple(fits)
 
 
+#: Skew exponent for ``arrival_dist="zipf"``: arrivals land at
+#: ``span * u**ZIPF_EXP`` (u uniform), so a dense HEAD of sessions
+#: joins in the first rounds — the live working set tier residency
+#: serves from hot/warm — while a long TAIL trickles in across the
+#: whole span and stays cold until it actually arrives.  The shape the
+#: CRDT-deployment surveys report for real multi-tenant fleets.
+ZIPF_EXP = 3.0
+
+
 def build_fleet(
     n_docs: int,
     mix: str | dict[str, float] = "mixed",
@@ -232,11 +241,16 @@ def build_fleet(
     bands: dict | None = None,
     delivery: str | None = None,
     horizon: int = 1,
+    arrival_dist: str = "uniform",
 ) -> list[Session]:
     """N sessions drawn from the mix's band weights, with arrival rounds
-    staggered uniformly over ``arrival_span`` rounds.  ``mix`` is a name
-    from MIXES or an explicit {band: weight} table; ``bands`` overrides
-    the band sizing table (tests use tiny bands).
+    staggered over ``arrival_span`` rounds — ``arrival_dist="uniform"``
+    spreads them evenly (the historical default),
+    ``arrival_dist="zipf"`` draws them skewed (:data:`ZIPF_EXP`): a
+    dense head of early joiners forms a REAL hot set while the tail
+    trickles in, the access skew that makes a warm tier pay.  ``mix``
+    is a name from MIXES or an explicit {band: weight} table; ``bands``
+    overrides the band sizing table (tests use tiny bands).
     ``delivery="banded"`` attaches each band's :data:`DELIVERY_BURST`
     producer rate to its sessions (consumed by the scheduler's bounded
     admission queue); the default delivers each stream whole.
@@ -257,12 +271,21 @@ def build_fleet(
     if not np.all(w >= 0) or w.sum() <= 0:
         raise ValueError(f"bad mix weights {weights}")
     w = w / w.sum()
+    if arrival_dist not in ("uniform", "zipf"):
+        raise ValueError(
+            f"unknown arrival_dist {arrival_dist!r} "
+            "(expected 'uniform' or 'zipf')"
+        )
     rng = np.random.default_rng(seed)
     band_of = rng.choice(len(names), size=n_docs, p=w)
-    arrivals = (
-        rng.integers(0, arrival_span, size=n_docs)
-        if arrival_span > 1 else np.zeros(n_docs, int)
-    )
+    if arrival_span <= 1:
+        arrivals = np.zeros(n_docs, int)
+    elif arrival_dist == "zipf":
+        arrivals = np.floor(
+            arrival_span * rng.random(n_docs) ** ZIPF_EXP
+        ).astype(int)
+    else:
+        arrivals = rng.integers(0, arrival_span, size=n_docs)
     sessions: list[Session] = []
     trace_rr = 0
     for doc_id in range(n_docs):

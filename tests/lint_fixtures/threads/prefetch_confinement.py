@@ -1,0 +1,49 @@
+"""Seeded confinement hazards of a PREFETCH thread — the minimized
+shape of the tiered-residency rehydrate worker (serve/prefetch.py) with
+each rule's canonical mistake planted next to its legal twin:
+
+- **G014**: the worker appends a freshly-loaded row into a shared list
+  the hot thread's admission reads — a mutable object escaping the
+  prefetch thread with no declared publish point;
+- **G015**: the worker's declared publish point mutates the published
+  payload in place AFTER the swap — a hot-side reader can observe the
+  half-applied handoff;
+- **G016**: the admission walk BLOCKS on the result queue when the
+  warm tier misses — the exact wait the contract forbids (a miss must
+  fall back to a synchronous rehydrate, never park the drain behind
+  the prefetch thread).  The non-blocking twin on the next line stays
+  legal.
+"""
+
+import queue
+
+_RESULTS = queue.Queue()
+
+
+class PrefetchBridge:
+    def __init__(self):
+        self.warm = {}  # hot-owned tier (only the hot thread touches it)
+        self.loaded = []  # shared scratch: the G014 escape below
+        self.latest = {}
+
+    def worker(self) -> None:  # graftlint: thread=prefetch
+        row = {"doc": 7, "bytes": [1, 2, 3]}
+        self.loaded.append(row)  # expect: G014
+        self.publish_row(row)
+
+    def publish_row(self, row: dict) -> None:  # graftlint: publish  # graftlint: thread=prefetch
+        self.latest = {"row": row}  # the legal atomic swap
+        self.latest["seq"] = 1  # expect: G015
+
+    def admit(self, doc_id: int):  # graftlint: hot-path
+        if doc_id in self.warm:
+            return self.warm[doc_id]
+        if not self.loaded:  # reads the escaped list on the hot thread
+            _RESULTS.get()  # expect: G016
+        try:
+            return _RESULTS.get_nowait()  # non-blocking twin: legal
+        except queue.Empty:
+            return self.rehydrate(doc_id)
+
+    def rehydrate(self, doc_id: int) -> dict:
+        return {"doc": doc_id}  # the synchronous fallback path

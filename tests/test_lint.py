@@ -321,6 +321,28 @@ def test_threads_corpus_covers_each_rule_exactly_once_per_hazard():
     assert len(g016) == 5
 
 
+def test_prefetch_thread_confinement_fixture():
+    """The tiered-residency prefetch thread's canonical hazards, one
+    per rule at exact lines: a loaded row escaping the worker into a
+    hot-read list (G014), an in-place mutation inside the declared
+    result publish point (G015), and the admission walk blocking on
+    the result queue (G016 — a warm miss must fall back to the
+    synchronous rehydrate, never wait on the prefetch thread).  The
+    legal twins — the atomic swap, ``get_nowait``, the sync fallback —
+    stay silent."""
+    path = THREADS_DIR / "prefetch_confinement.py"
+    findings = run_lint([str(path)])
+    assert [(f.rule, f.line) for f in findings] == sorted(
+        expected_markers(path), key=lambda rl: rl[1]
+    )
+    assert [(f.rule, f.line) for f in findings] == [
+        ("G014", 31), ("G015", 36), ("G016", 42),
+    ]
+    assert "prefetch" in findings[0].msg  # the owning-thread set named
+    assert "publish point" in findings[1].msg
+    assert "hot thread" in findings[2].msg
+
+
 def test_g017_dead_publish_and_unattributed_counter():
     """G017 mirrors G011 for publish points: a declared point the run
     never entered is flagged at its def line, a ``publish=status`` tag

@@ -1,0 +1,528 @@
+"""Tiered state residency: device-hot / pinned-host-warm /
+compressed-cold DocPool with predictive async prefetch.
+
+Ground truth throughout is the oracle: whatever tier a doc's state
+rides — device rows, warm host arrays, compressed spools, a prefetch
+payload in flight — the decoded bytes must match an uninterrupted
+replay of the same stream."""
+
+import json
+import os
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.bench import parse_tier_spec, run_serve_bench
+from crdt_benches_tpu.serve.faults import FaultEvent, FaultInjector, FaultPlan
+from crdt_benches_tpu.serve.journal import OpJournal, recover_fleet
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, prepare_streams
+from crdt_benches_tpu.serve.workload import build_fleet
+
+TINY_BANDS = {"synth-small": ("synth", (40, 120))}
+TINY_MIX = {"synth-small": 1.0}
+#: two capacity classes actually hosting docs, so the cross-class
+#: parity tests mean something
+TWO_BANDS = {
+    "synth-small": ("synth", (40, 120)),
+    "synth-medium": ("synth", (300, 600)),
+}
+TWO_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+def _fleet(tmp_path, n=8, seed=11, classes=(128,), slots=(2,),
+           warm_docs=4, bands=TINY_BANDS, mix=TINY_MIX, **kw):
+    sessions = build_fleet(
+        n, mix=mix, seed=seed, arrival_span=2, bands=bands
+    )
+    pool = DocPool(classes=classes, slots=slots,
+                   spool_dir=str(tmp_path / "spool"),
+                   warm_docs=warm_docs)
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32, **kw)
+    return sessions, pool, streams, sched
+
+
+def _assert_parity(sessions, pool, streams, skip_lossy=True):
+    for s in sessions:
+        if skip_lossy and streams[s.doc_id].lossy:
+            continue
+        assert pool.decode(s.doc_id) == replay_trace(s.trace), (
+            f"doc {s.doc_id} diverged"
+        )
+
+
+# ---------------------------------------------------------------------------
+# warm tier mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_warm_lru_eviction_order(tmp_path):
+    """Warm overflow demotes strictly least-recently-SCHEDULED first,
+    and the demoted docs land on the compressed cold spool."""
+    sessions, pool, streams, _ = _fleet(tmp_path, n=5, slots=(5,),
+                                        warm_docs=2)
+    rows = {}
+    for i in range(5):
+        pool.admit(i, need=16)
+        rows[i] = pool.docs[i]
+    # distinct recency: doc i last scheduled at round 10 + i
+    for i in range(5):
+        rows[i].last_sched = 10 + i
+    order = []
+    for i in (2, 0, 4, 3, 1):  # deposit order is NOT the LRU order
+        st = pool._pull_row(rows[i])
+        pool._free_row(rows[i])
+        pool.warm_deposit(i, np.asarray(st.doc[0]), int(st.length[0]),
+                          int(st.nvis[0]))
+        order.append(i)
+    # budget 2: three demotions happened, in last_sched order among
+    # what was warm at each overflow
+    assert sorted(d for d in range(5) if pool.docs[d].spool) == [0, 1, 2]
+    assert sorted(pool.warm.entries) == [3, 4]  # the most recent two
+    assert pool.warm_evictions == 3
+    # cold writes are COMPRESSED (warm→hot stays memory-only)
+    with zipfile.ZipFile(pool.docs[0].spool) as z:
+        assert all(i.compress_type == zipfile.ZIP_DEFLATED
+                   for i in z.infolist())
+
+
+def test_warm_hit_skips_disk_and_decodes(tmp_path):
+    """Evict→warm→admit round-trips through memory only: the doc comes
+    back byte-identical with zero cold restores."""
+    sessions, pool, streams, sched = _fleet(tmp_path, n=3, slots=(3,),
+                                            warm_docs=4)
+    sched.run(max_rounds=2)
+    doc_id = next(d for d, r in pool.residents(128))
+    rec = pool.docs[doc_id]
+    before = pool.decode(doc_id)
+    st = pool._pull_row(rec)
+    pool._free_row(rec)
+    pool.warm_deposit(doc_id, np.asarray(st.doc[0]), int(st.length[0]),
+                      int(st.nvis[0]))
+    assert doc_id in pool.warm and rec.spool is None
+    assert pool.decode(doc_id) == before  # decode reads the warm tier
+    pool.admit(doc_id, need=rec.length)
+    assert pool.decode(doc_id) == before
+    assert pool.warm_hits == 1 and pool.restores == 0
+
+
+def test_mid_macro_round_evict_to_warm_restore_round_trip(tmp_path):
+    """An oversubscribed drain with the warm tier big enough to hold
+    every eviction: docs cycle hot→warm→hot across macro-rounds with
+    NO disk restores, and every doc drains byte-identical."""
+    sessions, pool, streams, sched = _fleet(tmp_path, n=6, slots=(2,),
+                                            warm_docs=16)
+    sched.run()
+    assert sched.done
+    assert pool.evictions > 0
+    assert pool.warm_hits > 0  # evicted docs came back from warm
+    assert pool.restores == 0  # ...never from disk
+    assert pool.warm_evictions == 0
+    _assert_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_two_tier_pool_unchanged_without_warm_budget(tmp_path):
+    """warm_docs=0 (the default) is exactly the historical two-tier
+    pool: evictions spool straight to disk, uncompressed, no prefetch
+    thread."""
+    sessions, pool, streams, sched = _fleet(tmp_path, n=6, slots=(2,),
+                                            warm_docs=0)
+    assert pool.prefetcher is None
+    sched.run()
+    assert sched.done
+    assert pool.warm_hits == 0 and len(pool.warm) == 0
+    assert pool.restores > 0  # the spool round-trips still happened
+    _assert_parity(sessions, pool, streams, skip_lossy=False)
+
+
+def test_same_round_victim_promotion_keeps_state(tmp_path):
+    """Regression: a doc evicted as a smaller class's victim in the
+    SAME round its promotion installs into a larger class.  The
+    two-tier pool marked the victim's spool at plan time; warm mode
+    defers the deposit to the boundary, so without the plan's limbo
+    tracking the later class saw a state-less doc and installed it
+    FRESH — silently losing its whole edit history (caught by the
+    oracle on the first full-mix tier run)."""
+    sessions = build_fleet(
+        12, mix={"synth-medium": 1.0}, seed=4, arrival_span=2,
+        bands={"synth-medium": ("synth", (300, 600))},
+    )
+    pool = DocPool(classes=(128, 512, 1024), slots=(3, 2, 2),
+                   spool_dir=str(tmp_path / "spool"), warm_docs=4)
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32)
+    sched.run()
+    assert sched.done
+    assert pool.promotions > 0 and pool.evictions > 0
+    assert sched.limbo_pulls > 0, (
+        "test setup: no same-round victim→promotion collision occurred"
+    )
+    # no doc may ever hold two tiers at once
+    for d, rec in pool.docs.items():
+        tiers = [rec.cls is not None, d in pool.warm,
+                 rec.spool is not None]
+        assert sum(tiers) <= 1, (d, tiers)
+    # the O(1) cold counter never drifted from ground truth across
+    # all the churn above (every rec.spool transition is audited)
+    n = pool.cold_docs
+    assert n == pool.recount_cold(), "cold counter drifted"
+    _assert_parity(sessions, pool, streams, skip_lossy=False)
+
+
+# ---------------------------------------------------------------------------
+# deferred spool unlink (the crash-window fix)
+# ---------------------------------------------------------------------------
+
+
+def test_rehydrate_keeps_spool_until_resident(tmp_path, monkeypatch):
+    """The crash window: a rehydrate that dies between the spool read
+    and the install must leave the doc's only durable copy intact —
+    the unlink is deferred until the doc is resident and
+    dirty-tracked.  (The historical order unlinked first: an install
+    crash stranded the doc with neither device state nor spool.)"""
+    sessions, pool, streams, sched = _fleet(tmp_path, n=2, slots=(2,),
+                                            warm_docs=0)
+    sched.run(max_rounds=2)
+    doc_id = next(d for d, r in pool.residents(128))
+    before = pool.decode(doc_id)
+    spool = pool.evict(doc_id)
+    rec = pool.docs[doc_id]
+    assert os.path.exists(spool) and rec.spool == spool
+
+    boom = RuntimeError("install died mid-rehydrate")
+
+    def dead_install(*a, **kw):
+        raise boom
+
+    monkeypatch.setattr(pool, "_install", dead_install)
+    with pytest.raises(RuntimeError, match="mid-rehydrate"):
+        pool.admit(doc_id, need=rec.length)
+    # the durable copy survived the crash window
+    assert rec.spool == spool and os.path.exists(spool)
+    assert pool.decode(doc_id) == before
+    monkeypatch.undo()
+    cls, row = pool.admit(doc_id, need=rec.length)
+    assert rec.cls == cls and rec.spool is None
+    assert pool.decode(doc_id) == before
+    # the stale file is left behind by design (superseded by the next
+    # eviction's atomic replace), marked stale via rec.spool = None
+    assert os.path.exists(spool)
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+
+def _drain_prefetcher(pf, want: int, timeout=5.0):
+    """Poll the non-blocking harvest until ``want`` payloads arrived."""
+    out = []
+    t0 = time.monotonic()
+    while len(out) < want and time.monotonic() - t0 < timeout:
+        out.extend(pf.drain())
+        time.sleep(0.005)
+    return out
+
+
+def test_prefetch_hit_vs_synchronous_miss_byte_parity(tmp_path):
+    """Across every hosted capacity class: a doc admitted through the
+    prefetch path (cold → worker rehydrate → warm → compose) is
+    byte-identical to the same doc admitted through the synchronous
+    cold path."""
+    sessions = build_fleet(8, mix=TWO_MIX, seed=7, arrival_span=1,
+                           bands=TWO_BANDS)
+    pool = DocPool(classes=(128, 1024), slots=(8, 4),
+                   spool_dir=str(tmp_path / "spool"), warm_docs=8)
+    streams = prepare_streams(sessions, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32)
+    sched.run()  # full drain: medium docs end promoted to class 1024
+    by_cls = {}
+    for cls in (128, 1024):
+        for d, _row in pool.residents(cls):
+            by_cls.setdefault(cls, d)
+    assert len(by_cls) == 2, "fleet setup: both classes must host docs"
+    for cls, doc_id in sorted(by_cls.items()):
+        rec = pool.docs[doc_id]
+        want = pool.decode(doc_id)
+        spool = pool.evict(doc_id)
+        # -- synchronous miss --
+        pool.admit(doc_id, need=rec.length)
+        got_sync = pool.decode(doc_id)
+        spool = pool.evict(doc_id)
+        # -- prefetch hit --
+        pf = pool.prefetcher
+        assert pf.submit(doc_id, spool, pool.spool_gen(doc_id))
+        (payload,) = _drain_prefetcher(pf, 1)
+        assert payload["error"] is None and payload["doc"] == doc_id
+        assert pool.store_prefetched(
+            payload["doc"], payload["row"], payload["length"],
+            payload["nvis"], round_no=0,
+        )
+        assert doc_id in pool.warm
+        pool.admit(doc_id, need=rec.length)
+        got_pf = pool.decode(doc_id)
+        assert got_sync == got_pf == want
+    assert pool.prefetch_hits == 2
+    pool.close()
+    assert not pool.prefetcher.alive
+
+
+def test_stale_prefetch_payload_is_dropped(tmp_path):
+    """A prefetch read that raced a re-eviction (spool generation
+    moved) must be rejected at store time — the superseded bytes never
+    reach the warm tier."""
+    sessions, pool, streams, sched = _fleet(tmp_path, n=2, slots=(2,),
+                                            warm_docs=4)
+    sched.run(max_rounds=2)
+    doc_id = next(d for d, r in pool.residents(128))
+    rec = pool.docs[doc_id]
+    spool = pool.evict(doc_id)
+    gen = pool.spool_gen(doc_id)
+    pf = pool.prefetcher
+    assert pf.submit(doc_id, spool, gen)
+    (payload,) = _drain_prefetcher(pf, 1)
+    # the doc advances: rehydrate, (pretend to) apply, re-evict
+    pool.admit(doc_id, need=rec.length)
+    pool.evict(doc_id)
+    assert pool.spool_gen(doc_id) != payload["gen"]
+    # generation mismatch = dropped: the superseded bytes never land
+    assert payload["gen"] == gen
+    assert not pool.store_prefetched(
+        payload["doc"], payload["row"], payload["length"],
+        payload["nvis"], round_no=0, gen=payload["gen"],
+    )
+    assert doc_id not in pool.warm
+    assert rec.spool is not None  # the CURRENT durable copy survives
+
+
+def test_scheduled_drain_prefetches_under_pressure(tmp_path):
+    """An oversubscribed drain with a warm tier smaller than the
+    pending set: the prefetcher must actually run (submissions +
+    publish-point entries) and the drain stays byte-exact whatever
+    mix of warm hits and synchronous misses admission took."""
+    from crdt_benches_tpu.lint import race_sanitizer
+
+    race_sanitizer.reset_counters()
+    sessions, pool, streams, sched = _fleet(tmp_path, n=10, slots=(3,),
+                                            warm_docs=3, seed=5)
+    sched.run()
+    assert sched.done
+    pf = pool.prefetcher
+    assert pf.submitted > 0
+    assert pf.harvested == pf.submitted
+    counts = race_sanitizer.counters()
+    assert counts["publishes"].get("Prefetcher._publish", 0) > 0
+    _assert_parity(sessions, pool, streams, skip_lossy=False)
+
+
+# ---------------------------------------------------------------------------
+# chaos kinds
+# ---------------------------------------------------------------------------
+
+
+def test_tier_chaos_kinds_fire_and_recover(tmp_path):
+    """``tier_evict_pressure`` forces warm→cold churn mid-drain and
+    ``prefetch_miss`` drops a planned prefetch batch; both must fire,
+    recover, and leave the fleet byte-identical (admission's
+    synchronous fallback is the designed recovery)."""
+    plan = FaultPlan([
+        FaultEvent(kind="tier_evict_pressure", round=2),
+        FaultEvent(kind="prefetch_miss", round=2),
+    ], seed=3)
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, n=10, slots=(3,), warm_docs=3, seed=5,
+        faults=FaultInjector(plan),
+    )
+    sched.run()
+    assert sched.done
+    by_kind = {e.kind: e for e in plan.events}
+    ev_p = by_kind["tier_evict_pressure"]
+    assert ev_p.fired and ev_p.recovered and ev_p.detail["demoted"] >= 1
+    ev_m = by_kind["prefetch_miss"]
+    assert ev_m.fired and ev_m.recovered and ev_m.detail["dropped"] >= 1
+    assert sched.prefetch_missed >= 1
+    assert pool.warm_evictions >= ev_p.detail["demoted"]
+    _assert_parity(sessions, pool, streams, skip_lossy=False)
+
+
+# ---------------------------------------------------------------------------
+# journal / snapshot / recovery: one residency story
+# ---------------------------------------------------------------------------
+
+
+def test_recover_fleet_across_all_three_tiers(tmp_path):
+    """A snapshot barrier over a fleet split hot/warm/cold restores
+    EVERY tier through one composed path: warm members ride the
+    barrier as shadow spool members, recovery puts them back in the
+    warm tier, and the resumed drain ends byte-identical."""
+    jd = str(tmp_path / "journal")
+    sessions, pool, streams, sched = _fleet(
+        tmp_path, n=9, slots=(3,), warm_docs=3, seed=13,
+        journal=OpJournal(jd), snapshot_every=2,
+    )
+    # drain partway: with 9 docs on 3 rows and warm budget 3, the
+    # fleet is genuinely split across tiers mid-drain
+    sched.run(max_rounds=5)
+    assert not sched.done
+    hot = sum(1 for r in pool.docs.values() if r.cls is not None)
+    warm = len(pool.warm)
+    cold = pool.cold_docs
+    assert hot and warm and cold, (hot, warm, cold)
+    assert sched.stats.snapshots >= 1
+    sched.journal.close()
+
+    # the crash: fresh pool + streams from nothing but the journal dir
+    pool2 = DocPool(classes=(128,), slots=(3,),
+                    spool_dir=str(tmp_path / "spool2"), warm_docs=3)
+    streams2 = prepare_streams(sessions, pool2, batch=8, batch_chars=32)
+    rep = recover_fleet(pool2, streams2, jd)
+    assert rep.snapshot_round >= 0
+    assert rep.warm_restored >= 1  # warm residency came back as warm
+    assert len(pool2.warm) >= 1
+    assert rep.docs_restored >= 1
+    sched2 = FleetScheduler(pool2, streams2, batch=8, macro_k=4,
+                            batch_chars=32,
+                            start_round=rep.resume_round)
+    sched2.run()
+    assert sched2.done
+    _assert_parity(sessions, pool2, streams2, skip_lossy=False)
+    pool.close()
+    pool2.close()
+
+
+def test_snapshot_shadows_make_second_barrier_free(tmp_path):
+    """Warm entries are immutable, so the shadow written for barrier N
+    is reused (hard-linked) by barrier N+1 — the second barrier does
+    not rewrite unchanged warm members."""
+    from crdt_benches_tpu.serve.journal import write_snapshot
+
+    jd = str(tmp_path / "journal")
+    os.makedirs(jd)
+    sessions, pool, streams, sched = _fleet(tmp_path, n=4, slots=(4,),
+                                            warm_docs=4)
+    sched.run(max_rounds=2)
+    doc_id = next(d for d, r in pool.residents(128))
+    rec = pool.docs[doc_id]
+    st = pool._pull_row(rec)
+    pool._free_row(rec)
+    pool.warm_deposit(doc_id, np.asarray(st.doc[0]), int(st.length[0]),
+                      int(st.nvis[0]))
+    d1, m1 = write_snapshot(jd, pool, streams, 10, kind="full")
+    shadow = pool.warm.entries[doc_id].shadow
+    assert shadow is not None and os.path.exists(shadow)
+    assert str(doc_id) in m1["warm"]
+    ino1 = os.stat(os.path.join(d1, m1["warm"][str(doc_id)])).st_ino
+    d2, m2 = write_snapshot(jd, pool, streams, 20, kind="full")
+    ino2 = os.stat(os.path.join(d2, m2["warm"][str(doc_id)])).st_ino
+    assert pool.warm.entries[doc_id].shadow == shadow
+    assert ino1 == ino2 == os.stat(shadow).st_ino  # one inode, linked
+
+
+# ---------------------------------------------------------------------------
+# bench surface: --serve-tiers grammar, residency block, gauges
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tier_spec_grammar():
+    slots = (2048, 512, 128, 32, 16)
+    scaled, warm = parse_tier_spec("hot=1024,warm=4096", slots)
+    assert warm == 4096
+    assert all(s >= 2 for s in scaled)
+    assert abs(sum(scaled) - 1024) <= len(slots) * 2  # ~proportional
+    # warm alone keeps the explicit slot table
+    same, warm2 = parse_tier_spec("warm=64", slots)
+    assert same == slots and warm2 == 64
+    with pytest.raises(ValueError, match="warm=DOCS"):
+        parse_tier_spec("hot=64", slots)
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_tier_spec("lukewarm=3", slots)
+    with pytest.raises(ValueError, match="floor"):
+        parse_tier_spec("hot=4,warm=8", slots)
+
+
+def test_zipf_arrival_dist_is_skewed_and_deterministic():
+    a = build_fleet(400, mix=TINY_MIX, seed=9, bands=TINY_BANDS,
+                    arrival_span=16, arrival_dist="zipf")
+    b = build_fleet(400, mix=TINY_MIX, seed=9, bands=TINY_BANDS,
+                    arrival_span=16, arrival_dist="zipf")
+    assert [s.arrival for s in a] == [s.arrival for s in b]
+    arrivals = np.array([s.arrival for s in a])
+    assert arrivals.max() >= 8  # the tail really spans the window
+    # the head is dense: far more than the uniform share arrives at 0
+    assert (arrivals == 0).mean() > 2.5 / 16
+    with pytest.raises(ValueError, match="arrival_dist"):
+        build_fleet(4, mix=TINY_MIX, bands=TINY_BANDS,
+                    arrival_dist="pareto")
+
+
+def test_bench_residency_block_gauges_and_chaos_gate(tmp_path):
+    """run_serve_bench under --serve-tiers: the artifact carries the
+    versioned residency block (hit accounting + prefetch counters),
+    the tier gauges land in the metrics registry, the status surface
+    carries the residency dict, and the tier chaos kinds pass the
+    chaos gate."""
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=10, batch=8,
+        classes=(128,), slots=(16,), seed=5, arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS, macro_k=4, batch_chars=32,
+        serve_tiers="hot=3,warm=3",
+        faults="seed=3,span=3,tier_evict_pressure=1,prefetch_miss=1",
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"] and info["faults_ok"]
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    ex = d["extra"]
+    assert d["trace"] == "tier/custom"  # serve/tier/<mix>/<fleet> ids
+    res = ex["residency"]
+    assert res["version"] == 1 and res["warm_budget"] == 3
+    assert res["warm_hits"] + res["cold_restores"] > 0
+    assert res["hit_rate"] is not None
+    assert res["prefetch_submitted"] >= 0
+    g = ex["metrics"]["gauges"]
+    for name in ("serve.tier.hot_rows", "serve.tier.warm_docs",
+                 "serve.tier.cold_docs", "serve.tier.prefetch_inflight"):
+        assert name in g, (name, sorted(g))
+    c = ex["metrics"]["counters"]
+    for name in ("serve.tier.warm_hits", "serve.tier.warm_evictions",
+                 "serve.tier.prefetch_hits"):
+        assert name in c, (name, sorted(c))
+    kinds = {e["kind"]: e for e in ex["faults"]["events"]}
+    assert kinds["tier_evict_pressure"]["fired"]
+    assert kinds["prefetch_miss"]["fired"]
+    # the prefetch publish surface is armed in the crossings block
+    assert ex["thread_crossings"]["prefetch"] is True
+
+
+def test_tier_fault_kinds_require_tiers(tmp_path):
+    with pytest.raises(ValueError, match="serve-tiers"):
+        run_serve_bench(
+            mix=TINY_MIX, n_docs=4, bands=TINY_BANDS,
+            classes=(128,), slots=(4,),
+            faults="tier_evict_pressure=1",
+            spool_dir=str(tmp_path / "spool"),
+            results_dir=str(tmp_path / "results"),
+            log=lambda *_: None,
+        )
+
+
+def test_status_fields_carry_residency(tmp_path):
+    sessions, pool, streams, sched = _fleet(tmp_path, n=6, slots=(2,),
+                                            warm_docs=3)
+    sched.run(max_rounds=3)
+    out = sched.status_fields()
+    res = out["residency"]
+    assert res["warm_budget"] == 3
+    assert res["warm_docs"] == len(pool.warm)
+    assert res["hot_rows"] == pool.hot_rows
+    assert res["cold_docs"] == pool.cold_docs
+    assert json.dumps(res)  # plain scalars only (the status contract)

@@ -127,9 +127,24 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: blocks.
 #: ``recovery`` is the durability v2 measured-RTO block (runs with the
 #: recovery leg armed).
+#: ``residency`` is the tiered-residency block (``--serve-tiers``
+#: runs) — skip-with-note in BOTH directions: a tier run diffed
+#: against a flat baseline (or vice versa) is a schema difference,
+#: never an error.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
-                    "recovery")
+                    "recovery", "residency")
+
+
+def _tier_hit_rate(extra: dict) -> float | None:
+    """Warm+prefetch hit rate from the ``residency`` block: of the
+    admissions that needed a doc's state back, the fraction that
+    avoided a synchronous cold read.  None when the artifact predates
+    the block, ran flat, or saw no re-admissions."""
+    res = extra.get("residency")
+    if not isinstance(res, dict):
+        return None
+    return res.get("hit_rate")
 
 
 def _recover_ms(extra: dict) -> float | None:
@@ -265,7 +280,8 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_drain_p999_regress: float = 75.0,
             max_slo_regress: float = 5.0,
             max_recover_regress: float = 75.0,
-            max_journal_disk_regress: float = 40.0) -> list[Check]:
+            max_journal_disk_regress: float = 40.0,
+            max_hit_rate_regress: float = 25.0) -> list[Check]:
     checks = [
         _regress(
             "throughput (patches/s)",
@@ -329,6 +345,17 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             skip_note="journal disk footprint missing in at least one "
                       "artifact",
         ),
+        # tiered residency, one-sided like timeseries: the warm+
+        # prefetch hit rate — a prefetcher that stopped predicting (or
+        # a warm tier that started thrashing) fails here before the
+        # throughput gate can even see it
+        _regress(
+            "tier warm+prefetch hit rate",
+            _tier_hit_rate(new), _tier_hit_rate(base),
+            max_hit_rate_regress, higher_is_better=True,
+            skip_note="residency hit rate missing in at least one "
+                      "artifact",
+        ),
     ]
     checks.extend(_block_presence_checks(new, base))
     return checks
@@ -379,6 +406,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="max tolerated recover_fleet wall-time "
                          "increase (recovery block; ms-scale host "
                          "work jitters, the default is loose)")
+    ap.add_argument("--max-hit-rate-regress", type=float, default=25.0,
+                    metavar="PCT",
+                    help="max tolerated drop of the tiered pool's "
+                         "warm+prefetch hit rate (checked only when "
+                         "both artifacts carry a residency block)")
     ap.add_argument("--max-journal-disk-regress", type=float,
                     default=40.0, metavar="PCT",
                     help="max tolerated growth of the on-disk journal "
@@ -407,6 +439,7 @@ def main(argv: list[str] | None = None) -> int:
         max_slo_regress=args.max_slo_regress,
         max_recover_regress=args.max_recover_regress,
         max_journal_disk_regress=args.max_journal_disk_regress,
+        max_hit_rate_regress=args.max_hit_rate_regress,
     )
     failed = [c for c in checks if c.status == "fail"]
     if args.json:

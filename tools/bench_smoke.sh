@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul]   (repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier]   (repo root)
 #
 # The serve family (the default) drains a tiny document fleet through the
 # macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
@@ -22,6 +22,17 @@
 # status server + time-series stream armed, scrapes /healthz +
 # /status.json + /metrics mid-run, and fails on any scrape error or any
 # anomaly at all.
+#
+# The serve-tier family is the TIERED-RESIDENCY smoke: a fleet many
+# times its device-row budget (--serve-tiers hot=14,warm=6 against 40
+# docs) drained race-sanitized with the async prefetch thread live and
+# both tier chaos kinds armed (forced warm-tier churn + dropped
+# prefetch batches), gated by bench_compare against the committed
+# bench_results/serve_tier_baseline.json (throughput + the warm/
+# prefetch hit rate) and by G017 against the prefetch publish surface.
+# It exits NONZERO on a verify failure, an unfired/unrecovered tier
+# fault, a missing residency/hit-rate block, or an undeclared
+# cross-thread handoff.
 #
 # The serve-longhaul family is the DURABILITY smoke (durability v2): a
 # short longhaul drain (journal + delta snapshot chains + segmented WAL
@@ -529,8 +540,89 @@ print(f"longhaul crash smoke: crash_compact + delta_corrupt fired and "
       f"{rec['journal_disk_bytes']} B on disk, oracle verify green")
 PYEOF
     ;;
+  serve-tier)
+    # Tiered-residency smoke: 40 docs on a ~14-row hot budget with a
+    # 6-doc warm tier — real tier traffic by construction (hot→warm
+    # evictions every round, warm→cold LRU demotions, prefetch
+    # rehydrates ahead of the rotation) — run RACE-SANITIZED so the
+    # prefetch thread's bounded-queue handoff is proven at its declared
+    # publish point, with both tier chaos kinds armed and the journal
+    # on so snapshot barriers compose warm shadows.  The zipf arrival
+    # skew makes the hot set real.  The runner exits nonzero on verify
+    # fail or any unfired/unrecovered fault.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 40 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-tiers hot=14,warm=6 --serve-arrival-dist zipf \
+        --serve-arrival-span 4 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 3 \
+        --serve-faults "seed=3,span=4,tier_evict_pressure=1,prefetch_miss=1" \
+        --serve-save-name serve_tier_smoke
+    # The tier regression gate: throughput + the warm/prefetch hit
+    # rate vs the committed baseline (same recipe).  Thresholds are
+    # loose — a 40-doc drain is compile-dominated — but a prefetcher
+    # that stopped predicting or a thrashing warm tier fails the
+    # hit-rate check regardless of wall-clock noise.
+    python tools/bench_compare.py \
+      bench_results/serve_tier_smoke.json \
+      bench_results/serve_tier_baseline.json \
+      --max-throughput-regress 40 --max-p99-regress 200 \
+      --max-hit-rate-regress 40
+    # ...and the residency block must diff skip-with-note in BOTH
+    # directions against a flat (pre-tier) artifact — a schema
+    # difference, never an error (exit 0, not 2; thresholds are moot,
+    # the two runs are different scales — the point is the schema).
+    python tools/bench_compare.py \
+      bench_results/serve_tier_smoke.json \
+      bench_results/serve_baseline.json \
+      --max-throughput-regress 100 --max-p99-regress 100000 \
+      --max-syncs-regress 100000 --max-drain-p999-regress 100000
+    python tools/bench_compare.py \
+      bench_results/serve_baseline.json \
+      bench_results/serve_tier_smoke.json \
+      --max-throughput-regress 100 --max-p99-regress 100000 \
+      --max-syncs-regress 100000 --max-drain-p999-regress 100000
+    # G017 vs the tier artifact: the only family that arms the
+    # prefetch publish surface — a dead Prefetcher._publish annotation
+    # (or a rogue runtime counter) is invisible everywhere else.
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_tier_smoke.json
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_tier_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+assert x["verify_ok"], "tier smoke failed oracle byte-verify"
+res = x["residency"]
+assert res is not None, "residency block missing from the tier artifact"
+assert res["hit_rate"] is not None, f"hit-rate missing: {res}"
+assert res["warm_hits"] + res["cold_restores"] > 0, res
+assert res["prefetch_submitted"] > 0, f"prefetcher never ran: {res}"
+assert res["warm_evictions"] > 0, f"no warm->cold traffic: {res}"
+f = {e["kind"]: e for e in x["faults"]["events"]}
+assert f["tier_evict_pressure"]["fired"] and f["tier_evict_pressure"]["recovered"], f
+assert f["prefetch_miss"]["fired"] and f["prefetch_miss"]["recovered"], f
+tc = x["thread_crossings"]
+assert tc["sanitized"] and tc["prefetch"], tc
+assert tc["publishes"].get("Prefetcher._publish"), tc
+assert set(tc["crossings"] or {}) <= set(tc["publishes"]), tc
+g = x["metrics"]["gauges"]
+for name in ("serve.tier.hot_rows", "serve.tier.warm_docs",
+             "serve.tier.cold_docs", "serve.tier.prefetch_inflight"):
+    assert name in g, (name, sorted(g))
+print(f"tier smoke: {res['warm_hits']} warm hits "
+      f"({res['prefetch_hits']} prefetched) / {res['cold_restores']} "
+      f"cold restores (hit rate {res['hit_rate']:.3f}), "
+      f"{res['warm_evictions']} warm→cold demotions, both tier chaos "
+      f"kinds fired+recovered, prefetch publish point proven under the "
+      f"race sanitizer ({tc['publishes']['Prefetcher._publish']} entries)")
+PYEOF
+    ;;
   *)
-    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul)" >&2
+    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier)" >&2
     exit 2
     ;;
 esac
