@@ -20,6 +20,14 @@ from .core import (  # noqa: F401
     format_text,
     run_lint,
 )
+from .fs_sanitizer import (  # noqa: F401
+    DurableOrderingError,
+    InjectedCrash,
+    crash_at,
+    durable_protocol,
+    fs_protocol,
+    watch_root,
+)
 from .race_sanitizer import (  # noqa: F401
     SharedProxy,
     UndeclaredCrossThreadAccess,
@@ -40,9 +48,15 @@ __all__ = [
     "REGISTRY",
     "BoundaryContract",
     "BoundaryError",
+    "DurableOrderingError",
+    "InjectedCrash",
     "SharedProxy",
     "UndeclaredCrossThreadAccess",
     "UndeclaredSyncError",
+    "crash_at",
+    "durable_protocol",
+    "fs_protocol",
+    "watch_root",
     "boundary",
     "boundary_table",
     "checks_enabled",

@@ -5,6 +5,7 @@
                                     [--changed] [--fix]
                                     [--sync-artifact bench.json]
                                     [--thread-artifact bench.json]
+                                    [--fs-artifact bench.json]
 
 Exits nonzero when any finding survives suppression (CI gates on this);
 ``--format sarif`` emits SARIF 2.1.0 for CI annotation surfaces with
@@ -29,6 +30,11 @@ declared fences / unattributed runtime fences become findings).
 cross-thread-access counters) is cross-checked against the static
 ``# graftlint: publish`` markers — usually the same artifact file as
 ``--sync-artifact``.
+
+``--fs-artifact`` is G021's: the artifact's ``fs_ops`` block (the fs
+sanitizer's per-protocol entry and op counters) is cross-checked
+against the static ``# graftlint: durable=`` protocol markers — dead
+declared protocols and unattributed runtime fs ops both fail.
 
 ``--boundaries`` dumps the jit-boundary contract registry as JSON by
 importing the package modules that declare them (the only mode that
@@ -123,6 +129,11 @@ def main(argv: list[str] | None = None) -> int:
              "cross-check (thread_crossings block)",
     )
     ap.add_argument(
+        "--fs-artifact", default=None, metavar="JSON",
+        help="serve bench artifact for the G021 durable-protocol "
+             "cross-check (fs_ops block)",
+    )
+    ap.add_argument(
         "--boundaries", action="store_true",
         help="dump the jit-boundary contract registry as JSON and exit",
     )
@@ -175,6 +186,7 @@ def main(argv: list[str] | None = None) -> int:
     findings = run_lint(
         paths, select=select, sync_artifact=args.sync_artifact,
         thread_artifact=args.thread_artifact,
+        fs_artifact=args.fs_artifact,
     )
     out = (
         format_json(findings) if args.format == "json"

@@ -39,6 +39,16 @@ Marker comments (on the ``def`` line):
   armed surface the point rides (``publish=status`` crosses only when
   the live status server runs) and scopes the dead-point accounting
   to artifacts whose run armed it.
+- ``# graftlint: durable=<protocol>`` — the function is a DECLARED
+  member of a multi-step durable commit protocol (``snapshot`` / ``gc``
+  / ``wal`` / ``spool`` / ``flight``).  The crash-consistency rules
+  (G018-G020, lint/fsops.py) build a per-protocol filesystem-effect
+  sequence (write/fsync/replace/link/unlink over path-role symbols)
+  from these declarations and check atomic-commit discipline, durable
+  ordering, and verify-before-trust; the runtime twin
+  (lint/fs_sanitizer.py ``fs_protocol``) counts entries and records
+  the real op sequences, and G021 cross-validates the two like G011
+  does for fences.
 
 Fence tags (``# graftlint: fence=<tag>``) scope the G011 dead-fence
 accounting against serve bench artifacts:
@@ -108,7 +118,7 @@ _SUPPRESS_FILE_RE = re.compile(
     r"#\s*graftlint:\s*disable-file=([A-Z0-9,\s]+)"
 )
 _MARKER_RE = re.compile(
-    r"#\s*graftlint:\s*(hot-path|fence|publish|thread)"
+    r"#\s*graftlint:\s*(hot-path|fence|publish|thread|durable)"
     r"(?:=([a-zA-Z0-9_-]+))?\b"
 )
 
@@ -159,6 +169,8 @@ class FuncInfo:
     publish: bool = False  # declared cross-thread publish point
     publish_tag: str | None = None  # armed-surface tag (e.g. "status")
     thread: str | None = None  # declared owning thread (or class's)
+    durable: bool = False  # declared durable-commit-protocol member
+    protocol: str | None = None  # snapshot|gc|wal|spool|flight
 
     @property
     def params(self) -> list[str]:
@@ -287,6 +299,9 @@ class ModuleInfo:
                 fi.publish_tag = tag
             elif kind == "thread" and tag:
                 fi.thread = tag
+            elif kind == "durable":
+                fi.durable = True
+                fi.protocol = tag
         if fi.thread is None and cls is not None:
             fi.thread = self.class_threads.get(cls)
         for dec in node.decorator_list:
@@ -614,23 +629,28 @@ def build_index(paths: list[str]) -> tuple[PackageIndex, list[Finding]]:
 ARTIFACT_RULES = {
     "G011": ("sync_artifact", "--sync-artifact"),
     "G017": ("thread_artifact", "--thread-artifact"),
+    "G021": ("fs_artifact", "--fs-artifact"),
 }
 
 
 def run_lint(paths: list[str], select: set[str] | None = None,
              sync_artifact: str | None = None,
-             thread_artifact: str | None = None) -> list[Finding]:
+             thread_artifact: str | None = None,
+             fs_artifact: str | None = None) -> list[Finding]:
     """Run the rule suite over ``paths``.  ``sync_artifact`` names a
     serve bench artifact (or raw ``boundary_syncs`` JSON) to enable the
     G011 fence-cost cross-check — without it G011 is skipped (it has no
     runtime ground truth to compare the static fence graph against).
     ``thread_artifact`` is the same for G017's ``thread_crossings``
-    publish-point cross-check (usually the same artifact file)."""
+    publish-point cross-check (usually the same artifact file);
+    ``fs_artifact`` for G021's ``fs_ops`` durable-protocol cross-check
+    (the fs sanitizer's per-protocol op counters)."""
     from . import rules as _rules
 
     artifacts = {
         "sync_artifact": sync_artifact,
         "thread_artifact": thread_artifact,
+        "fs_artifact": fs_artifact,
     }
     index, findings = build_index(paths)
     for rule_id, fn in _rules.RULES.items():

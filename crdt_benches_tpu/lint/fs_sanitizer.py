@@ -1,0 +1,451 @@
+"""Runtime fs sanitizer: the dynamic half of the G018-G020 model, and
+the crash-point injection engine behind the durability stack's
+exhaustive crash-enumeration harness (serve/fscrash.py).
+
+graftlint's crash-consistency rules (lint/fsops.py) prove *statically*
+that every declared durable commit protocol (``# graftlint:
+durable=<protocol>``) follows atomic-commit discipline and durable
+ordering — but the static model trusts the annotations and the
+call-graph walk.  This module supplies the runtime evidence, the same
+architecture as the sync and race sanitizers:
+
+- every declared protocol function routes through :func:`fs_protocol`
+  (keyed by the protocol tag, so runtime counters line up with the
+  static ``durable=`` markers) and counts its **entries** — always, in
+  every mode, one lock-guarded dict increment per protocol run;
+- with ``CRDT_BENCH_SANITIZE_FS=1`` the filesystem surface the static
+  model reasons about is interposed — ``os.replace`` / ``os.rename`` /
+  ``os.link`` / ``os.unlink`` / ``os.fsync`` / ``shutil.rmtree`` plus
+  write-mode ``open`` — and every op touching a **watched root** (the
+  journal + spool directories, registered via :func:`watch_root`) is
+  attributed to the innermost active protocol, building the
+  per-protocol op sequences the serve artifact exports as its
+  ``fs_ops`` block (lint G021 cross-validates that ground truth
+  against the static ``durable=`` markers — dead protocols and
+  unattributed mutating ops both findings, G011's mirror);
+- armed, the G019 durable-ordering invariant is enforced **live**: a
+  destructive op (unlink / rmtree) on a durable path-role (non-``.tmp``
+  under a watched root) inside a protocol entry must be dominated by a
+  committed install (``os.replace``/``os.rename`` to a durable target)
+  or a read of the committed record (the torn-pass-completion form) —
+  anything else raises :class:`DurableOrderingError` at the callsite;
+- :func:`crash_at` injects a **crash** at any mutating-op boundary:
+  the ``i``-th mutating op on a watched root raises
+  :class:`InjectedCrash` *instead of executing*, and every later
+  mutating op is frozen to a silent no-op (a dead process writes
+  nothing — in particular, ``except``/``finally`` cleanup handlers
+  must not get to tidy up the crash window they are being tested on).
+  The harness enumerates ``i`` over the whole recorded sequence and
+  requires byte-verified recovery at every single point.
+
+Disarmed (the default), nothing is interposed — ``os.replace`` is the
+real ``os.replace``, ``open`` is the builtin — and the only cost
+anywhere is the protocol-entry counter bump, exactly the zero-overhead
+contract every sanitizer in this repo keeps.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import os
+import shutil
+import threading
+from contextlib import contextmanager
+
+_ENV = "CRDT_BENCH_SANITIZE_FS"
+
+#: The protocol vocabulary (the static rules reject any other tag).
+KNOWN_PROTOCOLS = ("snapshot", "gc", "wal", "spool", "flight")
+
+#: Ops that change the filesystem — the crash-point boundaries.
+#: ``update`` is an ``r+``-mode open (the WAL torn-tail truncate
+#: repair): it mutates in place, so it is a boundary and frozen
+#: post-crash, and it is NOT a read for G019's witness rule.
+MUTATING_OPS = frozenset(
+    {"write", "append", "update", "replace", "rename", "link",
+     "unlink", "rmtree"}
+)
+#: Ops that destroy a copy (G019's live jurisdiction).
+DESTRUCTIVE_OPS = frozenset({"unlink", "rmtree"})
+#: Ops that commit a staged replacement into its final name.
+COMMIT_OPS = frozenset({"replace", "rename"})
+
+#: Bounded in-memory op log (tests assert exact sequences off it).
+_OP_LOG_CAP = 8192
+
+
+class DurableOrderingError(RuntimeError):
+    """A destructive fs op on a durable path-role fired inside a
+    declared protocol entry before the committed install of its
+    replacement — the static G019 model just met a counterexample."""
+
+
+class InjectedCrash(BaseException):
+    """The simulated kill at one fs-op boundary.  A ``BaseException``
+    on purpose: recovery-relevant cleanup handlers catch ``OSError`` /
+    ``Exception``, and a crash must not be swallowed by the very code
+    whose crash window is under test."""
+
+
+_tls = threading.local()
+#: Crossing counts come from whatever thread runs the protocol (the
+#: prefetch worker rehydrates spools off-thread), so the counter tables
+#: take a real mutex — same reasoning as race_sanitizer._mu.
+_mu = threading.Lock()
+_protocols: dict[str, int] = {}  # entries, counted in EVERY mode
+_ops: dict[str, dict[str, int]] = {}  # tag -> op -> count (armed)
+_unattributed: dict[str, int] = {}  # mutating ops outside any protocol
+_op_log: list[tuple[str | None, str, str]] = []  # (tag, op, basename)
+_op_log_dropped = 0
+
+_watch: list[str] = []
+_installed = False
+_armed = False
+_forced = False  # armed explicitly (crash harness), not via the env
+
+_crash_point: int | None = None
+_mutations = 0
+_crashed = False
+
+
+def sanitizing() -> bool:
+    """True when ``CRDT_BENCH_SANITIZE_FS`` arms the sanitizer.  Read
+    at every protocol entry (not at import) so tests can flip it."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+def watch_root(path: str) -> None:
+    """Register a directory as durable territory: ops on paths under it
+    are attributed (and, armed, enforced + crash-enumerable).  The
+    bench registers the journal dir and the pool's spool dir."""
+    root = os.path.abspath(path)
+    if root not in _watch:
+        _watch.append(root)
+
+
+def clear_watch_roots() -> None:
+    _watch.clear()
+
+
+def _watched(path) -> bool:
+    if not _watch or not isinstance(path, str):
+        return False
+    p = os.path.abspath(path)
+    for root in _watch:
+        if p == root or p.startswith(root + os.sep):
+            return True
+    return False
+
+
+def _durable(path) -> bool:
+    """Path-role classifier, matching the static model: a ``.tmp``
+    anywhere in the path — basename OR any ancestor component (files
+    inside a ``snap_*.tmp`` staging directory are staging too) — is
+    never committed and ignorable after a crash; anything else under a
+    watched root is a durable role."""
+    s = str(path).replace("\\", "/")
+    return not any(".tmp" in part for part in s.split("/"))
+
+
+def reset_counters() -> None:
+    """Zero the counter tables and the op log (each bench run owns its
+    window).  Watch roots survive — they describe the run's layout,
+    not its history.  When the env flag is set, the interposition is
+    installed and armed HERE, eagerly: arming only at the first
+    protocol entry would leave any mutating op on a watched root
+    *before* that entry invisible to the unattributed-op accounting —
+    exactly the op class G021 exists to catch."""
+    global _op_log_dropped, _mutations, _armed
+    if not _forced:
+        if sanitizing():
+            _install()
+            _armed = True
+        else:
+            _armed = False
+    with _mu:
+        _protocols.clear()
+        _ops.clear()
+        _unattributed.clear()
+        _op_log.clear()
+        _op_log_dropped = 0
+        _mutations = 0
+
+
+def counters() -> dict:
+    """Snapshot: ``{"protocols": {tag: entries}, "ops": {tag: {op:
+    n}}, "unattributed": {op: n}}``.  ``protocols`` is populated in
+    every mode (the G021 ground truth); the op tables only while the
+    sanitizer is armed (the interposed surface is what observes
+    individual ops)."""
+    with _mu:
+        return {
+            "protocols": dict(sorted(_protocols.items())),
+            "ops": {
+                tag: dict(sorted(t.items()))
+                for tag, t in sorted(_ops.items())
+            },
+            "unattributed": dict(sorted(_unattributed.items())),
+        }
+
+
+def op_log() -> list[tuple[str | None, str, str]]:
+    """The armed run's ``(protocol, op, basename)`` sequence, bounded
+    at ``_OP_LOG_CAP`` entries (tests assert orderings off it, e.g.
+    fsync-before-replace in the spool protocol)."""
+    with _mu:
+        return list(_op_log)
+
+
+def mutation_count() -> int:
+    """Mutating ops observed on watched roots since the last reset —
+    the crash-enumeration domain size."""
+    with _mu:
+        return _mutations
+
+
+def crashed() -> bool:
+    return _crashed
+
+
+# ---------------------------------------------------------------------------
+# protocol entries
+# ---------------------------------------------------------------------------
+
+
+def _stack() -> list:
+    s = getattr(_tls, "protocols", None)
+    if s is None:
+        s = _tls.protocols = []
+    return s
+
+
+@contextmanager
+def fs_protocol(tag: str):
+    """One declared durable-protocol entry: count it (always — the
+    G021 ground truth), and while inside, every interposed fs op on a
+    watched root is attributed to ``tag`` (innermost wins, like
+    fences).  Arms/disarms the interposition lazily off the env flag
+    so tests can flip it without an import dance."""
+    global _armed
+    if not _forced:
+        if sanitizing():
+            if not _armed:
+                _install()
+                _armed = True
+        elif _armed:
+            _armed = False
+    with _mu:
+        _protocols[tag] = _protocols.get(tag, 0) + 1
+    stack = _stack()
+    stack.append({"tag": tag, "ops": []})
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def durable_protocol(tag: str):
+    """Decorator form of :func:`fs_protocol` (the ``@published``
+    pattern): goes on exactly the functions carrying ``# graftlint:
+    durable=<tag>`` markers so the runtime protocol entries line up
+    with the static declarations — G021 cross-checks that the two sets
+    agree."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def run(*args, **kwargs):
+            with fs_protocol(tag):
+                return fn(*args, **kwargs)
+
+        run.__graft_protocol__ = tag
+        return run
+
+    return deco
+
+
+@contextmanager
+def crash_at(point: int):
+    """Arm the sanitizer and kill the run at mutating-op boundary
+    ``point`` (0-based): ops ``[0, point)`` execute, op ``point``
+    raises :class:`InjectedCrash` without executing, and everything
+    after is frozen to a no-op until the context exits.  Resets the
+    counters on entry so ``point`` indexes the same sequence a
+    recording pass observed."""
+    global _crash_point, _crashed
+    _arm()
+    reset_counters()
+    _crash_point = point
+    _crashed = False
+    try:
+        yield
+    finally:
+        _crash_point = None
+        _crashed = False
+        if not sanitizing():
+            disarm()
+
+
+def _arm() -> None:
+    global _armed, _forced
+    _install()
+    _armed = True
+    _forced = True
+
+
+def disarm() -> None:
+    """Passthrough mode: hooks stay installed (interposition cannot be
+    safely unwound mid-process) but become identity."""
+    global _armed, _forced
+    _armed = False
+    _forced = False
+
+
+# ---------------------------------------------------------------------------
+# the interposed surface
+# ---------------------------------------------------------------------------
+
+
+def _observe(op: str, path, durable_hint: bool | None = None) -> bool:
+    """Record one fs op.  Returns False when the op must NOT execute
+    (frozen post-crash).  Raises :class:`InjectedCrash` at the armed
+    crash boundary and :class:`DurableOrderingError` on a live G019
+    violation."""
+    global _mutations, _crashed, _op_log_dropped
+    if not _armed:
+        return True
+    watched = _watched(path) if path is not None else bool(_stack())
+    if not watched:
+        return True
+    durable = _durable(path) if durable_hint is None else durable_hint
+    mutating = op in MUTATING_OPS
+    if mutating and _crashed:
+        return False  # the process is dead: nothing lands on disk
+    stack = _stack()
+    entry = stack[-1] if stack else None
+    tag = entry["tag"] if entry else None
+    if mutating:
+        with _mu:
+            idx = _mutations
+            _mutations += 1
+        if _crash_point is not None and idx == _crash_point:
+            _crashed = True
+            raise InjectedCrash(
+                f"injected crash before fs op #{idx} "
+                f"({op} {os.path.basename(str(path))!r}, "
+                f"protocol {tag or 'unattributed'})"
+            )
+    if op in DESTRUCTIVE_OPS and durable and entry is not None \
+            and not _crashed:
+        # live G019: destruction of a durable copy must be dominated by
+        # the committed install of its replacement — or by a read of
+        # the committed record (completing a torn pass)
+        ok = any(
+            (o in COMMIT_OPS and dur) or o == "read"
+            for o, dur in entry["ops"]
+        )
+        if not ok:
+            raise DurableOrderingError(
+                f"{op} of durable `{os.path.basename(str(path))}` "
+                f"inside protocol `{tag}` before any committed install "
+                "(os.replace/os.rename to a durable target) or read of "
+                "the committed record — a crash here loses the only "
+                f"copy ({_ENV}=1); install the replacement first"
+            )
+    with _mu:
+        if tag is not None:
+            t = _ops.setdefault(tag, {})
+            t[op] = t.get(op, 0) + 1
+        elif mutating:
+            _unattributed[op] = _unattributed.get(op, 0) + 1
+        if len(_op_log) < _OP_LOG_CAP:
+            _op_log.append(
+                (tag, op, os.path.basename(str(path)) if path else "")
+            )
+        else:
+            _op_log_dropped += 1
+    if entry is not None:
+        entry["ops"].append((op, durable))
+    return True
+
+
+_orig_open = builtins.open
+_orig_replace = os.replace
+_orig_rename = os.rename
+_orig_link = os.link
+_orig_unlink = os.unlink
+_orig_fsync = os.fsync
+_orig_rmtree = shutil.rmtree
+
+
+def _fs_open(file, mode="r", *args, **kwargs):
+    if _armed:
+        try:
+            path = os.fspath(file)
+        except TypeError:
+            path = None  # raw fd / file-like: out of model
+        if isinstance(path, str) and _watched(path):
+            if any(c in mode for c in "wx"):
+                op = "write"
+            elif "a" in mode:
+                op = "append"
+            elif "+" in mode:
+                op = "update"  # r+: in-place edit (torn-tail truncate)
+            else:
+                op = "read"
+            if not _observe(op, path):
+                # frozen: give the unwinding caller a harmless sink so
+                # cleanup code cannot touch the crash window
+                return _orig_open(os.devnull,
+                                  mode.replace("x", "w"), *args, **kwargs)
+    return _orig_open(file, mode, *args, **kwargs)
+
+
+def _fs_replace(src, dst, *args, **kwargs):
+    if _observe("replace", dst):
+        return _orig_replace(src, dst, *args, **kwargs)
+
+
+def _fs_rename(src, dst, *args, **kwargs):
+    if _observe("rename", dst):
+        return _orig_rename(src, dst, *args, **kwargs)
+
+
+def _fs_link(src, dst, *args, **kwargs):
+    if _observe("link", dst):
+        return _orig_link(src, dst, *args, **kwargs)
+
+
+def _fs_unlink(path, *args, **kwargs):
+    if _observe("unlink", path):
+        return _orig_unlink(path, *args, **kwargs)
+
+
+def _fs_fsync(fd):
+    # fd-keyed: no path to watch-filter, so attribution rides the
+    # active protocol entry (nothing outside the durability stack
+    # fsyncs in this codebase); never a crash boundary — a crash
+    # "before the fsync" is indistinguishable from one before the next
+    # mutating op, and the enumeration already covers that point.
+    if _armed and _stack():
+        _observe("fsync", None)
+    return _orig_fsync(fd)
+
+
+def _fs_rmtree(path, *args, **kwargs):
+    if _observe("rmtree", path):
+        return _orig_rmtree(path, *args, **kwargs)
+
+
+def _install() -> None:
+    global _installed
+    if _installed:
+        return
+    builtins.open = _fs_open
+    os.replace = _fs_replace
+    os.rename = _fs_rename
+    os.link = _fs_link
+    os.unlink = _fs_unlink
+    os.remove = _fs_unlink  # the same syscall, both spellings
+    os.fsync = _fs_fsync
+    shutil.rmtree = _fs_rmtree
+    _installed = True

@@ -1,4 +1,4 @@
-"""graftlint rules G001-G017.
+"""graftlint rules G001-G021.
 
 Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
 registered in :data:`RULES`.  Every rule is motivated by a real hazard
@@ -31,6 +31,12 @@ from .core import (
     walk_hot_scope,
 )
 from .flow import g008_shape_drift
+from .fsops import (
+    g018_atomic_commit,
+    g019_durable_ordering,
+    g020_verify_before_trust,
+    g021_fs_protocols,
+)
 from .pallas_rules import g009_pallas_grid, g010_block_lane
 from .threads import (
     g014_shared_escape,
@@ -1046,4 +1052,8 @@ RULES = {
     "G015": g015_publish_discipline,
     "G016": g016_blocking_hot_thread,
     "G017": g017_thread_crossings,  # artifact-driven; see run_lint
+    "G018": g018_atomic_commit,
+    "G019": g019_durable_ordering,
+    "G020": g020_verify_before_trust,
+    "G021": g021_fs_protocols,  # artifact-driven; see run_lint
 }

@@ -42,7 +42,9 @@ import sys
 import time
 from collections import deque
 
+from ..lint.fs_sanitizer import fs_protocol
 from ..lint.sanitizer import fenced
+from ..utils.fsdur import fsync_dir as _fsync_dir
 
 #: Bump when the dump document changes shape.
 FLIGHT_VERSION = 1
@@ -83,7 +85,7 @@ class FlightRecorder:  # graftlint: thread=hot
     # ---- triggers (anomaly fire / unrecovered fault / crash) ----
 
     @fenced
-    def trigger(self, reason: str, *, registry=None, status=None,  # graftlint: fence=flight
+    def trigger(self, reason: str, *, registry=None, status=None,  # graftlint: fence=flight  # graftlint: durable=flight
                 requests=None, anomalies=None) -> str:
         """Dump the recorder's state atomically and return the path.
         Later triggers replace the file (each dump is a superset-in-
@@ -129,9 +131,17 @@ class FlightRecorder:  # graftlint: thread=hot
             if d:
                 os.makedirs(d, exist_ok=True)
             tmp = self.path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(doc, f, separators=(",", ":"))
-            os.replace(tmp, self.path)  # commit point: never half a dump
+            with fs_protocol("flight"):
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, separators=(",", ":"))
+                    # a post-mortem that evaporates with the page cache
+                    # explains nothing: fsync before the commit rename,
+                    # and the directory entry after (G018)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)  # commit: never half a dump
+                if d:
+                    _fsync_dir(d)
         except (OSError, TypeError, ValueError) as e:
             self.dump_failures += 1
             self.last_error = f"{type(e).__name__}: {e}"

@@ -48,7 +48,7 @@ from ..bench.harness import (
     save_results,
     summarize,
 )
-from ..lint import race_sanitizer, sanitizer
+from ..lint import fs_sanitizer, race_sanitizer, sanitizer
 from ..obs import trace as obs_trace
 from ..obs.anomaly import AnomalyDetector
 from ..obs.flight import FlightRecorder
@@ -464,6 +464,17 @@ def run_serve_bench(
         race_sanitized = race_sanitizer.sanitizing()
         if race_sanitized:
             log("serve: race sanitizer ARMED (CRDT_BENCH_SANITIZE_RACES)")
+        # durable-protocol entry / fs-op counters (lint G021's ground
+        # truth, the fs_ops block): reset per drain; with
+        # CRDT_BENCH_SANITIZE_FS=1 the fs surface is interposed and
+        # every op on the watched roots below is attributed to its
+        # declared protocol (lint/fs_sanitizer.py)
+        fs_sanitizer.reset_counters()
+        fs_sanitized = fs_sanitizer.sanitizing()
+        if fs_sanitized:
+            log("serve: fs sanitizer ARMED (CRDT_BENCH_SANITIZE_FS)")
+        if journal_dir:
+            fs_sanitizer.watch_root(journal_dir)
         if telemetry is not None:
             telemetry.note_phase("building")  # staleness-clock heartbeat
         log(f"serve: building fleet n_docs={n_docs} mix={mix_label} "
@@ -477,6 +488,7 @@ def run_serve_bench(
         pool = DocPool(classes=classes, slots=slots, mesh=mesh,
                        spool_dir=spool_dir, serve_kernel=serve_kernel,
                        warm_docs=warm_docs)
+        fs_sanitizer.watch_root(pool.spool_dir)
         if warm_docs:
             log(
                 f"serve: tiered residency — hot {sum(slots)} rows "
@@ -909,6 +921,36 @@ def run_serve_bench(
                "accesses attributed" if race_sanitized else "")
         )
 
+        # ---- durable-protocol ground truth (lint G021 cross-checks
+        # the static crash-consistency model against exactly this
+        # block) ----
+        fs_counts = fs_sanitizer.counters()
+        fs_ops_block = {
+            "version": 1,
+            "sanitized": fs_sanitized,
+            # armed surfaces (G021's dead-protocol scoping, the G011
+            # fence-tag pattern): snapshot/gc/wal ride the journal,
+            # spool rides real pool spool traffic, flight a dump that
+            # actually fired this drain
+            "journal": journal is not None,
+            "spool": (stats.evictions + stats.restores
+                      + pool.warm_evictions) > 0,
+            "flight": boundary_syncs["flight"],
+            "protocols": fs_counts["protocols"],
+            "ops": fs_counts["ops"] if fs_sanitized else None,
+            "unattributed": (
+                fs_counts["unattributed"] if fs_sanitized else None
+            ),
+        }
+        log(
+            "serve: fs protocols — entries "
+            + (", ".join(
+                f"{k}={v}" for k, v in fs_counts["protocols"].items()
+            ) or "none")
+            + (f"; {sum(n for t in fs_counts['ops'].values() for n in t.values())} "
+               "fs ops attributed" if fs_sanitized else "")
+        )
+
         occ = stats.occupancy.mean
         r = BenchResult(
             group="serve",
@@ -1035,6 +1077,7 @@ def run_serve_bench(
                 "faults": fault_summary,
                 "boundary_syncs": boundary_syncs,
                 "thread_crossings": thread_crossings,
+                "fs_ops": fs_ops_block,
                 # versioned typed-metric registry: every counter /
                 # gauge / histogram the drain emitted (obs/metrics.py)
                 "metrics": stats.metrics.to_dict(),

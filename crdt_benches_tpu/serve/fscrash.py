@@ -1,0 +1,233 @@
+"""Exhaustive crash-point enumeration over the durability stack.
+
+The headline capability of graftlint v4's runtime twin: drive a small
+real fleet through EVERY declared durable protocol — WAL appends +
+segment seals, delta/full snapshot barriers with hard-linked spool
+members, crash-safe segment GC, spool evict/rehydrate churn, and a
+flight-recorder dump — under ``lint/fs_sanitizer.py`` interposition,
+record the complete mutating-op sequence, then re-run the whole
+workload once per op with an :class:`InjectedCrash` at exactly that
+boundary and require **byte-verified recovery** at every single
+injection point: ``recover_fleet`` into a fresh pool, resume through
+the normal macro-round path, and every document decodes to the oracle
+replay.  The workload is deterministic (seeded synth streams, no
+wall-clock dependence in the fs path), so crash pass ``i`` observes
+the same op sequence the recording pass did.
+
+This is the dynamic proof of the G018/G019 static model: if any
+ordering in the stack were wrong — an unlink before its install, a
+rename whose directory entry a recovery depends on, a torn GC pass —
+some boundary in the enumeration would recover to the wrong bytes or
+not at all.  The per-protocol point counts are asserted NONZERO so the
+harness can never silently cover nothing.
+
+Runs as a tier-1 test (tests/test_fs_sanitizer.py) and as the
+``serve-longhaul`` smoke's fs leg::
+
+    JAX_PLATFORMS=cpu python -m crdt_benches_tpu.serve.fscrash
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+from ..lint import fs_sanitizer
+from ..obs.flight import FlightRecorder
+from ..oracle.text_oracle import replay_trace
+from .journal import OpJournal, recover_fleet
+from .pool import DocPool
+from .scheduler import FleetScheduler, prepare_streams
+from .workload import build_fleet
+
+#: Tiny but protocol-complete: two capacity classes, a 3-row device
+#: budget against the fleet (forced evict/rehydrate churn = spool
+#: protocol), barriers every 2 rounds with a full re-root every 2nd
+#: barrier (delta chains + member adoption), sub-KiB WAL segments
+#: (seals + GC victims), and a flight dump at drain end.  The default
+#: config is the smoke's (~80 boundaries); ``small=True`` shrinks the
+#: streams for the tier-1 test while keeping every protocol covered.
+_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+_MIX = {"synth-small": 0.7, "synth-medium": 0.3}
+_SMALL_BANDS = {"synth-small": ("synth", (8, 36))}
+_SMALL_MIX = {"synth-small": 1.0}
+_CLASSES = (256, 1024)
+_SLOTS = (2, 1)
+_DOCS = 5
+_SEED = 11
+_BATCH = 16
+_CHARS = 64
+_MACRO_K = 2
+
+
+def _sessions(small: bool = False):
+    if small:
+        return build_fleet(4, mix=_SMALL_MIX, seed=_SEED,
+                           arrival_span=1, bands=_SMALL_BANDS)
+    return build_fleet(_DOCS, mix=_MIX, seed=_SEED, arrival_span=2,
+                       bands=_BANDS)
+
+
+def _drain(base: str, small: bool = False) -> None:
+    """One full protocol workload under ``base``: journaled drain to
+    completion + a flight dump.  Raises :class:`InjectedCrash` midway
+    when a crash point is armed."""
+    jd = os.path.join(base, "journal")
+    sp = os.path.join(base, "spool")
+    fl = os.path.join(base, "flight")
+    fs_sanitizer.clear_watch_roots()  # each pass owns fresh dirs
+    fs_sanitizer.watch_root(jd)
+    fs_sanitizer.watch_root(sp)
+    fs_sanitizer.watch_root(fl)
+    sessions = _sessions(small)
+    pool = DocPool(classes=_CLASSES, slots=_SLOTS, spool_dir=sp)
+    streams = prepare_streams(sessions, pool, batch=_BATCH,
+                              batch_chars=_CHARS)
+    journal = OpJournal(jd, segment_bytes=128 if small else 192)
+    sched = FleetScheduler(
+        pool, streams, batch=_BATCH, macro_k=_MACRO_K,
+        batch_chars=_CHARS, journal=journal,
+        snapshot_every=2, snapshot_full_every=2,
+    )
+    try:
+        sched.run()
+        flight = FlightRecorder(os.path.join(fl, "dump.json"), ring=8)
+        flight.note_round({"round": sched.round, "seconds": 0.0})
+        flight.trigger("fscrash-probe")
+    finally:
+        journal.close()
+
+
+def _recover_and_verify(base: str, small: bool = False) -> None:
+    """Recovery after a (possibly crashed) drain: fresh pool + streams,
+    ``recover_fleet``, resume through the normal macro-round path, and
+    byte-verify every document against the oracle replay."""
+    jd = os.path.join(base, "journal")
+    sessions = _sessions(small)
+    pool = DocPool(classes=_CLASSES, slots=_SLOTS,
+                   spool_dir=os.path.join(base, "spool_recover"))
+    streams = prepare_streams(sessions, pool, batch=_BATCH,
+                              batch_chars=_CHARS)
+    rep = recover_fleet(pool, streams, jd)
+    FleetScheduler(
+        pool, streams, batch=_BATCH, macro_k=_MACRO_K,
+        batch_chars=_CHARS, start_round=rep.resume_round,
+    ).run()
+    for s in sessions:
+        got = pool.decode(s.doc_id)
+        want = replay_trace(s.trace)
+        if got != want:
+            raise AssertionError(
+                f"doc {s.doc_id}: post-recovery bytes diverge from the "
+                f"oracle (snapshot round {rep.snapshot_round}, "
+                f"{rep.chain_fallbacks} fallbacks)"
+            )
+
+
+def enumerate_crash_points(workdir: str | None = None,
+                           log=lambda s: None,
+                           small: bool = False) -> dict:
+    """The full enumeration.  Returns a report dict::
+
+        {"mutations": M, "per_protocol": {tag: n}, "verified": M}
+
+    - recording pass: run the workload armed, capture the mutating-op
+      count ``M`` and its per-protocol attribution (every declared
+      protocol must have contributed at least one point);
+    - for each ``i`` in ``range(M)``: fresh directories, crash at
+      boundary ``i`` (the op raises instead of executing and the fs
+      freezes — a dead process writes nothing), then recover + resume
+      + byte-verify against the oracle.
+    """
+    own = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="crdt_fscrash_")
+    try:
+        record_dir = os.path.join(workdir, "record")
+        os.makedirs(record_dir)
+        fs_sanitizer.reset_counters()
+        fs_sanitizer._arm()
+        try:
+            _drain(record_dir, small)
+        finally:
+            if not fs_sanitizer.sanitizing():
+                fs_sanitizer.disarm()
+        counts = fs_sanitizer.counters()
+        m = fs_sanitizer.mutation_count()
+        per_protocol = {
+            tag: sum(n for op, n in ops.items()
+                     if op in fs_sanitizer.MUTATING_OPS)
+            for tag, ops in counts["ops"].items()
+        }
+        # the recording pass must also recover clean (crash "after the
+        # last op" — the trivial boundary)
+        _recover_and_verify(record_dir, small)
+        for tag in fs_sanitizer.KNOWN_PROTOCOLS:
+            if per_protocol.get(tag, 0) <= 0:
+                raise AssertionError(
+                    f"protocol `{tag}` contributed no mutating op — "
+                    "the enumeration would silently not cover it: "
+                    f"{per_protocol}"
+                )
+        if counts["unattributed"]:
+            raise AssertionError(
+                "unattributed mutating ops in the recording pass: "
+                f"{counts['unattributed']}"
+            )
+        log(f"fscrash: {m} crash points "
+            + ", ".join(f"{t}={n}" for t, n in sorted(per_protocol.items())))
+        verified = 0
+        for i in range(m):
+            base = os.path.join(workdir, f"crash_{i:04d}")
+            os.makedirs(base)
+            crashed = False
+            try:
+                with fs_sanitizer.crash_at(i):
+                    _drain(base, small)
+            except fs_sanitizer.InjectedCrash:
+                crashed = True
+            if not crashed:
+                raise AssertionError(
+                    f"crash point {i} never fired (expected {m} "
+                    "mutating ops — nondeterministic op sequence?)"
+                )
+            _recover_and_verify(base, small)
+            verified += 1
+            shutil.rmtree(base, ignore_errors=True)  # bound disk use
+        log(f"fscrash: {verified}/{m} crash points recovered "
+            "byte-verified")
+        return {
+            "mutations": m,
+            "per_protocol": per_protocol,
+            "verified": verified,
+        }
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    small = "--small" in argv
+    if [a for a in argv if a != "--small"]:
+        print("usage: python -m crdt_benches_tpu.serve.fscrash "
+              "[--small]", file=sys.stderr)
+        return 2
+    report = enumerate_crash_points(
+        log=lambda s: print(s, flush=True), small=small,
+    )
+    ok = report["verified"] == report["mutations"] > 0
+    print(
+        f"fscrash: {'OK' if ok else 'FAILED'} — "
+        f"{report['verified']}/{report['mutations']} boundaries "
+        f"byte-verified, per-protocol {report['per_protocol']}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

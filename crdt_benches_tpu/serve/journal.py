@@ -64,11 +64,14 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..lint.fs_sanitizer import durable_protocol, fs_protocol
 from ..lint.race_sanitizer import published
 from ..obs.metrics import Counter, Gauge
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
     CorruptCheckpointError,
+    fsync_dir,
+    fsync_file,
     load_state,
     save_state,
 )
@@ -142,7 +145,7 @@ class OpJournal:  # graftlint: thread=hot
     bad line).  Sealed segments are only ever complete records — a
     crash can only tear the file that was being appended."""
 
-    def __init__(self, journal_dir: str, fsync: bool = False,
+    def __init__(self, journal_dir: str, fsync: bool = False,  # graftlint: durable=wal
                  segment_bytes: int = DEFAULT_SEGMENT_BYTES):
         os.makedirs(journal_dir, exist_ok=True)
         self.dir = journal_dir
@@ -154,13 +157,15 @@ class OpJournal:  # graftlint: thread=hot
         if os.path.exists(self.path):
             good = _valid_prefix_bytes(self.path)
             if good < os.path.getsize(self.path):
-                with open(self.path, "r+b") as f:
-                    f.truncate(good)
+                with fs_protocol("wal"):
+                    with open(self.path, "r+b") as f:
+                        f.truncate(good)
         self._seq = 1 + max(
             (_segment_seq(s) for s in wal_segments(journal_dir)),
             default=0,
         )
-        self._f = open(self.path, "a", encoding="utf-8")
+        with fs_protocol("wal"):
+            self._f = open(self.path, "a", encoding="utf-8")
         self._active_bytes = os.path.getsize(self.path)
         self._since_snapshot = 0
         # per-segment GC-eligibility cache: max round of a SEALED
@@ -257,13 +262,14 @@ class OpJournal:  # graftlint: thread=hot
         self._g_since.set(0)
         return total
 
-    def append(self, obj: dict) -> None:
+    def append(self, obj: dict) -> None:  # graftlint: durable=wal
         payload = json.dumps(obj, separators=(",", ":"))
         line = f"{zlib.crc32(payload.encode()):08x} {payload}\n"
-        self._f.write(line)
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
+        with fs_protocol("wal"):
+            self._f.write(line)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
         self._m_records.inc()
         self._m_bytes.inc(len(line))
         self._active_bytes += len(line)
@@ -277,7 +283,7 @@ class OpJournal:  # graftlint: thread=hot
         else:
             self._active_roundless = True
 
-    def maybe_roll(self) -> bool:
+    def maybe_roll(self) -> bool:  # graftlint: durable=wal
         """Seal the active file as the next numbered segment (once it
         has passed ``segment_bytes``) and open a fresh one.  NOT called
         from the append hot path: a segment can only be GC'd at a
@@ -285,19 +291,29 @@ class OpJournal:  # graftlint: thread=hot
         :meth:`compact` rolls first, inside the barrier fence.  Crash
         windows are benign: after the rename but before the new open
         there is simply no active file, and the next append (or
-        reopen) creates one."""
+        reopen) creates one.
+
+        The seal fsyncs the active file BEFORE renaming it (graftlint
+        v4 audit fix, G018): a sealed segment is immutable and
+        GC-eligible — committing its name while its tail pages were
+        never flushed would let a power cut tear a file the reader
+        trusts to hold only complete records."""
         if not self.segment_bytes \
                 or self._active_bytes < self.segment_bytes:
             return False
-        self._f.close()
-        name = _segment_name(self._seq)
-        os.replace(self.path, os.path.join(self.dir, name))
-        self._seg_max[name] = (
-            None if self._active_roundless or not self._active_records
-            else self._active_max_r
-        )
-        self._seq += 1
-        self._f = open(self.path, "a", encoding="utf-8")
+        with fs_protocol("wal"):
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            name = _segment_name(self._seq)
+            os.replace(self.path, os.path.join(self.dir, name))
+            fsync_dir(self.dir)
+            self._seg_max[name] = (
+                None if self._active_roundless or not self._active_records
+                else self._active_max_r
+            )
+            self._seq += 1
+            self._f = open(self.path, "a", encoding="utf-8")
         self._active_bytes = 0
         self._active_max_r = -1
         self._active_roundless = False
@@ -333,7 +349,7 @@ class OpJournal:  # graftlint: thread=hot
 
     # ---- segment GC (cold path: runs inside the barrier fence) ----
 
-    def compact(self, covered_round: int, crash_hook=None) -> dict:
+    def compact(self, covered_round: int, crash_hook=None) -> dict:  # graftlint: durable=gc
         """Delete sealed segments fully covered at ``covered_round``: a
         segment whose every record carries ``r < covered_round`` is
         durable below that barrier (decisions live in the manifest,
@@ -384,21 +400,28 @@ class OpJournal:  # graftlint: thread=hot
         manifest = {"round": int(covered_round), "segments": victims}
         mpath = os.path.join(self.dir, GC_MANIFEST)
         tmp = mpath + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(manifest, f, separators=(",", ":"))
-        os.replace(tmp, mpath)  # the GC commit point
-        if crash_hook is not None and crash_hook():
-            # simulated crash between manifest write and unlink: the
-            # torn pass is recovered on the next open/compact/recovery
-            info["crashed"] = True
-            return info
-        for name in victims:
-            try:
-                os.unlink(os.path.join(self.dir, name))
-            except OSError:
-                pass
-            self._seg_max.pop(name, None)
-        os.unlink(mpath)
+        with fs_protocol("gc"):
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(manifest, f, separators=(",", ":"))
+                # the manifest IS the commit record: fsync before the
+                # rename so a power cut cannot commit a name whose
+                # victim list never reached the platter (G018)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, mpath)  # the GC commit point
+            fsync_dir(self.dir)
+            if crash_hook is not None and crash_hook():
+                # simulated crash between manifest write and unlink: the
+                # torn pass is recovered on the next open/compact/recovery
+                info["crashed"] = True
+                return info
+            for name in victims:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+                self._seg_max.pop(name, None)
+            os.unlink(mpath)
         self._m_gc_passes.inc()
         self._m_gc_segments.inc(len(victims))
         self._g_segments.set(1 + len(wal_segments(self.dir)))
@@ -406,7 +429,7 @@ class OpJournal:  # graftlint: thread=hot
         info["freed_bytes"] = freed
         return info
 
-    def finish_torn_gc(self) -> int:
+    def finish_torn_gc(self) -> int:  # graftlint: durable=gc
         """Complete a GC pass torn by a crash (instance-side wrapper:
         same repair as the module helper, plus the metrics every GC
         path must report — :meth:`compact` routes through here so a
@@ -455,45 +478,48 @@ def wal_segments(journal_dir: str) -> list[str]:
     )
 
 
-def finish_torn_gc(journal_dir: str) -> int:
+def finish_torn_gc(journal_dir: str) -> int:  # graftlint: durable=gc
     """Complete a GC pass that crashed between its manifest write and
     the unlinks: delete every victim the manifest lists that still
     exists, then retire the manifest.  Idempotent; returns the number
     of segments removed now.  A half-written ``GC_MANIFEST.json.tmp``
     (crash before the manifest commit) is simply discarded — the pass
-    never started, all segments survive."""
-    tmp = os.path.join(journal_dir, GC_MANIFEST + ".tmp")
-    if os.path.exists(tmp):
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-    mpath = os.path.join(journal_dir, GC_MANIFEST)
-    if not os.path.exists(mpath):
-        return 0
-    try:
-        with open(mpath, encoding="utf-8") as f:
-            manifest = json.load(f)
-        victims = [str(s) for s in manifest.get("segments", [])]
-    except (OSError, json.JSONDecodeError, AttributeError):
-        victims = []  # unreadable manifest: drop it, keep every segment
-    removed = 0
-    for name in victims:
-        path = os.path.join(journal_dir, name)
-        if os.path.exists(path):
+    never started, all segments survive.  (G019's read-witness form:
+    the destruction is dominated by a read of the committed manifest,
+    the one case where destroy-without-install is legal.)"""
+    with fs_protocol("gc"):
+        tmp = os.path.join(journal_dir, GC_MANIFEST + ".tmp")
+        if os.path.exists(tmp):
             try:
-                os.unlink(path)
-                removed += 1
+                os.unlink(tmp)
             except OSError:
                 pass
-    try:
-        os.unlink(mpath)
-    except OSError:
-        pass
-    return removed
+        mpath = os.path.join(journal_dir, GC_MANIFEST)
+        if not os.path.exists(mpath):
+            return 0
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+            victims = [str(s) for s in manifest.get("segments", [])]
+        except (OSError, json.JSONDecodeError, AttributeError):
+            victims = []  # unreadable manifest: drop, keep every segment
+        removed = 0
+        for name in victims:
+            path = os.path.join(journal_dir, name)
+            if os.path.exists(path):
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        try:
+            os.unlink(mpath)
+        except OSError:
+            pass
+        return removed
 
 
-def sweep_staging(journal_dir: str) -> list[str]:
+def sweep_staging(journal_dir: str) -> list[str]:  # graftlint: durable=snapshot
     """Remove snapshot staging directories abandoned by a crash before
     the atomic rename (``snap_*.tmp``).  They may contain a
     valid-looking manifest — the rename IS the commit, so anything
@@ -502,12 +528,13 @@ def sweep_staging(journal_dir: str) -> list[str]:
     if not os.path.isdir(journal_dir):
         return []
     removed = []
-    for d in sorted(os.listdir(journal_dir)):
-        if d.startswith(SNAP_PREFIX) and d.endswith(".tmp") and \
-                os.path.isdir(os.path.join(journal_dir, d)):
-            shutil.rmtree(os.path.join(journal_dir, d),
-                          ignore_errors=True)
-            removed.append(d)
+    with fs_protocol("snapshot"):
+        for d in sorted(os.listdir(journal_dir)):
+            if d.startswith(SNAP_PREFIX) and d.endswith(".tmp") and \
+                    os.path.isdir(os.path.join(journal_dir, d)):
+                shutil.rmtree(os.path.join(journal_dir, d),
+                              ignore_errors=True)
+                removed.append(d)
     return removed
 
 
@@ -608,7 +635,8 @@ def _manifest_crc(snap_dir: str) -> str | None:
         return None
 
 
-def write_snapshot(journal_dir: str, pool, streams, rnd: int,
+@durable_protocol("snapshot")
+def write_snapshot(journal_dir: str, pool, streams, rnd: int,  # graftlint: durable=snapshot
                    keep: int = 2, kind: str = "full"
                    ) -> tuple[str, dict]:
     """One fleet snapshot barrier: per-class bucket state (CRC'd .npz),
@@ -672,12 +700,17 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
         # spools are immutable once written (save_state lands them
         # via os.replace, so a re-eviction swaps in a NEW inode):
         # hard-link the snapshot member instead of copying — a
-        # thousands-of-cold-docs fleet barrier stays cheap
+        # thousands-of-cold-docs fleet barrier stays cheap.  The
+        # adopted member is fsynced HERE (one shared inode): hot-path
+        # spool writes skip the per-eviction fsync, and the barrier is
+        # where their contents must become durable — before the commit
+        # rename makes the snapshot real (G018).
         dst = os.path.join(tmp, fname)
         try:
             os.link(src, dst)
         except OSError:  # cross-device / unsupported fs
             shutil.copy2(src, dst)
+        fsync_file(dst)
 
     resident: dict[str, list[int]] = {}
     spooled: dict[str, str] = {}
@@ -709,7 +742,7 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
             save_state(
                 os.path.join(tmp, f"class_{cls}.npz"),
                 PackedState(doc=doc, length=length, nvis=nvis),
-                compress=False,
+                compress=False, durable=True,
             )
             class_shapes[str(cls)] = [int(doc.shape[0]),
                                       int(doc.shape[1])]
@@ -734,7 +767,7 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
                     length=np.asarray(length[rows_a], np.int32),
                     nvis=np.asarray(nvis[rows_a], np.int32),
                 ),
-                compress=False,
+                compress=False, durable=True,
             )
             delta_rows[str(cls)] = [int(r) for r in rows]
             class_shapes[str(cls)] = [int(doc.shape[0]),
@@ -767,14 +800,22 @@ def write_snapshot(journal_dir: str, pool, streams, rnd: int,
     mtmp = os.path.join(tmp, "MANIFEST.tmp")
     with open(mtmp, "w", encoding="utf-8") as f:
         json.dump(manifest, f, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(mtmp, os.path.join(tmp, "MANIFEST.json"))
+    # every member + the manifest are fsynced; flush the staging
+    # directory's ENTRIES too, then commit — and make the commit
+    # itself durable (G018: a rename is only a commit once the parent
+    # directory knows about it across a power cut)
+    fsync_dir(tmp)
     os.rename(tmp, final)  # the commit point
+    fsync_dir(journal_dir)
 
     _prune_chains(journal_dir, keep)
     return final, manifest
 
 
-def _prune_chains(journal_dir: str, keep: int) -> None:
+def _prune_chains(journal_dir: str, keep: int) -> None:  # graftlint: durable=snapshot
     """Prune committed snapshots by CHAIN: group directories into
     chains (a full snapshot starts one; a delta whose base is the
     previous member continues it; anything orphaned is its own
@@ -946,7 +987,7 @@ def load_chain_states(journal_dir: str, name: str,
     return tip, states, members
 
 
-def probe_recovery(journal_dir: str) -> tuple[str | None, int]:
+def probe_recovery(journal_dir: str) -> tuple[str | None, int]:  # graftlint: durable=snapshot
     """Dry-run the snapshot selection recovery performs: walk
     candidates newest-first, materializing each chain, and return
     ``(first_usable_snapshot, fallbacks)`` — ``fallbacks`` counts
@@ -1013,7 +1054,7 @@ class SnapshotBases:
             self._class_cache[ck] = st
         return self._class_cache[ck]
 
-    def base(self, doc_id: int):
+    def base(self, doc_id: int):  # graftlint: durable=snapshot
         if self.dir is None:
             return None
         for snap in reversed(list_snapshots(self.dir)):
@@ -1181,7 +1222,8 @@ class RecoveryReport:
     staging_removed: int = 0  # abandoned snap_*.tmp dirs swept
 
 
-def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:
+@durable_protocol("snapshot")
+def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:  # graftlint: durable=snapshot
     """Restore a crashed fleet into a FRESH pool + stream set (built by
     the same ``prepare_streams`` the original run used): complete any
     GC pass torn by the crash, sweep abandoned staging directories,

@@ -646,7 +646,7 @@ class DocPool:
         can drop a read that raced a re-eviction."""
         return self._spool_gens.get(doc_id, 0)
 
-    def spool_save(
+    def spool_save(  # graftlint: durable=spool
             self, doc_id: int, doc_row: np.ndarray, length: int,
             nvis: int, compress: bool = False) -> str:
         """Write one doc's checkpoint to the spool.  Only the used

@@ -16,13 +16,24 @@ Durability contract (the serve/ fleet leans on both properties):
 - **atomic write**: :func:`save_state` writes to a same-directory temp
   file and ``os.replace``\\ s it over the target, so a crash (or injected
   exception) mid-write can never leave a torn ``.npz`` behind — the old
-  file, if any, survives intact;
+  file, if any, survives intact.  ``durable=True`` additionally fsyncs
+  the staged bytes before the rename and the parent directory after it
+  (graftlint G018: a committed rename must imply durable contents —
+  snapshot members and manifests pass it; hot-path eviction spools do
+  not, their loss is exactly what journal replay covers);
 - **verified read**: :func:`load_state` checks every array against the
   saved CRC32 manifest and raises the typed
   :class:`CorruptCheckpointError` on any damage (truncation, bit flips,
   an unreadable zip) instead of surfacing a numpy decode crash far from
-  the load site.  Pre-manifest checkpoints (no ``__crcs__`` field) load
-  with verification skipped — the legacy fallback.
+  the load site (graftlint G020's verify-before-trust reader).
+  Pre-manifest checkpoints (no ``__crcs__`` field) load with
+  verification skipped — the legacy fallback.
+
+Both entry points are declared members of the ``spool`` durable
+protocol (``# graftlint: durable=spool``): the static crash-consistency
+rules check their effect sequences, and the runtime fs sanitizer
+(``CRDT_BENCH_SANITIZE_FS=1``) attributes their fs ops — and can crash
+them at every op boundary (serve/fscrash.py).
 """
 
 from __future__ import annotations
@@ -37,8 +48,12 @@ import numpy as np
 _BF16 = np.dtype(ml_dtypes.bfloat16)
 
 from ..engine.downstream import DownPacked, DownState
+from ..lint.fs_sanitizer import fs_protocol
 from ..ops.apply import DocState
 from ..ops.apply2 import PackedState, PackedState4, ReplayState
+from .fsdur import fsync_dir, fsync_file  # noqa: F401  (re-exported:
+# journal.py and tests import the fsync helpers from here alongside
+# save_state/load_state; the one implementation lives in utils/fsdur)
 
 _CLASSES = {
     "DocState": DocState,
@@ -56,7 +71,8 @@ class CorruptCheckpointError(ValueError):
     pre-existing ``except ValueError`` callers keep working."""
 
 
-def save_state(path: str, state, compress: bool = True) -> None:
+def save_state(path: str, state, compress: bool = True,
+               durable: bool = False) -> None:  # graftlint: durable=spool
     """Persist a DocState/DownState pytree (device arrays are fetched).
 
     Non-NumPy-native dtypes need explicit handling: ``np.savez`` writes a
@@ -74,7 +90,14 @@ def save_state(path: str, state, compress: bool = True) -> None:
     ``os.replace``\\ d over ``path`` only once fully written, so an
     interrupted save (eviction killed mid-write, disk-full, crash) never
     leaves a torn file — and never destroys a previous good checkpoint
-    at the same path."""
+    at the same path.  ``durable=True`` makes the committed rename mean
+    it: the staged file is fsynced before the replace and the parent
+    directory after (the graftlint v4 audit fix — a rename alone can
+    commit a name whose CONTENTS die with the page cache).  The default
+    stays False on purpose: eviction spools are a rebuildable cache
+    (deterministic streams + WAL replay), and snapshot barriers fsync
+    the members they adopt, so the per-eviction hot path keeps its
+    PR 2 cost profile."""
     cls = type(state).__name__
     if cls not in _CLASSES:
         raise TypeError(f"unsupported state type {cls}")
@@ -90,31 +113,37 @@ def save_state(path: str, state, compress: bool = True) -> None:
         crcs.append(zlib.crc32(np.ascontiguousarray(a).tobytes()))
     saver = np.savez_compressed if compress else np.savez
     d = os.path.dirname(os.path.abspath(path)) or "."
-    fd, tmp = tempfile.mkstemp(
-        dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
-    )
-    try:
-        # np.savez on a FILE OBJECT (a str path would get ".npz" appended
-        # and orphan the temp file)
-        with os.fdopen(fd, "wb") as fh:
-            saver(
-                fh,
-                __class__=np.asarray(cls),
-                __fields__=np.asarray(state._fields),
-                __dtypes__=np.asarray(dtypes),
-                __crcs__=np.asarray(crcs, np.uint64),
-                **arrays,
-            )
-        os.replace(tmp, path)
-    except BaseException:
+    with fs_protocol("spool"):
+        fd, tmp = tempfile.mkstemp(
+            dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
+        )
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            # np.savez on a FILE OBJECT (a str path would get ".npz"
+            # appended and orphan the temp file)
+            with os.fdopen(fd, "wb") as fh:
+                saver(
+                    fh,
+                    __class__=np.asarray(cls),
+                    __fields__=np.asarray(state._fields),
+                    __dtypes__=np.asarray(dtypes),
+                    __crcs__=np.asarray(crcs, np.uint64),
+                    **arrays,
+                )
+                if durable:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            if durable:
+                fsync_dir(d)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
 
-def load_state(path: str, verify: bool = True):
+def load_state(path: str, verify: bool = True):  # graftlint: durable=spool
     """Restore a state pytree saved by :func:`save_state` (host arrays;
     device placement happens lazily on first use).
 
@@ -123,7 +152,8 @@ def load_state(path: str, verify: bool = True):
     the CRC manifest existed (no ``__crcs__`` field) load with the
     verification skipped — the legacy fallback."""
     try:
-        z = np.load(path)
+        with fs_protocol("spool"):
+            z = np.load(path)
     except Exception as e:  # BadZipFile / OSError / EOFError / ValueError
         raise CorruptCheckpointError(
             f"checkpoint {path!r}: unreadable ({type(e).__name__}: {e})"

@@ -1,0 +1,35 @@
+"""Seeded G018: atomic-commit discipline broken three ways — an
+in-place write-mode open of a durable path role (a crash mid-write
+leaves a torn artifact under its committed name), a committed rename
+with no fsync anywhere earlier in the protocol sequence (rename
+durability does not imply content durability), and a typo'd protocol
+tag (which would silently exempt the function from the fs-protocol
+accounting forever).  The legal twins — a staged `.tmp` write, and a
+commit preceded by fsync — stay silent."""
+
+import os
+
+
+def clobber_manifest(path: str, blob: bytes) -> None:  # graftlint: durable=snapshot
+    with open(path, "wb") as f:  # expect: G018
+        f.write(blob)
+
+
+def seal_segment(path: str, blob: bytes) -> None:  # graftlint: durable=wal
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:  # staging write: legal
+        f.write(blob)
+    os.replace(tmp, path)  # expect: G018
+
+
+def seal_segment_durably(path: str, blob: bytes) -> None:  # graftlint: durable=wal
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # fsynced first: legal
+
+
+def mislabeled(path: str) -> None:  # graftlint: durable=wall  # expect: G018
+    os.fsync(os.open(path, os.O_RDONLY))

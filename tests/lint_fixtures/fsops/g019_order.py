@@ -1,0 +1,39 @@
+"""Seeded G019: durable-ordering broken — destruction of a durable
+copy (the old spool member) before the committed install of its
+replacement, the exact PR 13 unlink-before-install crash window.  The
+legal twins — commit-then-destroy, the torn-pass read-witness form,
+and staging cleanup — stay silent."""
+
+import os
+import shutil
+
+
+def rotate_spool(old: str, dst: str, blob: bytes) -> None:  # graftlint: durable=spool
+    os.unlink(old)  # expect: G019
+    tmp = dst + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
+
+
+def rotate_spool_safely(old: str, dst: str, blob: bytes) -> None:  # graftlint: durable=spool
+    tmp = dst + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)  # the committed install...
+    os.unlink(old)  # ...dominates the destruction: legal
+
+
+def torn_pass_cleanup(manifest: str, victim_dir: str) -> None:  # graftlint: durable=gc
+    with open(manifest, "rb") as f:  # read of the committed record...
+        f.read()
+    shutil.rmtree(victim_dir)  # ...licenses the destruction: legal
+
+
+def drop_staging(dst: str) -> None:  # graftlint: durable=snapshot
+    leftover = dst + ".tmp"
+    shutil.rmtree(leftover, ignore_errors=True)  # staging: legal

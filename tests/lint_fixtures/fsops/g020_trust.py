@@ -1,0 +1,48 @@
+"""Seeded G020: verify-before-trust broken both ways — a trusted
+``np.load`` of a durable artifact with no CRC verification in the
+reading function (bit flips surface as field-access crashes far from
+the load site), and a recovery fallback whose try-body indexes into
+parsed manifest data while catching too narrow a set (a bit-flipped
+manifest stays PARSEABLE json with garbled values and escapes as
+KeyError/IndexError/TypeError — the ``_read_manifest`` incident).  The
+verifying reader and the garbage-covering fallback stay silent."""
+
+import json
+import zlib
+
+import numpy as np
+
+_RECOVERABLE = (ValueError, KeyError, IndexError, TypeError, OSError)
+
+
+def read_member(path: str):  # graftlint: durable=spool
+    z = np.load(path)  # expect: G020
+    return z["doc"]
+
+
+def read_member_verified(path: str):  # graftlint: durable=spool
+    z = np.load(path)
+    got = zlib.crc32(z["doc"].tobytes())
+    if got != int(z["crc"]):
+        raise ValueError("member damaged")
+    return z["doc"]
+
+
+def pick_candidate(manifests: list[str]):  # graftlint: durable=snapshot
+    for raw in manifests:
+        try:
+            m = json.loads(raw)
+            return int(m["round"])
+        except ValueError:  # expect: G020
+            continue
+    return None
+
+
+def pick_candidate_safely(manifests: list[str]):  # graftlint: durable=snapshot
+    for raw in manifests:
+        try:
+            m = json.loads(raw)
+            return int(m["round"])
+        except _RECOVERABLE:  # parseable garbage covered: legal
+            continue
+    return None

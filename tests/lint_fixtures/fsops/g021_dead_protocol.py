@@ -1,0 +1,18 @@
+"""G021 fixture: a declared durable protocol vs a runtime ``fs_ops``
+artifact (fsops/artifact.json).  ``flush_ring`` declares the flight
+protocol; the artifact's run ARMED the flight surface but recorded
+zero flight entries — a dead protocol — and carries a ``rogue_proto``
+tag plus an unattributed unlink no static marker explains.  Like the
+G011/G017 fixtures, this file is artifact-driven: without the
+artifact, no findings."""
+
+import os
+
+
+def flush_ring(path: str, blob: str) -> None:  # graftlint: durable=flight  # expect: G021
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

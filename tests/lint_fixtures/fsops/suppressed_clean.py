@@ -1,0 +1,26 @@
+"""The suppression contract for the crash-consistency rules: each
+seeded violation carries a same-line ``# graftlint: disable=G0XX`` and
+the file must lint CLEAN — the reviewed escape hatch works for G018-
+G020 exactly as it does for every other rule."""
+
+import numpy as np
+import os
+
+
+def overwrite_in_place(path: str, blob: bytes) -> None:  # graftlint: durable=snapshot
+    with open(path, "wb") as f:  # graftlint: disable=G018
+        f.write(blob)
+
+
+def destroy_first(old: str, dst: str, blob: bytes) -> None:  # graftlint: durable=spool
+    os.unlink(old)  # graftlint: disable=G019
+    tmp = dst + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
+
+
+def trusting_read(path: str):  # graftlint: durable=spool
+    return np.load(path)["doc"]  # graftlint: disable=G020

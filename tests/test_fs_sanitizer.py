@@ -1,0 +1,334 @@
+"""graftlint v4 runtime twin: the fs sanitizer's disarmed-identity
+contract, per-protocol op-sequence attribution (pinning the
+fsync-before-replace audit fixes), the live G019 ordering enforcement,
+crash-injection freeze semantics, the exhaustive crash-point
+enumeration over the whole durability stack, and the G021 cross-check
+green in both directions on a sanitized 12-doc drain."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.lint import fs_sanitizer as fss
+from crdt_benches_tpu.lint.core import run_lint
+from crdt_benches_tpu.ops.apply2 import PackedState
+from crdt_benches_tpu.serve.journal import OpJournal, wal_segments
+from crdt_benches_tpu.utils.checkpoint import load_state, save_state
+
+PACKAGE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "crdt_benches_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _fs_reset(monkeypatch):
+    """Every test owns a clean sanitizer: counters zeroed, watch roots
+    cleared, disarmed unless the test arms it."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_FS", raising=False)
+    fss.disarm()
+    fss.clear_watch_roots()
+    fss.reset_counters()
+    yield
+    fss.disarm()
+    fss.clear_watch_roots()
+    fss.reset_counters()
+
+
+def _state(n: int = 6) -> PackedState:
+    return PackedState(
+        doc=np.full((1, n), 2, np.int32),
+        length=np.asarray([n], np.int32),
+        nvis=np.asarray([n], np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# disarmed identity + timing
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_counts_entries_but_records_no_ops(tmp_path):
+    fss.watch_root(str(tmp_path))
+    p = str(tmp_path / "doc.npz")
+    save_state(p, _state(), compress=False, durable=True)
+    load_state(p)
+    c = fss.counters()
+    assert c["protocols"] == {"spool": 2}
+    assert c["ops"] == {} and c["unattributed"] == {}
+    assert fss.op_log() == []
+    assert fss.mutation_count() == 0
+
+
+def test_disarmed_protocol_entry_timing_smoke():
+    """The always-on cost is one lock-guarded dict bump per protocol
+    entry — generous ceiling so the smoke never flakes, but a real
+    regression (interposition leaking into disarmed mode) blows
+    through it."""
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        with fss.fs_protocol("spool"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"10k disarmed protocol entries took {dt:.3f}s"
+    assert fss.counters()["protocols"]["spool"] == 10_000
+
+
+# ---------------------------------------------------------------------------
+# armed: attribution + the audit-fix regression pins
+# ---------------------------------------------------------------------------
+
+
+def test_armed_spool_sequence_pins_fsync_before_replace(
+        tmp_path, monkeypatch):
+    """The graftlint v4 audit fix, as a runtime regression pin: a
+    durable save's committed replace is preceded by an fsync in the
+    SAME protocol entry (content durability before name durability)."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_FS", "1")
+    fss.watch_root(str(tmp_path))
+    p = str(tmp_path / "doc.npz")
+    save_state(p, _state(), compress=False, durable=True)
+    seq = [(t, o) for t, o, _ in fss.op_log()]
+    assert ("spool", "replace") in seq
+    assert ("spool", "fsync") in seq
+    assert seq.index(("spool", "fsync")) < seq.index(("spool", "replace"))
+    # non-durable saves skip the per-eviction fsync (the PR 2 cost
+    # contract): replace present, no fsync before it
+    fss.reset_counters()
+    save_state(p, _state(), compress=False)
+    seq = [(t, o) for t, o, _ in fss.op_log()]
+    assert seq and seq[0] == ("spool", "replace")
+    c = fss.counters()
+    assert c["ops"]["spool"]["replace"] == 1
+    assert c["unattributed"] == {}
+
+
+def test_armed_wal_seal_and_gc_attribution(tmp_path, monkeypatch):
+    """Journal protocols attribute where declared: seals (wal) fsync
+    before their rename; a GC pass (gc) commits its manifest before
+    any victim unlink — live-checked by the sanitizer, sequence-pinned
+    here."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_FS", "1")
+    jd = str(tmp_path / "j")
+    fss.watch_root(jd)
+    j = OpJournal(jd, segment_bytes=120)
+    for r in range(10):
+        j.round_record(r, {256: [[1, r, r + 1]]})
+        j.maybe_roll()
+    assert len(wal_segments(jd)) >= 2
+    info = j.compact(10)
+    assert info["deleted"] >= 1
+    j.close()
+    seq = [(t, o) for t, o, _ in fss.op_log()]
+    # seal: fsync precedes the segment rename, inside wal
+    first_seal = seq.index(("wal", "replace"))
+    assert ("wal", "fsync") in seq[:first_seal]
+    # GC: the manifest commit (gc replace) precedes the first victim
+    # unlink
+    gc_replace = seq.index(("gc", "replace"))
+    gc_unlink = seq.index(("gc", "unlink"))
+    assert gc_replace < gc_unlink
+    c = fss.counters()
+    assert c["unattributed"] == {}
+    assert set(c["ops"]) >= {"wal", "gc"}
+
+
+def test_reset_arms_eagerly_so_pre_entry_ops_are_unattributed(
+        tmp_path, monkeypatch):
+    """The G021 accounting must see mutating ops on watched roots from
+    the RESET on, not from the first protocol entry on — arming lazily
+    would blind the unattributed-op check for exactly the run prefix
+    where setup code touches durable territory outside any declared
+    protocol."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_FS", "1")
+    fss.watch_root(str(tmp_path))
+    fss.reset_counters()  # the bench's reset: installs + arms
+    src = tmp_path / "a"
+    src.write_text("x")
+    os.replace(str(src), str(tmp_path / "b"))  # no protocol entered yet
+    c = fss.counters()
+    assert c["unattributed"] == {"replace": 1}, c
+    assert fss.mutation_count() == 1
+
+
+def test_staging_dir_contents_are_staging_and_update_mode_is_mutating(
+        tmp_path, monkeypatch):
+    """Two path-role/op-vocabulary pins: (a) a file INSIDE a
+    ``snap_*.tmp`` staging directory is staging — destroying it needs
+    no prior commit (the sweep_staging shape on rmtree fallbacks that
+    unlink member-by-member); (b) an ``r+`` open is an UPDATE — a
+    crash boundary, frozen post-crash, and never a G019 read-witness
+    (the WAL torn-tail truncate repair mutates in place)."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_FS", "1")
+    fss.watch_root(str(tmp_path))
+    fss.reset_counters()
+    staging = tmp_path / "snap_00000004.tmp"
+    staging.mkdir()
+    member = staging / "MANIFEST.json"
+    member.write_text("{}")
+    with fss.fs_protocol("snapshot"):
+        os.unlink(str(member))  # staging: legal with no prior commit
+    durable = tmp_path / "journal.log"
+    durable.write_text("rec\n")
+    fss.reset_counters()
+    with fss.fs_protocol("wal"):
+        with open(str(durable), "r+b") as f:
+            f.truncate(2)
+    assert fss.counters()["ops"]["wal"] == {"update": 1}
+    assert fss.mutation_count() == 1  # the update IS a crash boundary
+    # ...and it is not a read-witness: a destructive op after it still
+    # raises
+    with pytest.raises(fss.DurableOrderingError):
+        with fss.fs_protocol("wal"):
+            with open(str(durable), "r+b") as f:
+                pass
+            os.unlink(str(durable))
+
+
+def test_live_g019_raises_on_unlink_before_install(tmp_path, monkeypatch):
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_FS", "1")
+    fss.watch_root(str(tmp_path))
+    p = str(tmp_path / "member.npz")
+    save_state(p, _state(), compress=False)
+    with pytest.raises(fss.DurableOrderingError):
+        with fss.fs_protocol("gc"):
+            os.unlink(p)
+    assert os.path.exists(p)  # the violating op never executed
+    # staging destruction is exempt...
+    t = str(tmp_path / "member.npz.tmp")
+    open(t, "w").close()
+    with fss.fs_protocol("gc"):
+        os.unlink(t)
+    # ...and the read-witness form (torn-pass completion) is legal
+    with fss.fs_protocol("gc"):
+        with open(p, "rb") as f:
+            f.read(4)
+        os.unlink(p)
+    assert not os.path.exists(p)
+
+
+def test_crash_freeze_keeps_cleanup_handlers_off_the_disk(tmp_path):
+    """Crash semantics are a DEAD PROCESS, not an exception: after the
+    injected crash, even the atomic writer's own `except: unlink(tmp)`
+    cleanup is frozen — the orphaned staging file stays behind exactly
+    as a real kill would leave it (recovery sweeps ignore `.tmp`)."""
+    fss.watch_root(str(tmp_path))
+    p = str(tmp_path / "doc.npz")
+    with pytest.raises(fss.InjectedCrash):
+        with fss.crash_at(0):  # op 0 = the commit replace
+            save_state(p, _state(), compress=False)
+    assert not os.path.exists(p)  # the commit never happened
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    assert leftovers, "the frozen cleanup should strand the tmp file"
+    assert not fss._armed  # crash_at disarms on exit (env unset)
+
+
+# ---------------------------------------------------------------------------
+# the headline: exhaustive crash-point enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_crash_enumeration_every_boundary_recovers(tmp_path):
+    """THE graftlint v4 acceptance gate: for every declared protocol
+    (snapshot barrier, delta chain, WAL seal + GC, spool churn, flight
+    dump), a crash injected at EVERY mutating fs-op boundary is
+    followed by byte-verified recovery — and the per-protocol point
+    counts are nonzero, so the harness cannot silently cover
+    nothing."""
+    from crdt_benches_tpu.serve.fscrash import enumerate_crash_points
+
+    report = enumerate_crash_points(str(tmp_path / "w"), small=True)
+    assert report["mutations"] > 0
+    assert report["verified"] == report["mutations"]
+    for tag in fss.KNOWN_PROTOCOLS:
+        assert report["per_protocol"].get(tag, 0) > 0, report
+
+
+# ---------------------------------------------------------------------------
+# G021 cross-check on a real sanitized drain
+# ---------------------------------------------------------------------------
+
+
+def test_g021_cross_check_clean_both_directions(tmp_path, monkeypatch):
+    """A sanitized 12-doc journaled drain emits an fs_ops block that
+    cross-checks clean against the static durable= markers in BOTH
+    directions: no dead declared protocols (every armed surface's
+    protocols entered) and no unattributed runtime fs ops."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_FS", "1")
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix={"synth-small": 0.6, "synth-medium": 0.4},
+        bands={
+            "synth-small": ("synth", (10, 60)),
+            "synth-medium": ("synth", (150, 360)),
+        },
+        n_docs=12, batch=16, classes=(256, 1024), slots=(4, 2),
+        macro_k=2, batch_chars=64, arrival_span=2, verify_sample=4,
+        journal_dir="auto", snapshot_every=2, snapshot_full_every=2,
+        wal_segment_bytes=256,
+        results_dir=str(tmp_path), save_name="fs_smoke", log=lambda s: None,
+    )
+    assert info["verify_ok"]
+    block = r.extra["fs_ops"]
+    assert block["version"] == 1 and block["sanitized"]
+    assert block["journal"] and block["spool"]
+    for tag in ("snapshot", "gc", "wal", "spool"):
+        assert block["protocols"].get(tag, 0) > 0, block["protocols"]
+    assert block["unattributed"] == {}
+    artifact = str(tmp_path / "fs_smoke.json")
+    assert os.path.exists(artifact)
+    findings = run_lint([PACKAGE], select={"G021"}, fs_artifact=artifact)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.msg}" for f in findings
+    )
+
+
+def test_g021_flags_dead_protocol_and_rogue_tag_on_doctored_block(
+        tmp_path):
+    """Both failure directions, against a doctored artifact: a dead
+    declared protocol (armed surface, zero entries) and a runtime tag
+    + unattributed ops no static marker explains."""
+    artifact = tmp_path / "doctored.json"
+    artifact.write_text(json.dumps({"fs_ops": {
+        "version": 1, "sanitized": True,
+        "journal": True, "spool": False, "flight": False,
+        "protocols": {"gc": 3, "wal": 9, "rogue": 1},
+        "ops": {"gc": {"replace": 3}, "rogue": {"unlink": 1}},
+        "unattributed": {"rmtree": 2},
+    }}))
+    findings = run_lint([PACKAGE], select={"G021"},
+                        fs_artifact=str(artifact))
+    msgs = [f.msg for f in findings]
+    # snapshot is journal-armed but never entered -> dead
+    assert any("`snapshot` never entered" in m for m in msgs)
+    # spool surface not armed -> spool NOT dead-checked
+    assert not any("`spool` never entered" in m for m in msgs)
+    assert any("rogue" in m for m in msgs)
+    assert any("unattributed runtime `rmtree`" in m for m in msgs)
+
+
+def test_fs_ops_block_present_and_entry_counted_disarmed(tmp_path):
+    """A plain (disarmed, journal-less) drain still carries the fs_ops
+    block with protocol ENTRY counts — the always-on half of the G021
+    ground truth, exactly like publish entries for G017."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix={"synth-small": 1.0},
+        bands={"synth-small": ("synth", (10, 40))},
+        n_docs=6, batch=16, classes=(256,), slots=(3,),
+        macro_k=2, batch_chars=64, arrival_span=1, verify_sample=2,
+        results_dir=str(tmp_path), save_name="fs_plain",
+        log=lambda s: None,
+    )
+    assert info["verify_ok"]
+    block = r.extra["fs_ops"]
+    assert block["version"] == 1 and not block["sanitized"]
+    assert not block["journal"] and not block["flight"]
+    assert block["ops"] is None and block["unattributed"] is None
+    # spool entries show up whenever the pool spooled (evictions with
+    # 6 docs on 3 rows)
+    if block["spool"]:
+        assert block["protocols"].get("spool", 0) > 0

@@ -41,13 +41,13 @@ ALL_FIXTURE_FILES = sorted(p for p in FIXTURES.glob("**/*.py"))
 
 #: Cross-module corpora (``xmod_*`` directories) lint as a UNIT — their
 #: rules see nothing in a single-file run — so the per-file contract
-#: below covers only the standalone fixtures.  The G017 fixture is
-#: artifact-driven the same way G011 is (no ground truth, no findings),
-#: so its explicit test passes the artifact instead.
+#: below covers only the standalone fixtures.  The G017 and G021
+#: fixtures are artifact-driven the same way G011 is (no ground truth,
+#: no findings), so their explicit tests pass the artifact instead.
 FIXTURE_FILES = [
     p for p in ALL_FIXTURE_FILES
     if not any(part.startswith("xmod_") for part in p.parts)
-    and p.name != "g017_dead_publish.py"
+    and p.name not in ("g017_dead_publish.py", "g021_dead_protocol.py")
 ]
 XMOD_DIRS = sorted(
     d for d in FIXTURES.iterdir()
@@ -56,6 +56,7 @@ XMOD_DIRS = sorted(
 G008_DIR = FIXTURES / "xmod_g008"
 G011_DIR = FIXTURES / "xmod_g011"
 THREADS_DIR = FIXTURES / "threads"
+FSOPS_DIR = FIXTURES / "fsops"
 
 
 def test_corpus_is_nonempty():
@@ -289,6 +290,7 @@ def test_every_rule_has_a_detection_case():
         "G001", "G002", "G003", "G004", "G005", "G006", "G007",
         "G008", "G009", "G010", "G011", "G012", "G013",
         "G014", "G015", "G016", "G017",
+        "G018", "G019", "G020", "G021",
     } <= covered
 
 
@@ -392,6 +394,92 @@ def test_g017_selected_without_artifact_fails_like_g011():
     )
     assert [f.rule for f in findings] == ["G000"]
     assert "--thread-artifact" in findings[0].msg
+
+
+def test_fsops_corpus_covers_each_rule_per_hazard():
+    """The crash-consistency corpus seeds the canonical shape of each
+    hazard at exact lines: the in-place durable write + the
+    fsync-less commit + the typo'd protocol tag (G018), the PR 13
+    unlink-before-install window (G019), and both verify-before-trust
+    breaks — the trusted np.load and the too-narrow recovery catch-set
+    (G020) — while every legal twin (staged write, fsynced commit,
+    commit-then-destroy, read-witness cleanup, CRC-verified read,
+    garbage-covering fallback) stays silent."""
+    g018_path = FSOPS_DIR / "g018_atomic.py"
+    g018 = run_lint([str(g018_path)])
+    assert {f.rule for f in g018} == {"G018"}
+    assert [(f.rule, f.line) for f in g018] == sorted(
+        expected_markers(g018_path), key=lambda rl: rl[1]
+    )
+    assert "in-place write-mode open" in g018[0].msg
+    assert "no fsync" in g018[1].msg
+    assert "unknown durable protocol" in g018[2].msg
+    g019_path = FSOPS_DIR / "g019_order.py"
+    g019 = run_lint([str(g019_path)])
+    assert [(f.rule, f.line) for f in g019] == sorted(
+        expected_markers(g019_path), key=lambda rl: rl[1]
+    )
+    assert len(g019) == 1 and "destroys the only copy" in g019[0].msg
+    g020_path = FSOPS_DIR / "g020_trust.py"
+    g020 = run_lint([str(g020_path)])
+    assert [(f.rule, f.line) for f in g020] == sorted(
+        expected_markers(g020_path), key=lambda rl: rl[1]
+    )
+    assert "trusted np.load" in g020[0].msg
+    assert "parseable-garbage" in g020[1].msg
+
+
+def test_g021_dead_protocol_and_unattributed_ops():
+    """G021 mirrors G011/G017 for durable protocols: a declared
+    protocol the artifact's run never entered is flagged at its def
+    line (scoped by armed surface — the fixture artifact armed
+    ``flight`` only), a runtime tag with no marker and unattributed
+    mutating ops are flagged against the artifact.  Without an
+    artifact the rule stays silent."""
+    artifact = FSOPS_DIR / "artifact.json"
+    path = FSOPS_DIR / "g021_dead_protocol.py"
+    findings = run_lint([str(path)], fs_artifact=str(artifact))
+    dead = {(f.path, f.rule, f.line) for f in findings
+            if f.path.endswith(".py")}
+    assert dead == {
+        (str(path), r, ln) for r, ln in expected_markers(path)
+    }, "\n".join(f"  {f.path}:{f.line} {f.rule} {f.msg}" for f in findings)
+    from_artifact = [f for f in findings if f.path == str(artifact)]
+    assert len(from_artifact) == 2
+    assert any("rogue_proto" in f.msg for f in from_artifact)
+    assert any("unattributed runtime `unlink`" in f.msg
+               for f in from_artifact)
+    assert run_lint([str(path)]) == []  # no artifact -> no G021
+
+
+def test_g021_selected_without_artifact_fails_like_g011():
+    findings = run_lint(
+        [str(FSOPS_DIR / "g021_dead_protocol.py")], select={"G021"}
+    )
+    assert [f.rule for f in findings] == ["G000"]
+    assert "--fs-artifact" in findings[0].msg
+
+
+def test_fsops_suppression_contract():
+    """`# graftlint: disable=G018/19/20` silences the crash-
+    consistency rules exactly like every other rule."""
+    findings = run_lint([str(FSOPS_DIR / "suppressed_clean.py")])
+    assert findings == []
+
+
+def test_sarif_covers_the_fsops_rules():
+    """The SARIF reporter carries the new rules with the same
+    everything-is-an-error gate semantics (CI annotation surfaces
+    ingest the crash-consistency findings like any other)."""
+    from crdt_benches_tpu.lint import format_sarif
+
+    findings = run_lint([str(FSOPS_DIR / "g018_atomic.py"),
+                         str(FSOPS_DIR / "g019_order.py"),
+                         str(FSOPS_DIR / "g020_trust.py")])
+    doc = json.loads(format_sarif(findings))
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rules == {"G018", "G019", "G020"}
+    assert all(r["level"] == "error" for r in doc["runs"][0]["results"])
 
 
 def test_historical_bugs_caught_by_the_right_rule():

@@ -451,3 +451,21 @@ def test_bench_compare_tolerates_replication_blocks(tmp_path, capsys):
     assert "replication block" in out and "SKIP" in out
     # and symmetric: plain new run vs a replicated baseline
     assert bc.main([base, str(p)]) == 0
+    # graftlint v4: the fs_ops durable-protocol block rides the same
+    # one-sided matrix — present on either side alone is a skip with a
+    # note in BOTH directions, never exit 2
+    fsops = json.loads(Path(base).read_text())
+    fsops[0]["extra"]["fs_ops"] = {
+        "version": 1, "sanitized": True, "journal": True,
+        "spool": True, "flight": False,
+        "protocols": {"wal": 9, "gc": 2, "snapshot": 3, "spool": 12},
+        "ops": {"wal": {"replace": 3}}, "unattributed": {},
+    }
+    q = tmp_path / "fsops.json"
+    q.write_text(json.dumps(fsops))
+    assert bc.main([str(q), base]) == 0
+    out = capsys.readouterr().out
+    assert "fs_ops block" in out and "SKIP" in out
+    assert bc.main([base, str(q)]) == 0
+    out = capsys.readouterr().out
+    assert "fs_ops block" in out and "SKIP" in out

@@ -131,9 +131,13 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: runs) — skip-with-note in BOTH directions: a tier run diffed
 #: against a flat baseline (or vice versa) is a schema difference,
 #: never an error.
+#: ``fs_ops`` is the graftlint v4 durable-protocol block (fs sanitizer
+#: entry/op counters, G021's ground truth) — same both-directions
+#: skip: artifacts written before the block existed (or by a run that
+#: never journaled) diff cleanly against sanitized ones.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
-                    "recovery", "residency")
+                    "recovery", "residency", "fs_ops")
 
 
 def _tier_hit_rate(extra: dict) -> float | None:

@@ -43,7 +43,12 @@
 # crash-during-compaction and delta-chain-corruption chaos kinds:
 # recover_fleet must restore from the surviving chain, resume the redo
 # tail, and byte-verify against the oracle (the runner's exit code
-# carries the gate).
+# carries the gate) — then the graftlint v4 crash-consistency legs: a
+# 12-doc drain under CRDT_BENCH_SANITIZE_FS=1 (fs ops attributed to
+# their declared durable protocols, G019 orderings enforced live, the
+# G021 cross-check green in both directions against the emitted fs_ops
+# block) and the exhaustive crash-point enumeration harness (a crash
+# at EVERY mutating fs-op boundary must recover byte-verified).
 #
 # Artifacts land in bench_results/ under smoke-specific names so they
 # never clobber committed headline numbers.
@@ -510,7 +515,7 @@ PYEOF
         --serve-longhaul 4 --serve-crash-round 4 \
         --serve-faults "seed=3,crash_compact@2=1,delta_corrupt@2=1" \
         --serve-save-name serve_longhaul_crash_smoke
-    exec python - <<'PYEOF'
+    python - <<'PYEOF'
 import json
 extras = [e["extra"] for e in json.load(open("bench_results/serve_longhaul_crash_smoke.json"))
           if e.get("extra", {}).get("family") == "serve"]
@@ -539,6 +544,50 @@ print(f"longhaul crash smoke: crash_compact + delta_corrupt fired and "
       f"completed) + {rec['redo_ops']} redo ops, WAL "
       f"{rec['journal_disk_bytes']} B on disk, oracle verify green")
 PYEOF
+    # FS-sanitized crash-consistency leg (graftlint v4): a 12-doc
+    # journaled drain under CRDT_BENCH_SANITIZE_FS=1 — the filesystem
+    # surface is interposed, every op on the journal/spool roots is
+    # attributed to its declared durable protocol, and the G019
+    # ordering invariants are enforced LIVE (an unlink before its
+    # committed install raises at the callsite).  The artifact's
+    # fs_ops block is then cross-checked by G021 in both directions:
+    # dead declared protocols and unattributed runtime fs ops both
+    # fail the gate.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_FS=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 12 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 2 \
+        --serve-full-every 2 --serve-wal-segment-bytes 4096 \
+        --serve-save-name serve_longhaul_fs_smoke
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G021 \
+      --fs-artifact bench_results/serve_longhaul_fs_smoke.json
+    python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_longhaul_fs_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+fo = extras[0]["fs_ops"]
+assert fo["sanitized"] and fo["journal"], fo
+for tag in ("wal", "gc", "snapshot"):
+    assert fo["protocols"].get(tag, 0) > 0, (tag, fo["protocols"])
+assert fo["unattributed"] == {}, fo["unattributed"]
+assert set(fo["ops"]) <= set(fo["protocols"]), (fo["ops"], fo["protocols"])
+print(f"fs leg: {sum(fo['protocols'].values())} protocol entries, "
+      f"{sum(n for t in fo['ops'].values() for n in t.values())} fs ops "
+      "attributed, zero unattributed, G021 clean both directions")
+PYEOF
+    # ...and the headline: exhaustive crash-point enumeration — a
+    # crash injected at EVERY mutating fs-op boundary of the
+    # sub-minute protocol workload (snapshot barriers, delta chains,
+    # WAL seal+GC, spool churn, flight dump) must be followed by
+    # byte-verified recovery; the per-protocol point counts are
+    # asserted nonzero inside the harness so it can never silently
+    # cover nothing.
+    exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.serve.fscrash --small
     ;;
   serve-tier)
     # Tiered-residency smoke: 40 docs on a ~14-row hot budget with a
