@@ -655,6 +655,7 @@ def run_serve(args) -> int:
     from ..serve.bench import (
         ensure_virtual_devices,
         run_serve_bench,
+        run_serve_open_sweep,
         run_serve_soak,
     )
 
@@ -684,6 +685,7 @@ def run_serve(args) -> int:
             ("--serve-trace", args.serve_trace is not None),
             ("--serve-profile", args.serve_profile > 0),
             ("--serve-flight", args.serve_flight is not None),
+            ("--serve-open", args.serve_open is not None),
         ]
         bad = [flag for flag, hit in unsupported if hit]
         if bad:
@@ -729,6 +731,52 @@ def run_serve(args) -> int:
         ok = info["verify_ok"] and info["ra_ok"] and info["faults_ok"]
         return 0 if ok else 1
 
+    if args.serve_open is not None:
+        # open-loop live serving: unsupported combinations are REJECTED,
+        # not silently dropped (same contract as the replicated matrix
+        # above) — recovery/longhaul replay a closed-loop journal tail,
+        # the tiered family is its own bench id, and the ingest pump
+        # feeds exactly one scheduler's bounded queues
+        unsupported = [
+            ("--serve-longhaul", args.serve_longhaul > 0),
+            ("--serve-recover", args.serve_recover),
+            ("--serve-crash-round", args.serve_crash_round > 0),
+            ("--serve-mesh", args.serve_mesh > 1),
+            ("--serve-tiers", args.serve_tiers is not None),
+        ]
+        bad = [flag for flag, hit in unsupported if hit]
+        if bad:
+            print(
+                f"{', '.join(bad)} not supported with --serve-open "
+                "(the open-loop family serves live wire arrivals; "
+                "see serve/ingest/)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.serve_open_sweep is not None and args.serve_soak is not None:
+            print(
+                "--serve-open-sweep probes are one-shot drains; "
+                "--serve-soak does not compose with the sweep",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        # ingest-only flags without the front are configuration errors
+        orphaned = [
+            ("--serve-tenants", args.serve_tenants is not None),
+            ("--serve-deadline", args.serve_deadline),
+            ("--serve-deadline-budget", args.serve_deadline_budget > 0),
+            ("--serve-open-sweep", args.serve_open_sweep is not None),
+        ]
+        bad = [flag for flag, hit in orphaned if hit]
+        if bad:
+            print(
+                f"{', '.join(bad)} configure the live ingest front: "
+                "--serve-open RATE is required",
+                file=sys.stderr,
+            )
+            return 2
+
     mesh_devices = ensure_virtual_devices(args.serve_mesh)
     common = dict(
         mix=args.serve_mix,
@@ -755,6 +803,10 @@ def run_serve(args) -> int:
         faults=args.serve_faults,
         queue_cap=args.serve_queue_cap,
         overflow_policy=args.serve_overflow_policy,
+        open_spec=args.serve_open,
+        tenants_spec=args.serve_tenants,
+        deadline=args.serve_deadline,
+        deadline_budget=args.serve_deadline_budget,
         save_name=args.serve_save_name,
         trace_path=args.serve_trace,
         profile_rounds=args.serve_profile,
@@ -775,6 +827,32 @@ def run_serve(args) -> int:
             timeseries_window=args.serve_timeseries_window,
             watchdog_s=args.serve_watchdog,
             **common,
+        )
+    elif args.serve_open_sweep is not None:
+        # knee sweep: probe each offered rate, then run the configured
+        # rate as the final artifact-bearing drain (knee block attached)
+        try:
+            rates = [float(x) for x in
+                     args.serve_open_sweep.split(",") if x.strip()]
+        except ValueError:
+            print(
+                f"--serve-open-sweep: bad rate list "
+                f"{args.serve_open_sweep!r}",
+                file=sys.stderr,
+            )
+            return 2
+        sweep_kw = dict(common)
+        sweep_kw.pop("open_spec")
+        sweep_kw.pop("save_name")
+        r, info = run_serve_open_sweep(
+            rates,
+            open_spec=args.serve_open,
+            save_name=args.serve_save_name,
+            seed=args.serve_seed,
+            status_port=args.serve_status,
+            timeseries_path=args.serve_timeseries,
+            timeseries_window=args.serve_timeseries_window,
+            **sweep_kw,
         )
     else:
         r, info = run_serve_bench(
@@ -803,6 +881,36 @@ def run_serve(args) -> int:
             f"{res['warm_hits']} (prefetched {res['prefetch_hits']}), "
             f"cold restores {res['cold_restores']}, hit rate "
             + (f"{hr:.3f}" if hr is not None else "n/a")
+        )
+    if r.extra.get("ingest") is not None:
+        ing = r.extra["ingest"]
+        fr = ing["front"]
+        dl = ing["deadline"]
+        tenants = ing["admission"]["tenants"]
+        hit = dl.get("hit_rate")
+        print(
+            f"  ingest: {fr['ops_delivered']} ops / "
+            f"{fr['ops_frames']} frames over {fr['sessions_opened']} "
+            f"sessions ({fr['sessions_resumed']} resumed, "
+            f"{fr['churn_drops']} churn drops); "
+            + "; ".join(
+                f"{t}: admit {d['admitted_ops']} defer "
+                f"{d['deferred_ops']} shed {d['shed_ops']}"
+                for t, d in sorted(tenants.items())
+            )
+            + (f"; deadline hit rate {hit:.3f} "
+               f"({'EDF' if dl['edf'] else 'rr'})"
+               if hit is not None else "")
+        )
+    if r.extra.get("knee") is not None:
+        knee = r.extra["knee"]
+        print(
+            f"  knee: capacity {knee['capacity_ops_per_round']:.1f} "
+            f"ops/round over {len(knee['points'])} probes — "
+            + ", ".join(
+                f"u={p['utilization']:.2f}:p99 {p['p99_ms']:.1f}ms"
+                for p in knee["points"]
+            )
         )
     if r.extra["faults"] is not None:
         f = r.extra["faults"]
@@ -1035,6 +1143,39 @@ def main(argv=None) -> int:
                     metavar="N",
                     help="coalesced ops per writer turn block (the "
                          "replication authorship/broadcast unit)")
+    ap.add_argument("--serve-open", default=None, metavar="RATE",
+                    help="open-loop live serving (serve/ingest/): start "
+                         "the sessioned TCP ingest front and offer "
+                         "RATE ops/macro-round over seeded arrivals — "
+                         "'RATE' or 'RATE:poisson' / 'RATE:burst'.  "
+                         "Bench ids become serve/open/<mix>/<fleet>; "
+                         "the per-doc queue cap defaults on (8*batch) "
+                         "and delivery flows exclusively through "
+                         "per-tenant admission control")
+    ap.add_argument("--serve-tenants", default=None, metavar="SPEC",
+                    help="ingest admission tenants, "
+                         "'name=RATE[:BURST[:BUDGET]],...' — token "
+                         "refill per round, bucket depth (default "
+                         "4*RATE), in-queue op budget (default "
+                         "unbounded); e.g. 'gold=256:1024,"
+                         "free=16:32:256' (requires --serve-open)")
+    ap.add_argument("--serve-deadline", action="store_true",
+                    help="earliest-deadline-first selection over "
+                         "per-class latency budgets (serve/ingest/"
+                         "deadline.py) instead of round-robin "
+                         "(requires --serve-open)")
+    ap.add_argument("--serve-deadline-budget", type=int, default=0,
+                    metavar="N",
+                    help="default per-doc deadline budget in macro-"
+                         "rounds past arrival (0 = auto from the "
+                         "offered load)")
+    ap.add_argument("--serve-open-sweep", default=None, metavar="RATES",
+                    help="offered-load sweep: probe the open-loop "
+                         "drain at each comma-separated rate, then "
+                         "run --serve-open's configured rate as the "
+                         "artifact-bearing final run with the "
+                         "p99-vs-utilization knee curve attached "
+                         "(requires --serve-open)")
     ap.add_argument("--serve-seed", type=int, default=0)
     ap.add_argument("--serve-arrival-span", type=int, default=8)
     ap.add_argument("--serve-verify-sample", type=int, default=8,

@@ -911,10 +911,12 @@ def g012_obs_hygiene(index: PackageIndex) -> list[Finding]:
 #: below): binding a port or accepting connections belongs to the bench
 #: driver, never the serving hot path.
 _G013_SERVER_CTORS = {
-    "HTTPServer", "ThreadingHTTPServer", "TCPServer", "UDPServer",
-    "StatusServer",
+    "HTTPServer", "ThreadingHTTPServer", "TCPServer",
+    "ThreadingTCPServer", "UDPServer", "ThreadingUDPServer",
+    "StatusServer", "IngestFront",
 }
-_G013_SERVER_SOURCES = ("http.server", "socketserver", "obs.status")
+_G013_SERVER_SOURCES = ("http.server", "socketserver", "obs.status",
+                        "serve.ingest")
 
 #: obs/ v3 lifecycle constructors: the flight recorder and the request
 #: tracker are built (and armed — the tracker installs a global
@@ -942,7 +944,7 @@ def _g013_call_finding(fi: FuncInfo, node: ast.Call, chain: str
     if tail in _G013_SERVER_CTORS:
         root = d.split(".")[0]
         src = m.imports.get(root, "")
-        if tail == "StatusServer" or any(
+        if tail in ("StatusServer", "IngestFront") or any(
             s in src for s in _G013_SERVER_SOURCES
         ):
             return Finding(
@@ -950,9 +952,9 @@ def _g013_call_finding(fi: FuncInfo, node: ast.Call, chain: str
                 col=node.col_offset,
                 msg=(
                     f"`{tail}(...)` constructed in a hot-path scope "
-                    f"({chain}) — the status server is thread-confined "
-                    "and driver-owned; the drain only swaps snapshot "
-                    "references in"
+                    f"({chain}) — servers are thread-confined and "
+                    "driver-owned (status AND the ingest front); the "
+                    "drain only swaps snapshot references in"
                 ),
             )
     # (a') obs/ v3 lifecycle construction (flight recorder / request

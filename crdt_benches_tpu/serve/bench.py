@@ -59,11 +59,27 @@ from ..obs.status import StatusServer
 from ..obs.timeseries import ServeTelemetry, TimeseriesRecorder
 from ..oracle.text_oracle import replay_trace
 from .faults import (
+    INGEST_KINDS,
     JOURNAL_KINDS,
     REPLICATION_KINDS,
     TIER_KINDS,
     FaultInjector,
     FaultPlan,
+)
+from .ingest.admission import (
+    DEFAULT_TENANT,
+    AdmissionController,
+    TenantPolicy,
+    parse_tenant_spec,
+)
+from .ingest.deadline import DeadlineScheduler
+from .ingest.front import IngestFront
+from .ingest.loadgen import (
+    IngestPump,
+    OpenLoadClient,
+    build_open_plan,
+    drive_open_loop,
+    parse_open_spec,
 )
 from .journal import DEFAULT_SEGMENT_BYTES, OpJournal, recover_fleet
 from .pool import DocPool
@@ -260,6 +276,11 @@ def run_serve_bench(
     longhaul: int = 0,
     measure_recovery: bool = False,
     crash_after: int = 0,
+    open_spec: str | None = None,
+    tenants_spec: str | None = None,
+    deadline: bool = False,
+    deadline_budget: int = 0,
+    knee_block: dict | None = None,
     faults=None,
     queue_cap: int = 0,
     overflow_policy: str = "defer",
@@ -347,8 +368,48 @@ def run_serve_bench(
             "--serve-tiers and --serve-longhaul are separate bench "
             "families (serve/tier/* vs serve/longhaul/*); pick one"
         )
+    # open-loop serving (serve/open/<mix>/<fleet>): live ingest front +
+    # per-tenant admission + the deadline-aware scheduler — arrivals
+    # come over the wire at a configured offered load instead of the
+    # closed-loop trace replay
+    open_rate, open_process = 0.0, ""
+    if open_spec:
+        open_rate, open_process = parse_open_spec(open_spec)
+        if longhaul or warm_docs:
+            raise ValueError(
+                "--serve-open is its own bench family (serve/open/*); "
+                "--serve-longhaul / --serve-tiers do not compose with it"
+            )
+        if measure_recovery or crash_after:
+            raise ValueError(
+                "--serve-open does not support the measured recovery "
+                "leg (--serve-recover / --serve-crash-round): the "
+                "open-loop drain has no resumable closed-loop replay"
+            )
+        if mesh_devices > 1:
+            raise ValueError(
+                "--serve-open is single-host for now (the ingest pump "
+                "feeds one scheduler's bounded queues)"
+            )
+        if queue_cap <= 0:
+            # the pump delivers through the bounded-queue admission
+            # rule; unbounded queues would make admission meaningless
+            queue_cap = 8 * batch
+            log(f"serve: open-loop needs a bounded queue; "
+                f"defaulting queue_cap={queue_cap}")
+    if tenants_spec and not open_spec:
+        raise ValueError(
+            "--serve-tenants configures the ingest admission "
+            "controller: --serve-open is required"
+        )
+    if deadline and not open_spec:
+        raise ValueError(
+            "--serve-deadline selects EDF over the ingest deadline "
+            "budgets: --serve-open is required"
+        )
     mix_label = f"longhaul/{mix_name}" if longhaul else (
-        f"tier/{mix_name}" if warm_docs else mix_name
+        f"tier/{mix_name}" if warm_docs
+        else f"open/{mix_name}" if open_rate else mix_name
     )
 
     plan = None
@@ -373,6 +434,15 @@ def run_serve_bench(
                 f"fault kinds {tier_kinds} target the warm tier / "
                 "prefetcher: --serve-tiers is required — a two-tier "
                 "drain never reaches their injection points"
+            )
+        ingest_kinds = sorted({
+            e.kind for e in plan.events if e.kind in INGEST_KINDS
+        })
+        if ingest_kinds and not open_spec:
+            raise ValueError(
+                f"fault kinds {ingest_kinds} target the live ingest "
+                "front: --serve-open is required — a closed-loop "
+                "replay never polls them"
             )
         if queue_cap <= 0 and any(
             e.kind == "queue_overflow" for e in plan.events
@@ -419,6 +489,7 @@ def run_serve_bench(
     default_name = (
         f"serve_longhaul_{mix_name}_{n_docs}" if longhaul
         else f"serve_tier_{mix_name}_{n_docs}" if warm_docs
+        else f"serve_open_{mix_name}_{n_docs}" if open_rate
         else f"serve_{mix_name}_{n_docs}"
     )
 
@@ -448,9 +519,11 @@ def run_serve_bench(
     reqtrace = arm_reqtrace(reqtrace_samples, slo, slo_spec, log)
 
     pool = None
+    front = None
     # every exit path — including a failed drain or verify — must
-    # close the journal, drop an owned journal dir, and release the
-    # pool's spool directory (CI chaos runs must not leak temp dirs)
+    # close the journal, drop an owned journal dir, release the
+    # pool's spool directory, and stop a live ingest front (CI chaos
+    # runs must not leak temp dirs or listener threads)
     try:
         # publish-point / cross-thread counters must start counting
         # BEFORE the first status publish (the note_phase below enters
@@ -514,8 +587,8 @@ def run_serve_bench(
 
         profiler = DeviceProfiler(profile_rounds) \
             if profile_rounds > 0 else None
-        sched = FleetScheduler(
-            pool, streams, batch=batch, macro_k=macro_k,
+        sched_kw = dict(
+            batch=batch, macro_k=macro_k,
             batch_chars=batch_chars,
             queue_cap=queue_cap, overflow_policy=overflow_policy,
             faults=FaultInjector(plan) if plan else None,
@@ -526,6 +599,39 @@ def run_serve_bench(
             reqtrace=reqtrace, slo=slo,
             warm_start=True,
         )
+        open_plan = admission = pump = load_client = None
+        if open_rate:
+            # delivery belongs to the ingest pump alone: burst=0 makes
+            # the scheduler's own per-round _deliver a no-op, so every
+            # op reaches the bounded queues through admission
+            for st in streams.values():
+                st.burst = 0
+            policies = parse_tenant_spec(tenants_spec) if tenants_spec \
+                else {DEFAULT_TENANT: TenantPolicy(
+                    DEFAULT_TENANT, rate=max(1.0, 2.0 * open_rate))}
+            admission = AdmissionController(
+                policies, slo=slo, journal=journal)
+            open_plan = build_open_plan(
+                streams, rate=open_rate, process=open_process,
+                seed=seed, tenant_names=tuple(policies))
+            expected = -(-open_plan.total_ops // max(1, int(open_rate)))
+            sched = DeadlineScheduler(
+                pool, streams, edf=deadline,
+                default_budget=deadline_budget or max(
+                    64, 2 * expected + arrival_span),
+                **sched_kw,
+            )
+            log(
+                f"serve: open-loop {open_process} arrivals at "
+                f"{open_rate:g} ops/round over "
+                f"{len(open_plan.sessions)} sessions "
+                f"({open_plan.total_frames} frames, horizon "
+                f"{open_plan.horizon} rounds); tenants "
+                f"{','.join(sorted(policies))}; selection "
+                f"{'EDF' if deadline else 'round-robin'}"
+            )
+        else:
+            sched = FleetScheduler(pool, streams, **sched_kw)
         # per-fence boundary-sync counters cover drain + verify; with
         # CRDT_BENCH_SANITIZE_SYNCS=1 any sync outside a declared fence
         # raises inside run() at its callsite
@@ -554,15 +660,37 @@ def run_serve_bench(
             obs_trace.arm()
             armed_here = True
             log(f"serve: span tracer ARMED -> {trace_path}")
+        if open_rate:
+            # the front goes live LAST — after the sanitizer resets
+            # above, so every handler publish lands in the artifact's
+            # thread_crossings counts (G017's ground truth)
+            front = IngestFront(set(streams), tuple(admission.policies))
+            admission.bind(sched.stats.metrics)
+            port = front.start()
+            log(f"serve: ingest front on 127.0.0.1:{port} "
+                f"({len(open_plan.sessions)} sessions inbound)")
+            pump = IngestPump(
+                sched, front, admission,
+                tenant_of=open_plan.tenant_of, faults=sched.faults,
+            )
+            sched.ingest_status = pump.status_fields
+            load_client = OpenLoadClient(port, open_plan)
         profile_block = None
         try:
             try:
-                # crash_after > 0 = the injected crash: kill the drain
-                # after N macro-rounds and let the recovery leg resume
-                # from nothing but the journal directory
-                stats = sched.run(
-                    max_rounds=crash_after if crash_after else None
-                )
+                if open_rate:
+                    load_client.start()
+                    stats = drive_open_loop(
+                        sched, pump, load_client, log=log)
+                    load_client.join()
+                    front.stop()
+                else:
+                    # crash_after > 0 = the injected crash: kill the
+                    # drain after N macro-rounds and let the recovery
+                    # leg resume from nothing but the journal directory
+                    stats = sched.run(
+                        max_rounds=crash_after if crash_after else None
+                    )
             except BaseException as e:
                 # crash post-mortem: dump the flight window before the
                 # exception leaves the drain (the exit code alone is
@@ -602,6 +730,26 @@ def run_serve_bench(
                     + ", ".join(
                         f"{o['name']} {o['total_ms']:.1f}ms" for o in top
                     ))
+        if front is not None:
+            ff = front.status_fields()
+            dl = sched.deadline_fields()
+            hit = dl.get("hit_rate")
+            log(
+                f"serve: ingest — {ff['ops_frames']} op frames / "
+                f"{ff['ops_delivered']} ops over "
+                f"{ff['sessions_opened']} sessions "
+                f"({ff['sessions_resumed']} resumed, "
+                f"{ff['churn_drops']} churn drops); "
+                + "; ".join(
+                    f"{t}: admit {d['admitted_ops']} defer "
+                    f"{d['deferred_ops']} shed {d['shed_ops']}"
+                    for t, d in sorted(
+                        admission.status_fields()["tenants"].items())
+                )
+                + (f"; deadline hit rate {hit:.3f}"
+                   f" ({'EDF' if dl['edf'] else 'round-robin'})"
+                   if hit is not None else "")
+            )
         crashed = crash_after > 0 and not sched.done
         if crash_after:
             log(f"serve: CRASH injected after {stats.rounds} macro-"
@@ -906,6 +1054,9 @@ def run_serve_bench(
             # the prefetch surface (serve/prefetch.py publish=prefetch)
             # is armed exactly when the tiered pool ran its worker
             "prefetch": pool.prefetcher is not None,
+            # the ingest surface (serve/ingest/front.py publish=ingest)
+            # is armed exactly when a live front served the drain
+            "ingest": front is not None,
             "publishes": race_counts["publishes"],
             "crossings": (
                 race_counts["crossings"] if race_sanitized else None
@@ -1074,6 +1225,26 @@ def run_serve_bench(
                 # recovery leg ran): recover_ms + redo-span +
                 # chain-depth breakdown, gated by bench_compare
                 "recovery": recovery_block,
+                # live ingest (None unless --serve-open armed): wire +
+                # admission + deadline ground truth — offered load,
+                # front/session counters, per-tenant admit/defer/shed,
+                # EDF hit rate (bench_compare: one-sided skip-with-note)
+                "ingest": None if front is None else {
+                    "version": 1,
+                    "open": open_plan.to_dict(),
+                    "front": front.status_fields(),
+                    "client": load_client.to_dict(),
+                    "admission": admission.to_dict(),
+                    "deadline": sched.deadline_fields(),
+                    "late_frames": pump.late_frames,
+                    "admitted_frames": pump.admitted_frames,
+                    "dup_frames": pump.dup_frames,
+                    "shed_docs": pump.shed_docs,
+                    "drained_frames": pump.drained_frames,
+                },
+                # offered-load sweep output (run_serve_open_sweep's
+                # final run only): the p99-vs-utilization knee curve
+                "knee": knee_block,
                 "faults": fault_summary,
                 "boundary_syncs": boundary_syncs,
                 "thread_crossings": thread_crossings,
@@ -1151,8 +1322,84 @@ def run_serve_bench(
             shutil.rmtree(journal_dir, ignore_errors=True)
         if owns_telemetry and telemetry is not None:
             telemetry.close()  # stop the status server, close the stream
+        if front is not None:
+            front.stop()  # idempotent; kills handler threads on a crash
         if pool is not None:
             pool.close()  # drop an owned spool directory
+
+
+def run_serve_open_sweep(
+    sweep_rates,
+    *,
+    open_spec: str,
+    save_name: str | None = None,
+    log=print,
+    **kw,
+) -> tuple[BenchResult, dict]:
+    """Offered-load sweep: probe the open-loop drain at each rate in
+    ``sweep_rates``, then run the CONFIGURED rate (``open_spec``) as
+    the final, artifact-bearing run with the measured knee curve
+    attached as its ``knee`` block.
+
+    Each probe is a full open-loop drain (live front, real wire) at
+    ``probe_rate`` with the heavyweight side-channels stripped
+    (faults, status server, time-series, profiling — the probes
+    measure latency vs load, nothing else).  Per probe we record
+    offered rate, served rate (``range_ops / rounds``), p50/p99 batch
+    latency, and the defer/shed tallies; ``capacity`` is the highest
+    served rate any probe sustained, so each point's utilization is
+    ``offered / capacity`` and the p99-vs-utilization series IS the
+    knee curve the paper plots.
+    """
+    rate, process = parse_open_spec(open_spec)
+    rates = sorted({float(r) for r in sweep_rates} | {rate})
+    points = []
+    for probe_rate in rates:
+        probe_kw = dict(kw)
+        for heavy in ("faults", "status_port", "timeseries_path",
+                      "profile_rounds", "trace_path", "journal_dir"):
+            probe_kw.pop(heavy, None)
+        _, info = run_serve_bench(
+            open_spec=f"{probe_rate:g}:{process}",
+            log=lambda *_a, **_k: None,
+            **probe_kw,
+        )
+        st = info["stats"]
+        lat = st.latency_quantiles()
+        served = st.ops / max(1, st.rounds)
+        points.append({
+            "offered_rate": probe_rate,
+            "served_rate": round(served, 3),
+            "rounds": st.rounds,
+            "p50_ms": round(lat["p50"] * 1e3, 4),
+            "p99_ms": round(lat["p99"] * 1e3, 4),
+            "deferred_ops": st.deferred_ops,
+            "shed_ops": st.shed_ops,
+            "verify_ok": bool(info["verify_ok"]),
+        })
+        log(
+            f"serve: sweep probe {probe_rate:g} ops/round — served "
+            f"{served:.1f}, p99 {lat['p99'] * 1e3:.2f}ms, "
+            f"deferred {st.deferred_ops} shed {st.shed_ops}"
+        )
+    capacity = max(p["served_rate"] for p in points) or 1.0
+    for p in points:
+        p["utilization"] = round(p["offered_rate"] / capacity, 4)
+    knee_block = {
+        "version": 1,
+        "process": process,
+        "capacity_ops_per_round": capacity,
+        "points": points,
+    }
+    log(
+        f"serve: knee — capacity {capacity:.1f} ops/round over "
+        f"{len(points)} probes; final run at {rate:g} "
+        f"(utilization {rate / capacity:.2f})"
+    )
+    return run_serve_bench(
+        open_spec=open_spec, knee_block=knee_block,
+        save_name=save_name, log=log, **kw,
+    )
 
 
 def run_serve_soak(

@@ -62,6 +62,19 @@ Spec grammar (comma-separated ``key=value`` tokens)::
                      takes the synchronous cold path and must stay
                      verify-green — the prefetcher is opportunism,
                      never a dependency
+  ``conn_churn``     drop every live ingest connection at its next
+                     frame (open-loop front only): clients must
+                     reconnect-and-resume, and the idempotent delivery
+                     high-water mark must absorb any redelivery —
+                     recovery is a resumed session delivering ops
+                     again
+  ``tenant_flood``   one tenant's offered load is treated as inflated
+                     by ``param``x (default 8) for a fixed window of
+                     macro-rounds
+                     (open-loop front only): admission must defer/shed
+                     the flooder while other tenants keep admitting —
+                     recovery is the flood window closing with the
+                     pressure absorbed
   =================  ======================================================
 
 Every event records whether it fired and whether the engine recovered
@@ -92,6 +105,8 @@ KINDS = (
     "merge_reorder",
     "tier_evict_pressure",
     "prefetch_miss",
+    "conn_churn",
+    "tenant_flood",
 )
 
 #: Kinds that need the write-ahead journal armed (``--serve-journal``):
@@ -113,6 +128,13 @@ REPLICATION_KINDS = ("replica_partition", "merge_reorder")
 #: never reaches their injection points, so ``run_serve_bench`` rejects
 #: the combination up front instead of ending in a confusing not_fired.
 TIER_KINDS = ("tier_evict_pressure", "prefetch_miss")
+
+#: Kinds only the open-loop ingest pump polls (``--serve-open``): they
+#: target the live front and the admission controller — a closed-loop
+#: replay has neither, so ``run_serve_bench`` rejects a spec that arms
+#: them without the open-loop family up front instead of ending in a
+#: confusing not_fired chaos-gate failure.
+INGEST_KINDS = ("conn_churn", "tenant_flood")
 
 
 @dataclass
@@ -315,6 +337,18 @@ class FaultInjector:
         """Drop one round's planned prefetch batch (polled at prefetch
         planning; pending until a round actually plans prefetches)."""
         return self._pending(rnd, "prefetch_miss")
+
+    def conn_churn_event(self, rnd: int) -> FaultEvent | None:
+        """Drop every live ingest connection (polled by the open-loop
+        pump each macro-round; the front's churn generation bump does
+        the dropping)."""
+        return self._pending(rnd, "conn_churn")
+
+    def tenant_flood_event(self, rnd: int) -> FaultEvent | None:
+        """Inflate one tenant's offered load by ``param``x for a fixed
+        window (polled by the open-loop pump; admission must absorb
+        the pressure)."""
+        return self._pending(rnd, "tenant_flood")
 
     def partition_event(self, rnd: int) -> FaultEvent | None:
         """A replica's broadcast link drops for a span (polled by the

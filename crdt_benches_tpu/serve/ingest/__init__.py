@@ -1,0 +1,50 @@
+"""Live ingest front door — the subsystem that turns the serve stack
+from a batch replayer into a server.
+
+Four pieces, each its own module:
+
+- :mod:`.front` — a thread-confined TCP front (sibling of
+  ``obs/status.py``'s HTTP server) accepting CRC-framed op batches on
+  per-session connections.  Handler threads are ``thread=ingest``;
+  the ONLY mutable crossing into the hot drain is the declared
+  ``publish=ingest`` swap point (G013–G017 gated, race-sanitized).
+- :mod:`.admission` — per-tenant admission control: token buckets,
+  per-tenant queue budgets, and SLO-aware admit/defer/shed driven by
+  the class burn rates ``obs/slo.py`` already tracks.  Sheds are
+  journaled with the exact record shape the existing overflow sheds
+  use, so ``recover_fleet`` replays them with zero new code.
+- :mod:`.deadline` — ``DeadlineScheduler``, a ``FleetScheduler``
+  subclass replacing round-robin selection with earliest-deadline-
+  first over per-class latency budgets; macro-round staging is
+  untouched.
+- :mod:`.loadgen` — the open-loop load family (bench ids
+  ``serve/open/<mix>/<fleet>``): seeded Poisson/burst arrival
+  processes at a configured offered load, the hot-side pump that
+  marries the front to the scheduler's bounded queues, and the
+  offered-load sweep that emits the p99-vs-utilization knee curve.
+"""
+
+from .admission import (AdmissionController, TenantPolicy,
+                        TenantSpecError, parse_tenant_spec)
+from .deadline import DeadlineScheduler
+from .front import FRAME_KINDS, IngestFront, decode_frame, encode_frame
+from .loadgen import (IngestPump, OpenLoadClient, OpenLoadPlan,
+                      build_open_plan, drive_open_loop, parse_open_spec)
+
+__all__ = [
+    "AdmissionController",
+    "TenantPolicy",
+    "TenantSpecError",
+    "parse_tenant_spec",
+    "DeadlineScheduler",
+    "IngestFront",
+    "FRAME_KINDS",
+    "encode_frame",
+    "decode_frame",
+    "IngestPump",
+    "OpenLoadClient",
+    "OpenLoadPlan",
+    "build_open_plan",
+    "drive_open_loop",
+    "parse_open_spec",
+]

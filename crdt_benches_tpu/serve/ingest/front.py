@@ -1,0 +1,347 @@
+"""The thread-confined TCP ingest front.
+
+Sibling of ``obs/status.py``'s HTTP server, with the same confinement
+story inverted: /status flows hot→handler, ingest flows handler→hot.
+
+**Wire format** — one frame per line::
+
+    <crc32:08x> <json>\\n
+
+where the checksum covers the JSON bytes exactly (the journal's line
+convention).  Frame kinds, all JSON objects with a ``t`` field:
+
+- ``hello`` ``{t, session, doc, tenant, resume?}`` — binds this
+  connection to ONE session writing ONE doc.  ``resume`` marks a
+  reconnect after a drop (connection churn): delivery is idempotent
+  downstream (``delivered`` is monotonic, redelivery clamps), so a
+  resumed session simply re-sends from its last acked offset.
+- ``ops`` ``{t, seq, start, count}`` — "deliver the next ``count``
+  ops of this session's stream starting at absolute op offset
+  ``start``".  ``seq`` must be strictly increasing per connection;
+  the server acks each frame (``{"t":"ack","seq":n}``) before the
+  client sends the next, so in-session order is preserved into the
+  scheduler's bounded per-doc queue by construction.
+- ``bye`` ``{t, session}`` — clean close.
+
+Server replies are unframed JSON lines: ``ack`` / ``retry`` (delivery
+queue full, or the frame's planned round is still ahead of the server
+clock — re-send the same frame; the wire itself paces the open-loop
+arrival process) / ``err`` (protocol violation — connection closes) /
+``churn`` (the chaos fault dropped you — reconnect and resume).
+
+**Confinement** (G013–G017 + the runtime race sanitizer): handler
+threads are ``thread=ingest`` and own nothing but their connection
+state; every payload crosses to the hot pump through ONE declared
+``publish=ingest`` swap point on a bounded queue, and the pump's
+:meth:`IngestFront.drain` is the ``reveal`` gate.  All counters are
+hot-owned — handler-side events (bad CRC, churn drops) ride the
+published payloads and are tallied at drain.  The hot side signals
+handlers only through :meth:`churn`'s immutable generation bump (the
+``set_health`` pattern: an atomic int swap needs no publish point).
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import queue
+import threading
+import zlib
+
+from ...lint.race_sanitizer import published, reveal, share
+
+__all__ = ["IngestFront", "encode_frame", "decode_frame", "FRAME_KINDS"]
+
+FRAME_KINDS = ("hello", "ops", "bye")
+
+#: delivery-queue bound: deep enough to absorb a macro-round of frames
+#: from every live connection, small enough that a stalled pump turns
+#: into client-visible ``retry`` backpressure instead of memory growth.
+DEFAULT_CAPACITY = 1024
+
+
+def encode_frame(obj: dict) -> bytes:
+    """One CRC-framed wire line for ``obj`` (client side + tests)."""
+    body = json.dumps(obj, separators=(",", ":"), sort_keys=True)
+    raw = body.encode("utf-8")
+    return f"{zlib.crc32(raw):08x} ".encode("ascii") + raw + b"\n"
+
+
+def decode_frame(line: bytes) -> dict:
+    """Parse + verify one wire line; raises ``ValueError`` on a short
+    line, a CRC mismatch, or non-object JSON."""
+    line = line.rstrip(b"\r\n")
+    if len(line) < 10 or line[8:9] != b" ":
+        raise ValueError("short frame")
+    try:
+        want = int(line[:8], 16)
+    except ValueError:
+        raise ValueError("bad crc field") from None
+    raw = line[9:]
+    got = zlib.crc32(raw)
+    if got != want:
+        raise ValueError(f"crc mismatch (want {want:08x} got {got:08x})")
+    obj = json.loads(raw.decode("utf-8"))
+    if not isinstance(obj, dict) or "t" not in obj:
+        raise ValueError("frame is not an object with 't'")
+    return obj
+
+
+class _IngestHandler(socketserver.StreamRequestHandler):  # graftlint: thread=ingest
+    """One connection = one session = one doc.  Connection-local state
+    only; everything leaving this thread goes through the front's
+    declared publish point."""
+
+    def handle(self) -> None:
+        front: IngestFront = self.server.owner  # type: ignore[attr-defined]
+        churn_gen = front.churn_gen  # generation at accept
+        session = doc = tenant = None
+        last_seq = -1
+        while True:
+            try:
+                line = self.rfile.readline(front.max_frame)
+            except OSError:
+                return
+            if not line:
+                return  # peer closed
+            if front.churn_gen != churn_gen:
+                # the chaos fault dropped this connection: tell the
+                # client to reconnect-and-resume, surface the drop to
+                # the pump (that is the fault's "fire" evidence; session
+                # is None when churn raced the hello — still a drop)
+                front.publish({"kind": "churn_drop",
+                               "session": session, "doc": doc,
+                               "tenant": tenant})
+                self._reply({"t": "churn"})
+                return
+            try:
+                frame = decode_frame(line)
+            except ValueError as e:
+                front.publish({"kind": "bad_frame", "why": str(e)})
+                self._reply({"t": "err", "why": str(e)})
+                return
+            kind = frame.get("t")
+            if kind == "hello":
+                if session is not None:
+                    self._reply({"t": "err", "why": "double hello"})
+                    return
+                session = frame.get("session")
+                doc = frame.get("doc")
+                tenant = frame.get("tenant", "default")
+                if doc not in front.valid_docs:
+                    self._reply({"t": "err", "why": f"unknown doc {doc!r}"})
+                    return
+                if tenant not in front.tenant_names:
+                    self._reply(
+                        {"t": "err", "why": f"unknown tenant {tenant!r}"})
+                    return
+                front.publish({"kind": "hello", "session": session,
+                               "doc": doc, "tenant": tenant,
+                               "resume": bool(frame.get("resume"))})
+                self._reply({"t": "ack", "seq": -1})
+            elif kind == "ops":
+                if session is None:
+                    self._reply({"t": "err", "why": "ops before hello"})
+                    return
+                seq = int(frame.get("seq", -1))
+                if seq <= last_seq:
+                    front.publish({"kind": "bad_frame",
+                                   "why": f"seq regression {seq}"})
+                    self._reply({"t": "err",
+                                 "why": f"seq {seq} <= {last_seq}"})
+                    return
+                rnd = int(frame.get("round", 0))
+                if rnd > front.now + front.pace_slack:
+                    # planned arrival still in the future: the wire
+                    # paces the open loop — same retry contract as a
+                    # full queue, frame NOT acked, client re-sends
+                    self._reply({"t": "retry", "seq": seq})
+                    continue
+                payload = {
+                    "kind": "ops", "session": session, "doc": doc,
+                    "tenant": tenant, "seq": seq,
+                    "start": int(frame.get("start", 0)),
+                    "count": int(frame.get("count", 0)),
+                    "round": rnd,
+                }
+                if not front.publish(payload, timeout=front.put_timeout):
+                    # bounded queue full: client-visible backpressure,
+                    # frame NOT acked — the client re-sends it, so no
+                    # ops are lost and order is preserved
+                    self._reply({"t": "retry", "seq": seq})
+                    continue
+                last_seq = seq
+                self._reply({"t": "ack", "seq": seq})
+            elif kind == "bye":
+                front.publish({"kind": "bye", "session": session})
+                self._reply({"t": "ack", "seq": last_seq})
+                return
+            else:
+                self._reply({"t": "err", "why": f"unknown kind {kind!r}"})
+                return
+
+    def _reply(self, obj: dict) -> None:
+        try:
+            self.wfile.write(
+                json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+        except OSError:
+            pass  # peer vanished mid-reply: its redelivery is idempotent
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "IngestFront"
+
+
+class IngestFront:
+    """The sessioned op-intake server (module docstring has the wire
+    and confinement contracts).
+
+    Hot surface: :meth:`drain` / :meth:`churn` / :attr:`idle` (all
+    non-blocking).  Handler surface: :meth:`publish` → the declared
+    ``publish=ingest`` point.  Driver surface: :meth:`start` /
+    :meth:`stop`.
+    """
+
+    def __init__(self, valid_docs, tenant_names=("default",), *,
+                 capacity: int = DEFAULT_CAPACITY,
+                 put_timeout: float = 2.0, max_frame: int = 1 << 16,
+                 pace_slack: int = 2):
+        # immutable views: written once here (before any handler thread
+        # exists), read by every handler — the G014-legal shape
+        self.valid_docs = frozenset(valid_docs)
+        self.tenant_names = frozenset(tenant_names)
+        self.put_timeout = float(put_timeout)
+        self.max_frame = int(max_frame)
+        self.pace_slack = int(pace_slack)
+        #: the hot clock, published to handlers like churn_gen (an
+        #: immutable int swap).  Frames whose planned round is further
+        #: than ``pace_slack`` ahead get a ``retry`` — the wire itself
+        #: enforces the open-loop arrival process, and connections stay
+        #: live across the drain horizon (what conn_churn drops).
+        self.now = 0
+        self._q: queue.Queue = queue.Queue(maxsize=max(8, int(capacity)))
+        self._srv: _Server | None = None
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+        #: churn generation: bumped by the hot thread (immutable int
+        #: swap, no publish point needed), compared by handlers
+        self.churn_gen = 0
+        # hot-owned counters (tallied in drain(), never by handlers)
+        self.frames = 0
+        self.ops_frames = 0
+        self.ops_delivered = 0
+        self.bad_frames = 0
+        self.sessions_opened = 0
+        self.sessions_resumed = 0
+        self.sessions_closed = 0
+        self.churn_drops = 0
+
+    # ---- driver-side lifecycle (G013: never constructed mid-drain) --
+
+    def start(self) -> int:
+        if self._srv is not None:
+            return self.port  # type: ignore[return-value]
+        srv = _Server(("127.0.0.1", 0), _IngestHandler)
+        srv.owner = self
+        self._srv = srv
+        self.port = srv.server_address[1]
+        self._thread = threading.Thread(
+            target=srv.serve_forever, name="serve-ingest", daemon=True,
+            kwargs={"poll_interval": 0.05},
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._srv is None:
+            return
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._srv = None
+        self._thread = None
+
+    # ---- handler surface (the ingest thread) ----
+
+    def publish(self, payload: dict, timeout: float | None = None
+                ) -> bool:  # graftlint: thread=ingest
+        """Hand one payload to the hot pump.  Control payloads use the
+        short default timeout; ``ops`` frames pass the configured
+        backpressure timeout and report ``False`` on a full queue so
+        the handler can turn it into a client ``retry``."""
+        try:
+            self._publish(payload, 1.0 if timeout is None else timeout)
+        except queue.Full:
+            return False
+        return True
+
+    @published
+    def _publish(self, payload: dict, timeout: float) -> None:  # graftlint: publish=ingest  # graftlint: thread=ingest
+        """THE declared swap point: one frame's payload leaves the
+        ingest thread.  ``share`` stamps it with this point's publish
+        generation (armed runs), and the bounded ``put`` means a
+        stalled pump surfaces as client backpressure, never as an
+        unbounded buffer."""
+        self._q.put(share(payload, "IngestFront.delivery"),
+                    timeout=timeout)
+
+    # ---- hot-thread surface (non-blocking by contract, G016) ----
+
+    @property
+    def idle(self) -> bool:
+        return self._q.empty()
+
+    def churn(self) -> None:  # graftlint: thread=hot
+        """Drop every live connection at its next frame (the
+        ``conn_churn`` chaos fault).  An immutable int swap — handlers
+        poll the generation, no lock, no publish point (the
+        ``set_health`` pattern)."""
+        self.churn_gen = self.churn_gen + 1
+
+    def drain(self) -> list[dict]:  # graftlint: thread=hot
+        """Harvest every pending payload (never blocks).  Each one
+        passes the ``reveal`` gate — the reader side of the publish
+        contract — and all counters are tallied here, on the hot
+        thread that owns them."""
+        out: list[dict] = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            payload = reveal(item)
+            self.frames += 1
+            kind = payload.get("kind")
+            if kind == "ops":
+                self.ops_frames += 1
+                self.ops_delivered += payload.get("count", 0)
+            elif kind == "hello":
+                self.sessions_opened += 1
+                if payload.get("resume"):
+                    self.sessions_resumed += 1
+            elif kind == "bye":
+                self.sessions_closed += 1
+            elif kind == "bad_frame":
+                self.bad_frames += 1
+            elif kind == "churn_drop":
+                self.churn_drops += 1
+            out.append(payload)
+        return out
+
+    def status_fields(self) -> dict:
+        """Hot-owned gauges for /status.json and the artifact."""
+        return {
+            "port": self.port,
+            "frames": self.frames,
+            "ops_frames": self.ops_frames,
+            "ops_delivered": self.ops_delivered,
+            "bad_frames": self.bad_frames,
+            "sessions_opened": self.sessions_opened,
+            "sessions_resumed": self.sessions_resumed,
+            "sessions_closed": self.sessions_closed,
+            "churn_drops": self.churn_drops,
+            "queue_depth": self._q.qsize(),
+        }

@@ -257,6 +257,11 @@ def run_serve_repl_bench(
                     "status": False,  # repl family rejects --serve-status
                     "journal": journal is not None,
                     "bus": True,
+                    # surfaces the replicated family never arms — the
+                    # keys must still be RECORDED (False) or G017
+                    # treats their publish tags as unmatchable
+                    "prefetch": False,  # repl pool is flat, no tiers
+                    "ingest": False,  # repl family rejects --serve-open
                     "publishes": race_sanitizer.counters()["publishes"],
                     "crossings": (
                         race_sanitizer.counters()["crossings"]

@@ -1,0 +1,43 @@
+"""G013 seed: network-endpoint isolation in hot-path scopes — the
+ingest-front edition of ``g013_status.py``.
+
+``hot_pump_round`` is the declared hot root; ``_accept_inline`` and
+``_dial_peer`` are reached from it.  Constructing or serving the TCP
+front there, and opening outbound sockets, are the violations; the
+sanctioned pattern is the driver building the front ONCE and the hot
+pump only draining its bounded queue.  ``driver_setup`` shows the same
+calls are LEGAL off the hot call graph — server lifecycle belongs to
+the bench driver.
+"""
+
+import socket
+import socketserver
+
+from crdt_benches_tpu.serve.ingest.front import IngestFront
+
+
+def hot_pump_round(front):  # graftlint: hot-path
+    payloads = front.drain()  # held reference: clean
+    _accept_inline()
+    _dial_peer()
+    return payloads
+
+
+def _accept_inline():
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), None)  # expect: G013
+    srv.serve_forever()  # expect: G013
+    IngestFront({0}).start()  # expect: G013
+
+
+def _dial_peer():
+    sk = socket.create_connection(("127.0.0.1", 9))  # expect: G013
+    sk.close()
+    socket.create_server(("127.0.0.1", 0))  # expect: G013
+
+
+def driver_setup(docs):
+    # off the hot call graph: binding the port and spinning the
+    # handler threads up is the driver's job — exactly where it belongs
+    front = IngestFront(docs)
+    front.start()
+    return front

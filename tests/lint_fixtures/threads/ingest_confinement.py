@@ -1,0 +1,47 @@
+"""Seeded confinement hazards of an INGEST handler thread — the
+minimized shape of the live front's connection handlers
+(serve/ingest/front.py) with each rule's canonical mistake planted
+next to its legal twin:
+
+- **G014**: the handler appends a decoded frame into a shared list the
+  hot pump reads — a mutable object escaping the ingest thread with no
+  declared publish point;
+- **G015**: the handler's declared publish point mutates the published
+  payload in place AFTER the swap — the hot pump can observe the
+  half-applied handoff;
+- **G016**: the pump's drain BLOCKS on the delivery queue when no
+  frame is pending — the exact wait the contract forbids (an empty
+  queue means "nothing arrived this round", never "park the drain
+  behind a TCP handler").  The non-blocking twin on the next line
+  stays legal.
+"""
+
+import queue
+
+_DELIVERY = queue.Queue()
+
+
+class FrontBridge:
+    def __init__(self):
+        self.holding = []  # hot-owned (only the pump touches it)
+        self.seen = []  # shared scratch: the G014 escape below
+        self.latest = {}
+
+    def handle_frame(self) -> None:  # graftlint: thread=ingest
+        frame = {"doc": 3, "seq": 1, "count": 8}
+        self.seen.append(frame)  # expect: G014
+        self.publish_frame(frame)
+
+    def publish_frame(self, frame: dict) -> None:  # graftlint: publish  # graftlint: thread=ingest
+        self.latest = {"frame": frame}  # the legal atomic swap
+        self.latest["acked"] = True  # expect: G015
+
+    def pump_step(self):  # graftlint: hot-path
+        if self.holding:
+            return self.holding.pop()
+        if not self.seen:  # reads the escaped list on the hot thread
+            _DELIVERY.get()  # expect: G016
+        try:
+            return _DELIVERY.get_nowait()  # non-blocking twin: legal
+        except queue.Empty:
+            return None

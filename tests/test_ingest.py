@@ -1,0 +1,526 @@
+"""serve/ingest/ live front door: wire framing, per-tenant admission,
+deadline scheduling, and the open-loop drive path.
+
+The admission decision matrix is pinned directly against
+AdmissionController (no sockets); the wire protocol is pinned against a
+LIVE IngestFront over loopback; recovery parity reuses the durability
+suite's recover_fleet pattern — an admission shed journaled by the
+ingest path must replay exactly like an overflow shed.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from crdt_benches_tpu.serve.ingest.admission import (
+    AdmissionController,
+    TenantPolicy,
+    TenantSpecError,
+    parse_tenant_spec,
+)
+from crdt_benches_tpu.serve.ingest.front import (
+    IngestFront,
+    decode_frame,
+    encode_frame,
+)
+from crdt_benches_tpu.serve.ingest.loadgen import parse_open_spec
+from crdt_benches_tpu.serve.journal import OpJournal, read_journal, recover_fleet
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import prepare_streams
+from crdt_benches_tpu.serve.workload import build_fleet
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_BANDS = {
+    "synth-small": ("synth", (10, 60)),
+    "synth-medium": ("synth", (150, 360)),
+}
+TINY_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+
+def test_frame_codec_roundtrip_and_rejects():
+    obj = {"t": "ops", "seq": 3, "start": 0, "count": 8, "round": 2}
+    assert decode_frame(encode_frame(obj)) == obj
+    # CRC mismatch: flip a payload byte behind a valid header
+    line = bytearray(encode_frame(obj))
+    line[-3] ^= 0x01
+    with pytest.raises(ValueError, match="crc mismatch"):
+        decode_frame(bytes(line))
+    with pytest.raises(ValueError, match="short frame"):
+        decode_frame(b"deadbeef\n")
+    with pytest.raises(ValueError, match="bad crc"):
+        decode_frame(b"nothexx! {}\n")
+    # valid CRC over non-object / t-less JSON still rejects
+    import zlib
+
+    for body in (b"[1,2]", b'{"x":1}'):
+        framed = f"{zlib.crc32(body):08x} ".encode() + body + b"\n"
+        with pytest.raises(ValueError, match="not an object"):
+            decode_frame(framed)
+
+
+# ---------------------------------------------------------------------------
+# spec parsers
+# ---------------------------------------------------------------------------
+
+
+def test_parse_open_spec():
+    assert parse_open_spec("32") == (32.0, "poisson")
+    assert parse_open_spec("64:burst") == (64.0, "burst")
+    assert parse_open_spec("12.5:poisson") == (12.5, "poisson")
+    for bad in ("", "0", "-4", "32:steady", "x", "32:poisson:extra"):
+        with pytest.raises(ValueError):
+            parse_open_spec(bad)
+
+
+def test_parse_tenant_spec_matrix():
+    pol = parse_tenant_spec("gold=48:192,free=8:16:64")
+    assert set(pol) == {"gold", "free"}
+    assert pol["gold"].rate == 48.0 and pol["gold"].burst == 192.0
+    assert pol["gold"].budget == 0  # unset -> unlimited queue
+    assert pol["free"].budget == 64
+    # burst defaults to 4x rate when omitted
+    assert parse_tenant_spec("t=10")["t"].burst == 40.0
+    for bad in ("", "=4", "t=", "t=0", "t=-3", "t=4:x", "t=4:8:2:9",
+                "a=4,a=8"):
+        with pytest.raises(TenantSpecError):
+            parse_tenant_spec(bad)
+    with pytest.raises(TenantSpecError):
+        TenantPolicy("t", rate=4.0, burst=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# admission decision matrix
+# ---------------------------------------------------------------------------
+
+
+class _FakeSlo:
+    """status_fields() stand-in: inject exact per-class burn rates."""
+
+    def __init__(self, classes):
+        self._classes = classes
+
+    def status_fields(self):
+        return {"classes": self._classes}
+
+
+def _controller(spec="gold=16:32,free=4:8:24", *, burns=None):
+    adm = AdmissionController(
+        parse_tenant_spec(spec),
+        slo=_FakeSlo(burns or {}) if burns is not None else None,
+    )
+    adm.refill()
+    return adm
+
+
+def test_admission_burn_matrix():
+    """SLO burn gates the verdict before any token math: a sustained
+    burn (fast AND slow > 1) sheds, a spike (fast only) defers."""
+    adm = _controller(burns={
+        "c128": {"burn_fast": 2.0, "burn_slow": 1.5},
+        "c512": {"burn_fast": 1.8, "burn_slow": 0.4},
+        "c4096": {"burn_fast": 0.2, "burn_slow": 0.1},
+    })
+    assert adm.decide("gold", 8, "c128", pending=0) == (
+        "shed", "burn_sustained")
+    assert adm.decide("gold", 8, "c512", pending=0) == (
+        "defer", "burn_spike")
+    assert adm.decide("gold", 8, "c4096", pending=0) == ("admit", "ok")
+    # unknown class: no burn signal, normal admission
+    assert adm.decide("gold", 8, "nope", pending=0) == ("admit", "ok")
+    assert adm.decisions["shed:burn_sustained"] == 1
+    assert adm.decisions["defer:burn_spike"] == 1
+    assert adm.decisions["admit:ok"] == 2
+
+
+def test_admission_defer_limit_sheds():
+    """A batch pushed back MAX_DEFERS rounds sheds even with a clean
+    SLO — the starvation backstop."""
+    adm = _controller()
+    assert adm.decide("gold", 8, "c128", pending=0,
+                      defers=AdmissionController.MAX_DEFERS) == (
+        "shed", "defer_limit")
+    # one short of the limit with empty tokens: still only a defer
+    adm.tokens["gold"] = 0.0
+    assert adm.decide("gold", 8, "c128", pending=0,
+                      defers=AdmissionController.MAX_DEFERS - 1) == (
+        "defer", "tokens")
+
+
+def test_admission_queue_budget_and_tokens():
+    adm = _controller()
+    # free: budget=24 — pending + batch over budget defers regardless
+    # of token balance
+    assert adm.decide("free", 8, "c128", pending=20) == (
+        "defer", "queue_budget")
+    # token exhaustion: burst 8 admits one 8-op batch, defers the next
+    assert adm.decide("free", 8, "c128", pending=0) == ("admit", "ok")
+    assert adm.decide("free", 8, "c128", pending=0) == ("defer", "tokens")
+    # refill restores rate (4/round, capped at burst) -> one more round
+    # is still short, two refills cover the batch
+    adm.refill()
+    assert adm.decide("free", 8, "c128", pending=0) == ("defer", "tokens")
+    adm.refill()
+    assert adm.decide("free", 8, "c128", pending=0) == ("admit", "ok")
+    assert adm.admitted_ops["free"] == 16
+    assert adm.deferred_ops["free"] == 24
+
+
+def test_admission_tenant_isolation():
+    """One tenant draining its bucket never touches a neighbour's."""
+    adm = _controller()
+    for _ in range(4):
+        adm.decide("free", 8, "c128", pending=0)
+    assert adm.tokens["gold"] == 32.0  # untouched
+    assert adm.decide("gold", 24, "c128", pending=0) == ("admit", "ok")
+    assert adm.shed_ops["gold"] == 0 and adm.shed_ops["free"] == 0
+    with pytest.raises(KeyError, match="unknown tenant"):
+        adm.decide("mystery", 1, "c128", pending=0)
+    fields = adm.status_fields()
+    assert set(fields["tenants"]) == {"gold", "free"}
+    assert fields["tenants"]["gold"]["admitted_ops"] == 24
+
+
+def test_admission_shed_recovery_parity(tmp_path):
+    """An admission shed journaled by the ingest path replays through
+    recover_fleet exactly like an overflow shed: the doc comes back
+    lossy with its cursor limit clamped, and the report carries the
+    shed ops — zero ingest-specific replay code."""
+    sessions = build_fleet(6, mix=TINY_MIX, seed=7, arrival_span=2,
+                           bands=TINY_BANDS)
+    jd = str(tmp_path / "journal")
+    journal = OpJournal(jd)
+    adm = AdmissionController(parse_tenant_spec("free=4:8"),
+                              journal=journal)
+    pool = DocPool(classes=(128, 512), slots=(6, 3),
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=16)
+    doc = max(streams, key=lambda d: streams[d].n_total)
+    total = streams[doc].n_total
+    assert total > 5
+    adm.journal_shed(doc, keep=5, shed=total - 5, tenant="free", rnd=2)
+    journal.close()
+    records, dropped = read_journal(jd)
+    assert dropped == 0
+    (rec,) = records
+    assert rec == {"t": "shed", "r": 2, "doc": doc, "at": 5,
+                   "ops": total - 5, "tenant": "free",
+                   "why": "admission"}
+    # fresh pool + streams, same deterministic workload
+    pool_b = DocPool(classes=(128, 512), slots=(6, 3),
+                     spool_dir=str(tmp_path / "spool_b"))
+    streams_b = prepare_streams(sessions, pool_b, batch=16)
+    rep = recover_fleet(pool_b, streams_b, jd)
+    st = streams_b[doc]
+    assert st.lossy and st.limit == 5
+    assert rep.shed_ops == total - 5
+    assert rep.records == 1
+    # no round barriers were journaled: recovery is a cold start, the
+    # shed decision still applies from round 0
+    assert rep.snapshot_round == -1 and rep.resume_round == 0
+
+
+# ---------------------------------------------------------------------------
+# deadline scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_budgets_and_scoring(tmp_path):
+    from crdt_benches_tpu.serve.ingest.deadline import DeadlineScheduler
+
+    sessions = build_fleet(12, mix=TINY_MIX, seed=5, arrival_span=3,
+                           bands=TINY_BANDS)
+    pool = DocPool(classes=(128, 512), slots=(6, 3),
+                   spool_dir=str(tmp_path / "spool"))
+    streams = prepare_streams(sessions, pool, batch=16)
+    sched = DeadlineScheduler(pool, streams, batch=16, edf=True,
+                              deadline_budgets={128: 5, 512: 9},
+                              default_budget=7)
+    # the per-class budget resolves through the doc's capacity class
+    for doc, st in streams.items():
+        cls = pool.class_for(max(pool.docs[doc].length, 1))
+        want = {128: 5, 512: 9}[cls]
+        assert sched.deadline_for(doc) == st.arrival + want
+    sched.run()
+    assert sched.done
+    fields = sched.deadline_fields()
+    assert fields["edf"] is True
+    assert fields["met"] + fields["missed"] == len(streams)
+    assert 0.0 <= fields["hit_rate"] <= 1.0
+    assert fields["budgets"] == {"128": 5, "512": 9}
+    # the block rides the status surface (the sidecar's scrape)
+    assert sched.status_fields()["deadline"]["met"] == fields["met"]
+
+
+# ---------------------------------------------------------------------------
+# the live front over loopback
+# ---------------------------------------------------------------------------
+
+
+def _connect(port):
+    sk = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    return sk, sk.makefile("rwb")
+
+
+def _xchg(f, obj):
+    f.write(encode_frame(obj))
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_live_front_session_protocol():
+    front = IngestFront({7}, ("gold",), pace_slack=2)
+    port = front.start()
+    try:
+        # rejected hellos: unknown doc, unknown tenant
+        for hello, why in (
+            ({"t": "hello", "session": "s0", "doc": 9, "tenant": "gold"},
+             "unknown doc"),
+            ({"t": "hello", "session": "s0", "doc": 7, "tenant": "x"},
+             "unknown tenant"),
+        ):
+            sk, f = _connect(port)
+            r = _xchg(f, hello)
+            assert r["t"] == "err" and why in r["why"]
+            sk.close()
+        # ops before hello is a protocol error
+        sk, f = _connect(port)
+        r = _xchg(f, {"t": "ops", "seq": 0, "count": 4})
+        assert r["t"] == "err" and "before hello" in r["why"]
+        sk.close()
+        # the happy path: hello -> paced ops -> bye
+        sk, f = _connect(port)
+        r = _xchg(f, {"t": "hello", "session": "s1", "doc": 7,
+                      "tenant": "gold"})
+        assert r == {"t": "ack", "seq": -1}
+        # a frame planned past now + pace_slack is retried, not acked:
+        # the wire enforces the open-loop arrival process
+        r = _xchg(f, {"t": "ops", "seq": 0, "start": 0, "count": 4,
+                      "round": 9})
+        assert r == {"t": "retry", "seq": 0}
+        front.now = 7  # the pump's per-round clock publish
+        r = _xchg(f, {"t": "ops", "seq": 0, "start": 0, "count": 4,
+                      "round": 9})
+        assert r == {"t": "ack", "seq": 0}
+        # seq regression closes the session
+        r = _xchg(f, {"t": "ops", "seq": 0, "start": 4, "count": 4,
+                      "round": 9})
+        assert r["t"] == "err" and "seq" in r["why"]
+        sk.close()
+        # clean close on a fresh session
+        sk, f = _connect(port)
+        _xchg(f, {"t": "hello", "session": "s2", "doc": 7,
+                  "tenant": "gold"})
+        r = _xchg(f, {"t": "bye"})
+        assert r["t"] == "ack"
+        sk.close()
+        # corrupt frame surfaces as bad_frame
+        sk, f = _connect(port)
+        f.write(b"00000000 {broken\n")
+        f.flush()
+        r = json.loads(f.readline())
+        assert r["t"] == "err"
+        sk.close()
+        # drain() tallies on the hot side; handlers never touch counters
+        payloads = front.drain()
+        kinds = [p["kind"] for p in payloads]
+        assert kinds.count("hello") == 2
+        assert kinds.count("ops") == 1
+        assert kinds.count("bye") == 1
+        assert kinds.count("bad_frame") >= 1
+        assert front.sessions_opened == 2
+        assert front.sessions_closed == 1
+        assert front.ops_delivered == 4
+        assert front.bad_frames >= 1
+        fields = front.status_fields()
+        assert fields["port"] == port and fields["queue_depth"] == 0
+    finally:
+        front.stop()
+
+
+def test_live_front_churn_drops_connection():
+    front = IngestFront({3}, ("default",))
+    port = front.start()
+    try:
+        sk, f = _connect(port)
+        _xchg(f, {"t": "hello", "session": "s0", "doc": 3,
+                  "tenant": "default"})
+        front.now = 10
+        front.churn()  # the conn_churn fault hook
+        r = _xchg(f, {"t": "ops", "seq": 0, "count": 2, "round": 0})
+        assert r == {"t": "churn"}
+        sk.close()
+        front.drain()
+        assert front.churn_drops == 1
+        # resume-hello is counted separately from a fresh open
+        sk, f = _connect(port)
+        r = _xchg(f, {"t": "hello", "session": "s0", "doc": 3,
+                      "tenant": "default", "resume": True})
+        assert r["t"] == "ack"
+        sk.close()
+        front.drain()
+        assert front.sessions_resumed == 1
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# runner rejection matrix (exit 2 — rejected before any fleet is built)
+# ---------------------------------------------------------------------------
+
+
+_REJECTS = [
+    (["--serve-open", "32", "--serve-longhaul", "1"], "longhaul"),
+    (["--serve-open", "32", "--serve-recover"], "recover"),
+    (["--serve-open", "32", "--serve-mesh", "3"], "mesh"),
+    (["--serve-open", "bogus"], "open"),
+    (["--serve-tenants", "gold=8"], "tenants"),
+    (["--serve-deadline"], "deadline"),
+    (["--serve-open-sweep", "8,16"], "sweep"),
+]
+
+
+@pytest.mark.parametrize("extra,tag", _REJECTS, ids=[t for _, t in _REJECTS])
+def test_runner_rejects_open_loop_conflicts(extra, tag, tmp_path):
+    """Unsupported --serve-open combinations (and orphaned open-loop
+    flags) are usage errors: exit 2 with a message, no artifact."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "crdt_benches_tpu.bench.runner",
+         "--family", "serve", "--serve-docs", "8",
+         "--results-dir", str(tmp_path)] + extra,
+        capture_output=True, text=True, timeout=120,
+        cwd=str(REPO), env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2, proc.stderr
+    assert not list(Path(tmp_path).glob("*.json"))
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: open-loop gating semantics
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_ingest", REPO / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare_ingest"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(tmp_path, name, *, pps=100_000.0, p99=0.005, rate=None,
+              knee=False):
+    extra = {
+        "family": "serve",
+        "patches_per_sec": pps,
+        "batch_latency": {"p50": p99 / 3, "p95": p99 / 1.2, "p99": p99},
+        "rounds": 40,
+        "range_ops": 10_000,
+        "journal": None,
+    }
+    if rate is not None:
+        extra["ingest"] = {
+            "version": 1,
+            "open": {"rate": rate, "process": "poisson"},
+            "admission": {"tenants": {}},
+        }
+    if knee:
+        extra["knee"] = {"version": 1, "capacity": 120.0, "points": []}
+    data = [{"group": "serve", "trace": "mixed", "backend": "512",
+             "extra": extra}]
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_open_loop_matrix(tmp_path, capsys):
+    bc = _bench_compare()
+    closed = _artifact(tmp_path, "closed.json")
+    open_a = _artifact(tmp_path, "open_a.json", rate=64.0)
+    # open vs closed: throughput is skip-with-note (it follows the
+    # offered load), p99 is skip-with-note (no comparable load point),
+    # the one-sided ingest block is a note — never exit 2
+    assert bc.main([open_a, closed]) == 0
+    out = capsys.readouterr().out
+    assert "SKIP" in out and "offered load" in out
+    assert bc.main([closed, open_a]) == 0
+    # same offered load: p99 IS gated — a regression fails
+    slow = _artifact(tmp_path, "slow.json", rate=64.0, p99=0.05)
+    assert bc.main([slow, open_a]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "offered load 64" in out
+    # identical open runs pass, and the gate names the load point
+    assert bc.main([open_a, open_a]) == 0
+    out = capsys.readouterr().out
+    assert "offered load 64" in out
+    # different offered loads: p99 not comparable, skip with note
+    open_b = _artifact(tmp_path, "open_b.json", rate=32.0, p99=0.05)
+    assert bc.main([open_b, open_a]) == 0
+    out = capsys.readouterr().out
+    assert "offered load differs" in out
+    # the knee block rides the one-sided matrix both directions
+    kneed = _artifact(tmp_path, "kneed.json", rate=64.0, knee=True)
+    assert bc.main([kneed, open_a]) == 0
+    out = capsys.readouterr().out
+    assert "knee block" in out and "SKIP" in out
+    assert bc.main([open_a, kneed]) == 0
+
+
+# ---------------------------------------------------------------------------
+# end to end: open-loop drain over the live wire at toy scale
+# ---------------------------------------------------------------------------
+
+
+def test_open_loop_drain_end_to_end(tmp_path):
+    """A TINY fleet served through the real TCP front under an open
+    Poisson arrival process with tenants + EDF: byte-exact verification,
+    every op accounted for across wire -> admission -> scheduler, and
+    the artifact carries the full ingest block."""
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=12, batch=16,
+        classes=(128, 512), slots=(8, 4), seed=3, arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        open_spec="48", deadline=True,
+        tenants_spec="gold=48:192,free=12:24:96",
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    assert r.bench_id == "serve/open/custom/12"
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    ing = d["extra"]["ingest"]
+    assert ing["open"]["rate"] == 48.0
+    assert ing["open"]["process"] == "poisson"
+    # conservation: every planned op arrived over the wire and every
+    # admitted op was delivered to the scheduler
+    assert ing["front"]["ops_delivered"] == ing["open"]["total_ops"]
+    assert ing["client"]["errors"] == 0
+    assert ing["client"]["sent_frames"] >= ing["open"]["total_frames"]
+    adm = ing["admission"]["tenants"]
+    assert set(adm) == {"gold", "free"}
+    admitted = sum(t["admitted_ops"] for t in adm.values())
+    shed = sum(t["shed_ops"] for t in adm.values())
+    # >= because a partially admitted batch's refused tail is re-held
+    # and re-decided (its ops count again on the later verdict)
+    assert admitted + shed >= ing["open"]["total_ops"]
+    assert ing["dup_frames"] == 0  # no chaos, no redelivery
+    assert ing["deadline"]["met"] + ing["deadline"]["missed"] == 12
+    # the ingest surface is armed AND published in the crossings map
+    assert d["extra"]["thread_crossings"]["ingest"] is True

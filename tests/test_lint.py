@@ -345,6 +345,45 @@ def test_prefetch_thread_confinement_fixture():
     assert "hot thread" in findings[2].msg
 
 
+def test_ingest_thread_confinement_fixture():
+    """The live ingest front's canonical handler-thread hazards, one
+    per rule at exact lines: a decoded frame escaping the handler into
+    a hot-read list (G014), an in-place mutation inside the declared
+    frame publish point (G015), and the pump blocking on the delivery
+    queue (G016 — an empty queue means nothing arrived this round,
+    never a reason to park the drain behind a TCP handler).  The legal
+    twins — the atomic swap, ``get_nowait``, the hot-owned holding
+    list — stay silent."""
+    path = THREADS_DIR / "ingest_confinement.py"
+    findings = run_lint([str(path)])
+    assert [(f.rule, f.line) for f in findings] == sorted(
+        expected_markers(path), key=lambda rl: rl[1]
+    )
+    assert [(f.rule, f.line) for f in findings] == [
+        ("G014", 32), ("G015", 37), ("G016", 43),
+    ]
+    assert "ingest" in findings[0].msg  # the owning-thread set named
+    assert "publish point" in findings[1].msg
+    assert "hot thread" in findings[2].msg
+
+
+def test_g013_ingest_front_fixture_covers_socket_construction():
+    """The ingest-front G013 seed: constructing/serving a TCP server,
+    constructing the front itself, and opening outbound sockets are
+    all flagged in hot-path scopes at exact lines — while the same
+    calls in ``driver_setup`` (off the hot call graph) stay legal."""
+    path = FIXTURES / "serve" / "g013_ingest.py"
+    findings = run_lint([str(path)])
+    got = {(f.rule, f.line) for f in findings}
+    assert got == expected_markers(path), "\n".join(
+        f"  {f.rule} L{f.line}: {f.msg}" for f in findings
+    )
+    assert {f.rule for f in findings} == {"G013"}
+    assert len(findings) == 5
+    ctor = [f for f in findings if "IngestFront" in f.msg]
+    assert len(ctor) == 1 and "driver-owned" in ctor[0].msg
+
+
 def test_g017_dead_publish_and_unattributed_counter():
     """G017 mirrors G011 for publish points: a declared point the run
     never entered is flagged at its def line, a ``publish=status`` tag

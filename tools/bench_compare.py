@@ -18,6 +18,13 @@ files), the gate compares:
   ``timeseries`` block, the worst full window's throughput is compared
   too: a mid-run stall the end-of-run mean averages away fails here.
 
+Open-loop artifacts (``--serve-open``, the ``ingest`` block) flip the
+headline semantics: throughput follows the OFFERED load, so its gate
+is skipped with a note, and steady p99 is gated only when both
+artifacts ran at the same offered load — the "p99 at fixed offered
+load" contract.  Rate-mismatched or mixed open/closed pairs skip p99
+with a note instead of comparing incomparable numbers.
+
 Artifacts of different schema vintages diff cleanly: an obs/ v2 block
 (``timeseries`` / ``anomalies``) present on only one side is reported
 as a skip with a note, never an error — a new baseline is not required
@@ -35,8 +42,10 @@ Usage::
         [--max-journal-regress 25] [--max-syncs-regress 60] [--json]
 
 The committed baseline for ``serve/mixed/4096`` lives at
-``bench_results/serve_baseline.json``; CI smokes also reuse this gate
-to bound armed-tracing overhead (traced leg vs plain leg, 5%).
+``bench_results/serve_baseline.json``; the open-loop baseline for
+``serve/open/mixed/4096`` at ``bench_results/serve_open_baseline.json``.
+CI smokes also reuse this gate to bound armed-tracing overhead (traced
+leg vs plain leg, 5%).
 """
 
 from __future__ import annotations
@@ -135,9 +144,14 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: entry/op counters, G021's ground truth) — same both-directions
 #: skip: artifacts written before the block existed (or by a run that
 #: never journaled) diff cleanly against sanitized ones.
+#: ``ingest`` / ``knee`` are the open-loop serving blocks
+#: (``--serve-open`` / ``--serve-open-sweep``) — both-directions skip:
+#: an open-loop artifact diffed against a closed-loop baseline (or
+#: vice versa) is a family difference, never an error.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
-                    "recovery", "residency", "fs_ops")
+                    "recovery", "residency", "fs_ops", "ingest",
+                    "knee")
 
 
 def _tier_hit_rate(extra: dict) -> float | None:
@@ -260,6 +274,15 @@ def _window_floor(extra: dict) -> float | None:
     return min(tputs) if tputs else None
 
 
+def _open_rate(extra: dict) -> float | None:
+    """The offered load (ops/round) of an open-loop artifact
+    (``--serve-open``); None for closed-loop replay artifacts."""
+    ing = extra.get("ingest")
+    if not isinstance(ing, dict):
+        return None
+    return (ing.get("open") or {}).get("rate")
+
+
 def _block_presence_checks(new: dict, base: dict) -> list[Check]:
     out = []
     for blk in _OPTIONAL_BLOCKS:
@@ -286,18 +309,47 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_recover_regress: float = 75.0,
             max_journal_disk_regress: float = 40.0,
             max_hit_rate_regress: float = 25.0) -> list[Check]:
-    checks = [
-        _regress(
+    # open-loop artifacts (--serve-open) invert what the headline
+    # numbers mean: throughput TRACKS the offered load (the client
+    # decides it, not the engine), so gating it is meaningless — the
+    # open-loop regression surface is p99 AT A FIXED OFFERED LOAD.
+    # Mixed or rate-mismatched pairs skip-with-note instead of
+    # comparing incomparable numbers.
+    new_rate, base_rate = _open_rate(new), _open_rate(base)
+    open_any = new_rate is not None or base_rate is not None
+    if open_any:
+        tput_check = Check(
+            "throughput (patches/s)", "skip",
+            note="open-loop artifact: throughput follows the offered "
+                 "load, not engine capability — p99 at fixed offered "
+                 "load is the gated number",
+        )
+    else:
+        tput_check = _regress(
             "throughput (patches/s)",
             new.get("patches_per_sec"), base.get("patches_per_sec"),
             max_throughput_regress, higher_is_better=True,
-        ),
-        _regress(
-            "steady p99 latency (s)",
+        )
+    if open_any and new_rate != base_rate:
+        p99_check = Check(
+            "steady p99 latency (s)", "skip",
+            note=f"offered load differs ({new_rate!r} vs "
+                 f"{base_rate!r}): open-loop p99 is only comparable "
+                 "at a fixed offered load",
+        )
+    else:
+        name = ("steady p99 latency (s, at offered load "
+                f"{new_rate:g} ops/round)" if open_any
+                else "steady p99 latency (s)")
+        p99_check = _regress(
+            name,
             (new.get("batch_latency") or {}).get("p99"),
             (base.get("batch_latency") or {}).get("p99"),
             max_p99_regress, higher_is_better=False,
-        ),
+        )
+    checks = [
+        tput_check,
+        p99_check,
         _regress(
             "journal bytes per range op",
             _journal_bytes_per_op(new), _journal_bytes_per_op(base),

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier]   (repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier|serve-open]   (repo root)
 #
 # The serve family (the default) drains a tiny document fleet through the
 # macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
@@ -49,6 +49,20 @@
 # G021 cross-check green in both directions against the emitted fs_ops
 # block) and the exhaustive crash-point enumeration harness (a crash
 # at EVERY mutating fs-op boundary must recover byte-verified).
+#
+# The serve-open family is the LIVE-INGEST smoke (serve/ingest/): the
+# fleet's ops arrive over a real loopback TCP front under an open-loop
+# Poisson process (the wire paces arrivals — frames ahead of the hot
+# clock are retried, not acked) with two tenants, SLO-aware admission
+# and EDF deadlines, run RACE-SANITIZED with the status server live so
+# a sidecar can scrape the per-tenant admission gauges MID-RUN.  The
+# p99 at the fixed offered load is gated against the committed
+# bench_results/serve_open_baseline.json (throughput is skip-with-note:
+# open loop follows the offered load), G017 cross-checks the ingest
+# publish surface, and a chaos leg fires conn_churn (sessions must
+# reconnect-and-resume) + tenant_flood (admission must defer/shed and
+# drain the backlog) — the runner exits nonzero on a verify failure or
+# an unfired/unrecovered ingest fault.
 #
 # Artifacts land in bench_results/ under smoke-specific names so they
 # never clobber committed headline numbers.
@@ -670,8 +684,148 @@ print(f"tier smoke: {res['warm_hits']} warm hits "
       f"race sanitizer ({tc['publishes']['Prefetcher._publish']} entries)")
 PYEOF
     ;;
+  serve-open)
+    # Leg 1: the open-loop drain over the live wire — 24 docs, two
+    # tenants (gold generously provisioned, free budget-capped so the
+    # admission path actually defers), EDF deadlines, offered load 64
+    # ops/round — race-sanitized with the status server on an
+    # ephemeral port.  --serve-soak 10 keeps the telemetry bundle
+    # armed across the drain so the sidecar below has a live /metrics
+    # to scrape; the clean-soak contract (no active anomaly at end)
+    # rides along for free.
+    rm -f bench_results/serve_open_smoke.log
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-open 64 --serve-tenants "gold=48:192,free=16:32:128" \
+        --serve-deadline \
+        --serve-soak 10 --serve-status 0 \
+        --serve-slo "default=p99:60000" \
+        --serve-save-name serve_open_smoke \
+        2> >(tee bench_results/serve_open_smoke.log >&2) &
+    open_pid=$!
+    # Mid-run sidecar: the per-tenant ingest gauges + admission
+    # counters must render on the LIVE /metrics endpoint while the
+    # front is accepting connections (pre-registered at bind, so they
+    # are present from the first registry snapshot on), and
+    # /status.json must be advancing rounds.
+    python - <<'PYEOF'
+import json, re, sys, time, urllib.request
+
+log = "bench_results/serve_open_smoke.log"
+port = None
+deadline = time.time() + 120
+while time.time() < deadline:
+    try:
+        m = re.search(r"status server on http://127\.0\.0\.1:(\d+)",
+                      open(log, encoding="utf-8").read())
+    except OSError:
+        m = None
+    if m:
+        port = int(m.group(1))
+        break
+    time.sleep(0.25)
+assert port, "open smoke: status server never announced its port"
+base = f"http://127.0.0.1:{port}"
+rounds, err = [], None
+for _ in range(400):
+    try:
+        h = urllib.request.urlopen(base + "/healthz", timeout=2)
+        assert h.status == 200, h.read()
+        s = json.load(urllib.request.urlopen(base + "/status.json", timeout=2))
+        text = urllib.request.urlopen(base + "/metrics", timeout=2).read().decode()
+        assert "# TYPE" in text
+        for series in ('serve_ingest_tokens{tenant="free"}',
+                       'serve_ingest_tokens{tenant="gold"}',
+                       'serve_ingest_admitted_ops_total{tenant="gold"}'):
+            assert series in text, f"{series} missing from live /metrics"
+        rounds.append(int(s.get("rounds", 0)))
+        if len(rounds) >= 2 and rounds[-1] > rounds[-2]:
+            break
+    except (OSError, AssertionError) as e:  # not serving yet: retry
+        err = e
+    time.sleep(0.2)
+else:
+    sys.exit(f"open smoke scrape: never saw the ingest gauges on an advancing run ({rounds!r}, last error {err!r})")
+print(f"open smoke scrape ok: rounds {rounds[-2]} -> {rounds[-1]}, per-tenant ingest gauges live on /metrics")
+PYEOF
+    wait "$open_pid"
+    # The open-loop regression gate: p99 AT THE FIXED OFFERED LOAD vs
+    # the committed baseline (same recipe, 64 ops/round) — throughput
+    # is skip-with-note by design.  Thresholds are loose: a 24-doc
+    # drain is compile-dominated and the smoke leg runs sanitized +
+    # soak-armed while the baseline is plain.
+    python tools/bench_compare.py \
+      bench_results/serve_open_smoke.json \
+      bench_results/serve_open_baseline.json \
+      --max-p99-regress 200 --max-drain-p999-regress 200
+    # G017 vs the open artifact: the only family that arms the ingest
+    # publish surface — a dead IngestFront._publish annotation (or a
+    # rogue runtime counter) is invisible to every other family, where
+    # ingest=False skips the dead-point check.
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_open_smoke.json
+    # Leg 2: ingest chaos under the race sanitizer with the journal on
+    # — conn_churn drops every live connection mid-drain (clients must
+    # reconnect-and-resume; redelivered frames dup-drop idempotently)
+    # and tenant_flood inflates one tenant's admission pressure for a
+    # window (admission must defer/shed it and the backlog must
+    # drain).  Exit 0 = verify green + both faults fired AND
+    # recovered.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-open 64 --serve-tenants "gold=48:192,free=16:32:128" \
+        --serve-deadline --serve-journal auto --serve-snapshot-every 3 \
+        --serve-faults "seed=5,conn_churn@6=1,tenant_flood@10=1" \
+        --serve-save-name serve_open_chaos_smoke
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_open_chaos_smoke.json
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_open_chaos_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+assert x["verify_ok"], "open chaos smoke failed oracle byte-verify"
+f = {e["kind"]: e for e in x["faults"]["events"]}
+assert f["conn_churn"]["fired"] and f["conn_churn"]["recovered"], f
+assert f["tenant_flood"]["fired"] and f["tenant_flood"]["recovered"], f
+ing = x["ingest"]
+# the churn really severed live connections and the clients really
+# came back: drops AND resumed sessions, zero client-side errors
+assert ing["front"]["churn_drops"] >= 1, ing["front"]
+assert ing["front"]["sessions_resumed"] >= 1, ing["front"]
+assert ing["client"]["reconnects"] >= 1, ing["client"]
+assert ing["client"]["errors"] == 0, ing["client"]
+# every planned op still arrived over the wire (pacing + resume)
+assert ing["front"]["ops_delivered"] == ing["open"]["total_ops"], ing
+dl = ing["deadline"]
+assert dl["met"] + dl["missed"] == x["fleet_docs"], dl
+tc = x["thread_crossings"]
+assert tc["sanitized"] and tc["ingest"], tc
+assert tc["publishes"].get("IngestFront._publish"), tc
+assert set(tc["crossings"] or {}) <= set(tc["publishes"]), tc
+adm = ing["admission"]["tenants"]
+print(f"open chaos: churn dropped {ing['front']['churn_drops']} conns, "
+      f"{ing['front']['sessions_resumed']} sessions resumed "
+      f"({ing['client']['reconnects']} reconnects); flood verdicts — "
+      + "; ".join(f"{t}: admit {d['admitted_ops']} defer {d['deferred_ops']} "
+                  f"shed {d['shed_ops']}" for t, d in sorted(adm.items()))
+      + f"; deadline hit rate {dl['hit_rate']}, ingest publish point "
+      f"proven under the race sanitizer "
+      f"({tc['publishes']['IngestFront._publish']} entries)")
+PYEOF
+    ;;
   *)
-    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier)" >&2
+    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier, serve-open)" >&2
     exit 2
     ;;
 esac
