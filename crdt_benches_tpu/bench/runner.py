@@ -686,6 +686,9 @@ def run_serve(args) -> int:
             ("--serve-profile", args.serve_profile > 0),
             ("--serve-flight", args.serve_flight is not None),
             ("--serve-open", args.serve_open is not None),
+            ("--serve-stream", args.serve_stream),
+            ("--serve-stream-scaling",
+             args.serve_stream_scaling is not None),
         ]
         bad = [flag for flag, hit in unsupported if hit]
         if bad:
@@ -743,6 +746,7 @@ def run_serve(args) -> int:
             ("--serve-crash-round", args.serve_crash_round > 0),
             ("--serve-mesh", args.serve_mesh > 1),
             ("--serve-tiers", args.serve_tiers is not None),
+            ("--serve-stream", args.serve_stream),
         ]
         bad = [flag for flag, hit in unsupported if hit]
         if bad:
@@ -777,6 +781,17 @@ def run_serve(args) -> int:
             )
             return 2
 
+    if args.serve_stream_scaling is not None and (
+            args.serve_soak is not None
+            or args.serve_open_sweep is not None):
+        print(
+            "--serve-stream-scaling attaches the fleet-size probe "
+            "table to ONE serve run's artifact; it does not compose "
+            "with --serve-soak / --serve-open-sweep",
+            file=sys.stderr,
+        )
+        return 2
+
     mesh_devices = ensure_virtual_devices(args.serve_mesh)
     common = dict(
         mix=args.serve_mix,
@@ -788,6 +803,8 @@ def run_serve(args) -> int:
         arrival_dist=args.serve_arrival_dist,
         mesh_devices=mesh_devices,
         verify_sample=args.serve_verify_sample,
+        stream=args.serve_stream,
+        sample_seed=args.serve_sample_seed,
         macro_k=args.serve_macro,
         batch_chars=args.serve_batch_chars,
         serve_kernel=args.serve_kernel,
@@ -855,11 +872,40 @@ def run_serve(args) -> int:
             **sweep_kw,
         )
     else:
+        scaling_rows = None
+        if args.serve_stream_scaling:
+            # fleet-size scaling probe: one fresh subprocess per
+            # (size, mode) cell — ru_maxrss is process-monotonic, so
+            # per-cell peaks need per-cell processes.  The table rides
+            # the main run's artifact (construction.scaling).
+            from ..serve.construction import scaling_table
+
+            try:
+                sizes = [int(x) for x in
+                         args.serve_stream_scaling.split(",")
+                         if x.strip()]
+            except ValueError:
+                print(
+                    f"--serve-stream-scaling: bad size list "
+                    f"{args.serve_stream_scaling!r}",
+                    file=sys.stderr,
+                )
+                return 2
+            scaling_rows = scaling_table(
+                sizes,
+                mix=args.serve_mix,
+                seed=args.serve_seed,
+                arrival_span=args.serve_arrival_span,
+                arrival_dist=args.serve_arrival_dist,
+                serve_tiers=args.serve_tiers,
+                log=lambda m: print(m, file=sys.stderr),
+            )
         r, info = run_serve_bench(
             seed=args.serve_seed,
             status_port=args.serve_status,
             timeseries_path=args.serve_timeseries,
             timeseries_window=args.serve_timeseries_window,
+            construction_scaling=scaling_rows,
             **common,
         )
     print(
@@ -1071,6 +1117,34 @@ def main(argv=None) -> int:
                          "'zipf' — a dense early head plus a long "
                          "trickling tail, the skew that makes the "
                          "warm tier's hot set real")
+    ap.add_argument("--serve-stream", action="store_true",
+                    help="streaming fleet construction: the fleet is a "
+                         "lazy FleetSpec (per-doc band/arrival/trace "
+                         "seed derived from (seed, doc_id), byte-"
+                         "stable vs the eager build) and docs are born "
+                         "in the pool's genesis state — traces "
+                         "tensorize on first admission (off-drain via "
+                         "the prefetch thread under --serve-tiers), so "
+                         "setup cost/RSS scale with the active set, "
+                         "not the fleet.  The artifact's "
+                         "'construction' block carries "
+                         "construction_ms + RSS either way")
+    ap.add_argument("--serve-sample-seed", type=int, default=None,
+                    metavar="SEED",
+                    help="seed for the sampled oracle verify draw "
+                         "(default: --serve-seed + 1); recorded in the "
+                         "artifact (construction.verify_sample_seed) "
+                         "next to the picked doc ids, so any sample "
+                         "is re-drawable and auditable offline")
+    ap.add_argument("--serve-stream-scaling", default=None,
+                    metavar="N1,N2,...",
+                    help="before the main run, probe construction "
+                         "cost (no drain) at each fleet size in a "
+                         "FRESH subprocess per (size, mode) cell — "
+                         "stream rows at every size, eager contrast "
+                         "rows up to 65536 docs — and attach the "
+                         "fleet-size-vs-construction_ms/RSS table to "
+                         "the artifact (construction.scaling)")
     ap.add_argument("--serve-trace", default=None, metavar="PATH",
                     help="arm the obs/trace.py span tracer for the "
                          "drain and write Perfetto-loadable Chrome "

@@ -81,10 +81,11 @@ from .ingest.loadgen import (
     drive_open_loop,
     parse_open_spec,
 )
+from .construction import current_rss_bytes, peak_rss_bytes
 from .journal import DEFAULT_SEGMENT_BYTES, OpJournal, recover_fleet
 from .pool import DocPool
-from .scheduler import FleetScheduler, prepare_streams
-from .workload import build_fleet
+from .scheduler import FleetScheduler, LazyStreams, prepare_streams
+from .workload import FleetSpec, build_fleet
 
 
 def parse_slo(slo_spec):
@@ -261,6 +262,9 @@ def run_serve_bench(
     arrival_dist: str = "uniform",
     mesh_devices: int = 0,
     verify_sample: int = 8,
+    stream: bool = False,
+    sample_seed: int | None = None,
+    construction_scaling: list | None = None,
     bands: dict | None = None,
     macro_k: int = 8,
     batch_chars: int = 256,
@@ -407,6 +411,37 @@ def run_serve_bench(
             "--serve-deadline selects EDF over the ingest deadline "
             "budgets: --serve-open is required"
         )
+    # streaming construction (--serve-stream): the fleet is a lazy
+    # FleetSpec — per-doc band/arrival/seed derived from (seed, doc_id),
+    # traces tensorized on first admission — so setup cost and host
+    # footprint scale with the ACTIVE set, not the fleet.  It rides the
+    # existing closed-loop families (serve/ and serve/tier/); the legs
+    # that replay eagerly built streams do not compose with it.
+    stream = bool(stream)
+    if stream:
+        if longhaul or measure_recovery or crash_after:
+            raise ValueError(
+                "--serve-stream does not compose with the durability "
+                "legs (--serve-longhaul / --serve-recover / "
+                "--serve-crash-round): journal recovery rebuilds "
+                "eagerly prepared streams"
+            )
+        if journal_dir:
+            raise ValueError(
+                "--serve-stream does not compose with --serve-journal: "
+                "the lazy path releases drained streams, which the "
+                "journal's replay window would still reference"
+            )
+        if open_spec:
+            raise ValueError(
+                "--serve-stream does not compose with --serve-open: "
+                "the open-loop plan tensorizes every stream up front"
+            )
+        if mesh_devices > 1:
+            raise ValueError(
+                "--serve-stream is single-host for now (lazy "
+                "materialization feeds one scheduler)"
+            )
     mix_label = f"longhaul/{mix_name}" if longhaul else (
         f"tier/{mix_name}" if warm_docs
         else f"open/{mix_name}" if open_rate else mix_name
@@ -552,12 +587,26 @@ def run_serve_bench(
             telemetry.note_phase("building")  # staleness-clock heartbeat
         log(f"serve: building fleet n_docs={n_docs} mix={mix_label} "
             f"seed={seed}"
-            + (f" horizon=x{longhaul}" if longhaul else ""))
-        sessions = build_fleet(
-            n_docs, mix=mix, seed=seed, arrival_span=arrival_span, bands=bands,
-            delivery=delivery, horizon=max(1, longhaul),
-            arrival_dist=arrival_dist,
-        )
+            + (f" horizon=x{longhaul}" if longhaul else "")
+            + (" [streaming]" if stream else ""))
+        # construction accounting (always measured, both modes): the
+        # window is fleet spec/sessions -> pool -> streams -> scheduler
+        # ready, i.e. everything before round 0 could run
+        t_setup = time.perf_counter()
+        spec = None
+        sessions = None
+        if stream:
+            spec = FleetSpec.build(
+                n_docs, mix=mix, seed=seed, arrival_span=arrival_span,
+                bands=bands, delivery=delivery, horizon=max(1, longhaul),
+                arrival_dist=arrival_dist,
+            )
+        else:
+            sessions = build_fleet(
+                n_docs, mix=mix, seed=seed, arrival_span=arrival_span,
+                bands=bands, delivery=delivery, horizon=max(1, longhaul),
+                arrival_dist=arrival_dist,
+            )
         pool = DocPool(classes=classes, slots=slots, mesh=mesh,
                        spool_dir=spool_dir, serve_kernel=serve_kernel,
                        warm_docs=warm_docs)
@@ -569,21 +618,37 @@ def run_serve_bench(
                 f"docs, cold spool compressed, prefetch "
                 f"{'armed' if pool.prefetcher is not None else 'off'}"
             )
-        streams = prepare_streams(
-            sessions, pool, batch=batch, batch_chars=batch_chars
-        )
-        total_ops = sum(s.remaining for s in streams.values())
-        total_units = sum(
-            int(s.unit_cum[-1]) for s in streams.values() if len(s.kind)
-        )
-        log(
-            f"serve: {len(sessions)} docs, {total_ops} range ops "
-            f"({total_units} unit ops), classes={classes} slots={slots} "
-            f"batch={batch} chars={batch_chars} K={macro_k} "
-            f"kernel={serve_kernel} "
-            f"lanes={'/'.join(str(d) for d in pool.op_dtypes)} "
-            f"mesh={mesh_devices if mesh else 'off'}"
-        )
+        if stream:
+            streams = LazyStreams(
+                spec, pool, batch=batch, batch_chars=batch_chars
+            )
+            log(
+                f"serve: streaming construction — {n_docs} docs born in "
+                f"genesis (nothing resident); traces tensorize on first "
+                f"admission"
+                + (", off-drain via prefetch"
+                   if pool.prefetcher is not None else "")
+                + f"; classes={classes} slots={slots} batch={batch} "
+                f"chars={batch_chars} K={macro_k} kernel={serve_kernel}"
+            )
+        else:
+            streams = prepare_streams(
+                sessions, pool, batch=batch, batch_chars=batch_chars
+            )
+            total_ops = sum(s.remaining for s in streams.values())
+            total_units = sum(
+                int(s.unit_cum[-1])
+                for s in streams.values() if len(s.kind)
+            )
+            log(
+                f"serve: {len(sessions)} docs, {total_ops} range ops "
+                f"({total_units} unit ops), classes={classes} "
+                f"slots={slots} "
+                f"batch={batch} chars={batch_chars} K={macro_k} "
+                f"kernel={serve_kernel} "
+                f"lanes={'/'.join(str(d) for d in pool.op_dtypes)} "
+                f"mesh={mesh_devices if mesh else 'off'}"
+            )
 
         profiler = DeviceProfiler(profile_rounds) \
             if profile_rounds > 0 else None
@@ -632,6 +697,13 @@ def run_serve_bench(
             )
         else:
             sched = FleetScheduler(pool, streams, **sched_kw)
+        construction_ms = (time.perf_counter() - t_setup) * 1e3
+        rss_setup = current_rss_bytes()
+        log(
+            f"serve: construction {construction_ms:.1f}ms "
+            f"({'stream' if stream else 'eager'}; "
+            f"rss {rss_setup / 2**20:.1f} MiB)"
+        )
         # per-fence boundary-sync counters cover drain + verify; with
         # CRDT_BENCH_SANITIZE_SYNCS=1 any sync outside a declared fence
         # raises inside run() at its callsite
@@ -821,24 +893,41 @@ def run_serve_bench(
         # docs whose ops were shed by an EXPLICIT decision (overflow shed /
         # quarantine) cannot match a full oracle replay; they are excluded
         # from the sample and surfaced in the artifact instead.
+        # The sample is SEEDED and auditable: ``vseed`` (defaulting to
+        # seed + 1, overridable via --serve-sample-seed) + the picked
+        # doc ids both land in the artifact, so any sample can be
+        # re-drawn and re-checked offline.  In streaming mode a full
+        # fleet verify would itself be O(fleet) — the sampled verify is
+        # the gate by design; post-drain every doc has materialized, so
+        # the class census walks pool.docs instead of the sessions list.
         lossy = sorted(d for d, st in streams.items() if st.lossy)
         by_class: dict[int, list[int]] = {}
-        for s in sessions:
-            if streams[s.doc_id].lossy:
+        verify_ids = sorted(pool.docs) if stream \
+            else [s.doc_id for s in sessions]
+        for doc_id in verify_ids:
+            if streams[doc_id].lossy:
                 continue
-            rec = pool.docs[s.doc_id]
+            rec = pool.docs[doc_id]
             final_cls = rec.cls or pool.class_for(max(rec.length, 1))
-            by_class.setdefault(final_cls, []).append(s.doc_id)
+            by_class.setdefault(final_cls, []).append(doc_id)
         used_classes = sorted(by_class)
         per_class = max(1, -(-verify_sample // max(1, len(used_classes))))
-        rng = np.random.default_rng(seed + 1)
+        vseed = (seed + 1) if sample_seed is None else int(sample_seed)
+        rng = np.random.default_rng(vseed)
         sample: list[int] = []
         for cls in used_classes:
             ids = by_class[cls]
             pick = rng.choice(ids, size=min(per_class, len(ids)), replace=False)
             sample.extend(int(x) for x in pick)
         failures = []
-        session_of = {s.doc_id: s for s in sessions}
+        session_of = {} if stream else {s.doc_id: s for s in sessions}
+
+        def _trace_of(doc_id):
+            # lazy fleets re-derive the sampled doc's trace from the
+            # spec (seed-stable, byte-identical to first admission)
+            return spec.session(doc_id).trace if stream \
+                else session_of[doc_id].trace
+
         if crashed:
             # an interrupted drain's pool is mid-stream by design; the
             # byte-verify happens on the RECOVERED fleet below
@@ -848,7 +937,7 @@ def run_serve_bench(
                 "recovered fleet carries the oracle gate")
         else:
             for doc_id in sample:
-                want = replay_trace(session_of[doc_id].trace)
+                want = replay_trace(_trace_of(doc_id))
                 got = pool.decode(doc_id)
                 if got != want:
                     failures.append(doc_id)
@@ -1177,6 +1266,35 @@ def run_serve_bench(
                     "disk_bytes": journal.on_disk_bytes(),
                 },
                 "longhaul": longhaul,
+                # streaming fleet construction (ALWAYS present — eager
+                # runs carry it too, so bench_compare can gate
+                # construction_ms / peak RSS across modes; artifacts
+                # predating the block skip-with-note one-sided).  The
+                # verify sample's seed + doc ids ("verified_docs"
+                # below) make the sampled oracle gate auditable.
+                "construction": {
+                    "version": 1,
+                    "mode": "stream" if stream else "eager",
+                    "construction_ms": construction_ms,
+                    "rss_after_construction_bytes": rss_setup,
+                    "peak_rss_bytes": peak_rss_bytes(),
+                    "fleet_docs": n_docs,
+                    "materialized_docs": (
+                        streams.materialized if stream else n_docs
+                    ),
+                    "released_docs": (
+                        streams.released if stream else 0
+                    ),
+                    "prefetch_built": (
+                        streams.prefetch_built if stream else 0
+                    ),
+                    "genesis_docs_end": pool.genesis_docs,
+                    "verify_sample_seed": vseed,
+                    # fleet-size-vs-construction/RSS scaling rows from
+                    # the fresh-subprocess probe (serve/construction.py)
+                    # when --serve-stream-scaling ran; None otherwise
+                    "scaling": construction_scaling,
+                },
                 # tiered residency (None unless --serve-tiers armed):
                 # tier budgets + hit/miss/prefetch accounting — the
                 # warm+prefetch hit rate is the number bench_compare

@@ -1,0 +1,222 @@
+"""Construction-cost accounting: RSS probes + the fleet-size scaler.
+
+The streaming-construction work (serve/workload.py ``FleetSpec`` +
+serve/scheduler.py ``LazyStreams``) claims setup cost and host
+footprint scale with the ACTIVE set, not the fleet.  This module is
+how the claim is measured and committed:
+
+- :func:`current_rss_bytes` / :func:`peak_rss_bytes` — the two RSS
+  probes the bench embeds in every artifact's ``construction`` block
+  (``VmRSS`` point-in-time from ``/proc/self/status``; ``ru_maxrss``
+  high-water mark from ``getrusage``);
+- :func:`probe` — build ONE fleet to scheduler-ready (spec/sessions →
+  pool → streams → scheduler, NO drain) and report construction_ms +
+  RSS, in either mode;
+- :func:`scaling_table` — the fleet-size-vs-RSS table.  ``ru_maxrss``
+  is process-monotonic, so each (size, mode) cell runs :func:`probe`
+  in a FRESH subprocess (``python -m crdt_benches_tpu.serve
+  .construction``) and parses its one-line JSON; eager rows are capped
+  at ``eager_limit`` docs (past it the eager build takes minutes —
+  that being the point of the table).
+
+The table rides the artifact (``construction.scaling``) via the
+runner's ``--serve-stream-scaling`` flag, and ``tools/bench_compare.py``
+gates ``construction_ms`` / ``peak_rss_bytes`` against the committed
+baseline (skip-with-note when either side predates the block).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+_PAGE = resource.getpagesize()
+
+
+def current_rss_bytes() -> int:
+    """Point-in-time resident set size of THIS process, in bytes.
+
+    Linux: ``VmRSS`` from ``/proc/self/status`` (what the fleet holds
+    *right now* — the number the scaling table plots).  Elsewhere:
+    falls back to the ``ru_maxrss`` high-water mark."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return peak_rss_bytes()
+
+
+def peak_rss_bytes() -> int:
+    """Process-lifetime peak RSS in bytes (``ru_maxrss``; KiB on
+    Linux).  Monotonic per process — comparable across runs only when
+    each run is its own process, which is why :func:`scaling_table`
+    shells a fresh interpreter per cell."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def probe(
+    n_docs: int,
+    *,
+    mix: str = "mixed",
+    seed: int = 0,
+    arrival_span: int = 8,
+    arrival_dist: str = "uniform",
+    serve_tiers: str | None = None,
+    stream: bool = True,
+    batch: int = 64,
+    batch_chars: int = 256,
+    classes=(256, 1024, 4096, 8192, 49152),
+    slots=(2048, 512, 128, 32, 16),
+) -> dict:
+    """Build one fleet to scheduler-ready and report the cost — the
+    construction half of a serve run, with NO drain.  Lazy mode builds
+    ``FleetSpec`` + ``LazyStreams`` (every doc in genesis); eager mode
+    is the historic ``build_fleet`` + ``prepare_streams`` path."""
+    # lazy imports: bench.py imports this module's RSS probes at its
+    # own import time, so importing bench at OUR top would be a cycle
+    from .bench import parse_tier_spec
+    from .pool import DocPool
+    from .scheduler import FleetScheduler, LazyStreams, prepare_streams
+    from .workload import FleetSpec, build_fleet
+
+    warm_docs = 0
+    if serve_tiers:
+        slots, warm_docs = parse_tier_spec(serve_tiers, slots)
+    rss0 = current_rss_bytes()
+    pool = None
+    t0 = time.perf_counter()
+    try:
+        if stream:
+            spec = FleetSpec.build(
+                n_docs, mix=mix, seed=seed, arrival_span=arrival_span,
+                arrival_dist=arrival_dist,
+            )
+            pool = DocPool(classes=classes, slots=slots,
+                           warm_docs=warm_docs)
+            streams = LazyStreams(
+                spec, pool, batch=batch, batch_chars=batch_chars
+            )
+        else:
+            sessions = build_fleet(
+                n_docs, mix=mix, seed=seed, arrival_span=arrival_span,
+                arrival_dist=arrival_dist,
+            )
+            pool = DocPool(classes=classes, slots=slots,
+                           warm_docs=warm_docs)
+            streams = prepare_streams(
+                sessions, pool, batch=batch, batch_chars=batch_chars
+            )
+        sched = FleetScheduler(
+            pool, streams, batch=batch, batch_chars=batch_chars
+        )
+        ms = (time.perf_counter() - t0) * 1e3
+        assert not sched.done or n_docs == 0
+        return {
+            "n_docs": int(n_docs),
+            "mode": "stream" if stream else "eager",
+            "construction_ms": ms,
+            "rss_before_bytes": rss0,
+            "rss_after_bytes": current_rss_bytes(),
+            "peak_rss_bytes": peak_rss_bytes(),
+            "genesis_docs": pool.genesis_docs,
+        }
+    finally:
+        if pool is not None:
+            pool.close()
+
+
+def scaling_table(
+    sizes,
+    *,
+    mix: str = "mixed",
+    seed: int = 0,
+    arrival_span: int = 8,
+    arrival_dist: str = "uniform",
+    serve_tiers: str | None = None,
+    eager_limit: int = 65536,
+    timeout: float = 900.0,
+    log=print,
+) -> list[dict]:
+    """One fresh-subprocess :func:`probe` per (size, mode) cell.
+
+    Stream rows cover every requested size; eager contrast rows stop at
+    ``eager_limit`` docs (0 disables them).  A cell that fails or times
+    out becomes an ``{"error": ...}`` row — the table never lies by
+    omission about a size that would not build."""
+    rows: list[dict] = []
+    for n in sorted({int(s) for s in sizes}):
+        for mode in ("stream", "eager"):
+            if mode == "eager" and (not eager_limit or n > eager_limit):
+                continue
+            cmd = [
+                sys.executable, "-m",
+                "crdt_benches_tpu.serve.construction",
+                "--n-docs", str(n), "--mode", mode,
+                "--mix", mix, "--seed", str(seed),
+                "--arrival-span", str(arrival_span),
+                "--arrival-dist", arrival_dist,
+            ]
+            if serve_tiers:
+                cmd += ["--serve-tiers", serve_tiers]
+            env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+                "JAX_PLATFORMS", "cpu"))
+            try:
+                out = subprocess.run(
+                    cmd, capture_output=True, text=True,
+                    timeout=timeout, env=env,
+                )
+            except subprocess.TimeoutExpired:
+                rows.append({"n_docs": n, "mode": mode,
+                             "error": f"timeout after {timeout:g}s"})
+                log(f"construction: {mode}/{n} TIMED OUT")
+                continue
+            if out.returncode != 0:
+                tail = (out.stderr or out.stdout or "").strip()
+                rows.append({"n_docs": n, "mode": mode,
+                             "error": tail[-400:] or "nonzero exit"})
+                log(f"construction: {mode}/{n} FAILED")
+                continue
+            row = json.loads(out.stdout.strip().splitlines()[-1])
+            rows.append(row)
+            log(
+                f"construction: {mode}/{n} — "
+                f"{row['construction_ms']:.0f}ms, "
+                f"peak rss {row['peak_rss_bytes'] / 2**20:.0f} MiB"
+            )
+    return rows
+
+
+def main(argv=None) -> int:
+    """``python -m crdt_benches_tpu.serve.construction``: one probe,
+    one JSON line on stdout (the :func:`scaling_table` cell worker)."""
+    ap = argparse.ArgumentParser(
+        description="construction-cost probe (one fleet, no drain)"
+    )
+    ap.add_argument("--n-docs", type=int, required=True)
+    ap.add_argument("--mode", choices=("stream", "eager"),
+                    default="stream")
+    ap.add_argument("--mix", default="mixed")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--arrival-span", type=int, default=8)
+    ap.add_argument("--arrival-dist", default="uniform")
+    ap.add_argument("--serve-tiers", default=None)
+    args = ap.parse_args(argv)
+    row = probe(
+        args.n_docs, mix=args.mix, seed=args.seed,
+        arrival_span=args.arrival_span, arrival_dist=args.arrival_dist,
+        serve_tiers=args.serve_tiers, stream=args.mode == "stream",
+    )
+    print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

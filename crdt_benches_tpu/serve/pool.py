@@ -419,8 +419,14 @@ class DocPool:
         self._gauges = {
             name: Gauge("serve.tier." + name)
             for name in ("hot_rows", "warm_docs", "cold_docs",
-                         "prefetch_inflight")
+                         "genesis_docs", "prefetch_inflight")
         }
+        #: docs born in GENESIS residency (streaming construction):
+        #: specified by the fleet but never yet registered — no record,
+        #: no row, no spool, no checkpoint, nothing resident.  Armed by
+        #: :meth:`set_genesis_population`; every :meth:`register` moves
+        #: one doc genesis → tracked.  0 when construction was eager.
+        self._n_genesis = 0
         #: per-doc spool write generation: bumped at every spool_save,
         #: so an in-flight prefetch read can be recognized as stale at
         #: harvest (the doc was re-evicted while the read ran)
@@ -543,6 +549,20 @@ class DocPool:
 
     # ---- registration / class arithmetic ----
 
+    def set_genesis_population(self, n: int) -> None:
+        """Arm the GENESIS residency state (streaming construction):
+        ``n`` docs exist in the fleet spec but have nothing resident
+        anywhere — not even a record.  Each :meth:`register` call
+        decrements the population; the ``serve.tier.genesis_docs``
+        gauge makes never-materialized docs first-class in the
+        residency story."""
+        self._n_genesis = max(0, int(n))
+
+    @property
+    def genesis_docs(self) -> int:
+        """Docs specified by the fleet but never yet materialized."""
+        return self._n_genesis
+
     def register(self, doc_id: int, n_init: int, capacity_need: int,
                  chars: np.ndarray) -> DocRecord:
         if capacity_need > self.classes[-1]:
@@ -554,6 +574,8 @@ class DocPool:
             doc_id=doc_id, n_init=n_init, capacity_need=capacity_need,
             chars=np.asarray(chars, np.int32), length=n_init,
         )
+        if doc_id not in self.docs and self._n_genesis > 0:
+            self._n_genesis -= 1
         self.docs[doc_id] = rec
         return rec
 
@@ -895,6 +917,7 @@ class DocPool:
         g["hot_rows"].set(self.hot_rows)
         g["warm_docs"].set(len(self.warm))
         g["cold_docs"].set(self.cold_docs)
+        g["genesis_docs"].set(self._n_genesis)
         g["prefetch_inflight"].set(
             self.prefetcher.inflight if self.prefetcher is not None else 0
         )
@@ -908,6 +931,7 @@ class DocPool:
             "warm_docs": len(self.warm),
             "warm_budget": self.warm.budget,
             "cold_docs": self.cold_docs,
+            "genesis_docs": self._n_genesis,
             "warm_hits": self.warm_hits,
             "warm_evictions": self.warm_evictions,
             "cold_restores": self.restores,

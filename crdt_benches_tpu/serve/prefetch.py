@@ -41,12 +41,27 @@ re-admitted and re-evicted while the read was in flight, so the bytes
 describe a superseded state.  ``save_state`` lands spools via
 ``os.replace``, so an in-flight read races only ever against a
 complete old inode, never a torn file.
+
+Streaming construction rides the same channel: a **construct** request
+carries a pure builder callable (closed over the immutable
+``FleetSpec``) instead of a spool path, the worker tensorizes the doc's
+op stream, and the finished arrays come back through the SAME declared
+publish point — first-admission tensorization never runs on the drain.
+The builder crosses threads on the request queue itself, so no shared
+mutable attribute exists for G014 to find.
+
+Every submission is stamped with a monotonically increasing **sequence
+number** and reaping is by sequence: ``note_lost`` remembers the reaped
+seqs, and a payload whose read outlived its reaping is dropped at
+harvest WITHOUT touching ``inflight`` — the counter can no longer be
+double-decremented below zero by a slow result racing the reaper.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+from typing import Callable, Iterable
 
 import numpy as np
 
@@ -84,14 +99,35 @@ class Prefetcher:
         self.harvested = 0
         self.errors = 0  # payloads that came back with a load error
         self.lost = 0  # reaped by the scheduler (publish-drop leak fix)
+        self.reap_dropped = 0  # payloads that arrived after their reap
         self.inflight = 0
+        #: next submission sequence number.  Starts at 1 so a
+        #: successful :meth:`submit` is always truthy; 0 means refused.
+        self._seq = 1
+        #: seqs the scheduler reaped whose payloads may still arrive —
+        #: their harvest must NOT decrement ``inflight`` again
+        self._reaped: set[int] = set()
 
-    def note_lost(self, n: int) -> None:
-        """The scheduler reaped ``n`` in-flight entries whose results
-        never arrived (a wedged round forced the worker's bounded
-        publish to time out and drop).  Without this, a dropped
-        payload would pin ``inflight`` — and shrink the submission
-        budget — for the rest of the run."""
+    def note_lost(self, seqs: int | Iterable[int]) -> None:
+        """The scheduler reaped in-flight entries whose results never
+        arrived (a wedged round forced the worker's bounded publish to
+        time out and drop).  Without this, a dropped payload would pin
+        ``inflight`` — and shrink the submission budget — for the rest
+        of the run.
+
+        Pass the reaped submissions' sequence numbers: ``inflight`` is
+        decremented for each ONCE, here, and the seqs are remembered so
+        a payload that merely *outlived* its reaping (the read was slow,
+        not dropped) is discarded at harvest without a second decrement
+        — the underflow that used to drive ``inflight`` negative.  A
+        bare int is accepted for callers that never see the payload
+        again (count-only reap; no double-decrement protection)."""
+        if isinstance(seqs, int):
+            n = seqs
+        else:
+            seqs = [int(s) for s in seqs]
+            self._reaped.update(seqs)
+            n = len(seqs)
         self.lost += n
         self.inflight = max(0, self.inflight - n)
 
@@ -123,26 +159,52 @@ class Prefetcher:
 
     # ---- hot-thread surface (non-blocking by contract, G016) ----
 
-    def submit(self, doc_id: int, spool_path: str, gen: int) -> bool:
+    def submit(self, doc_id: int, spool_path: str, gen: int) -> int:
         """Queue one cold→warm rehydrate.  Never blocks: a full queue
         refuses the prefetch (counted; admission will simply take the
         synchronous path).  The request tuple is immutable — the only
         mutable data crossing threads is the RESULT, through the
-        declared publish point."""
+        declared publish point.
+
+        Returns the submission's sequence number (>= 1, so the result
+        is truthy iff accepted) or 0 when refused.  The caller hands
+        the seq back to :meth:`note_lost` if it reaps the entry."""
+        return self._enqueue(
+            ("spool", self._seq, int(doc_id), str(spool_path), int(gen))
+        )
+
+    def submit_construct(
+        self, doc_id: int, builder: Callable[[], dict]
+    ) -> int:
+        """Queue one first-admission stream construction.  ``builder``
+        must be PURE — a callable closed over immutable inputs only
+        (the ``FleetSpec``), since it executes on the prefetch thread;
+        its returned dict crosses back through the declared publish
+        point like any rehydrate.  Same seq/refusal contract as
+        :meth:`submit`."""
+        return self._enqueue(("construct", self._seq, int(doc_id), builder))
+
+    def _enqueue(self, item: tuple) -> int:
         try:
-            self._req.put_nowait((int(doc_id), str(spool_path), int(gen)))
+            self._req.put_nowait(item)
         except queue.Full:
             self.dropped += 1
-            return False
+            return 0
+        seq = item[1]
+        self._seq += 1
         self.submitted += 1
         self.inflight += 1
-        return True
+        return seq
 
     def drain(self) -> list[dict]:
         """Harvest every completed rehydrate (never blocks).  Each
         payload passes the ``reveal`` gate — the reader side of the
         publish contract — so armed runs attribute the crossing to
-        :meth:`_publish` (and raise on an unpublished handoff)."""
+        :meth:`_publish` (and raise on an unpublished handoff).
+
+        A payload whose seq was already reaped via :meth:`note_lost`
+        (the read outlived the reaper) is discarded here WITHOUT a
+        second ``inflight`` decrement — the underflow fix."""
         out: list[dict] = []
         while True:
             try:
@@ -150,6 +212,11 @@ class Prefetcher:
             except queue.Empty:
                 break
             payload = reveal(item)
+            seq = payload.get("seq", 0)
+            if seq in self._reaped:
+                self._reaped.discard(seq)
+                self.reap_dropped += 1
+                continue
             self.inflight -= 1
             self.harvested += 1
             if payload.get("error") is not None:
@@ -170,23 +237,39 @@ class Prefetcher:
             item = self._req.get()
             if item is None:
                 return
-            doc_id, path, gen = item
-            try:
-                st = load_state(path)
-                payload = {
-                    "doc": doc_id,
-                    "gen": gen,
-                    "row": np.asarray(st.doc[0], np.int32),
-                    "length": int(st.length[0]),
-                    "nvis": int(st.nvis[0]),
-                    "error": None,
-                }
-            except Exception as e:  # CRC damage, vanished file, ...
-                payload = {
-                    "doc": doc_id, "gen": gen, "row": None,
-                    "length": 0, "nvis": 0,
-                    "error": f"{type(e).__name__}: {e}",
-                }
+            kind, seq = item[0], item[1]
+            if kind == "spool":
+                _, _, doc_id, path, gen = item
+                try:
+                    st = load_state(path)
+                    payload = {
+                        "kind": "spool",
+                        "seq": seq,
+                        "doc": doc_id,
+                        "gen": gen,
+                        "row": np.asarray(st.doc[0], np.int32),
+                        "length": int(st.length[0]),
+                        "nvis": int(st.nvis[0]),
+                        "error": None,
+                    }
+                except Exception as e:  # CRC damage, vanished file, ...
+                    payload = {
+                        "kind": "spool", "seq": seq, "doc": doc_id,
+                        "gen": gen, "row": None, "length": 0, "nvis": 0,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+            else:  # construct: first-admission tensorization off-drain
+                _, _, doc_id, builder = item
+                try:
+                    payload = dict(builder())
+                    payload.update(
+                        kind="construct", seq=seq, doc=doc_id, error=None
+                    )
+                except Exception as e:
+                    payload = {
+                        "kind": "construct", "seq": seq, "doc": doc_id,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
             try:
                 self._publish(payload)
             except queue.Full:

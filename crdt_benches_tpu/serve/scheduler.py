@@ -74,6 +74,7 @@ import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from functools import partial
 
 import numpy as np
 
@@ -184,6 +185,58 @@ class DocStream:
         return max(0, min(end, self.cursor) - max(0, start))
 
 
+def _tensorize_trace(trace, batch_chars: int, max_class: int) -> tuple:
+    """One trace -> packed coalesced range-op arrays + cumsums + the
+    raw tensorization (for init/capacity metadata).  Pure function of
+    its arguments — it also runs on the prefetch worker thread for
+    streaming construction, so it must touch no shared mutable state."""
+    from ..ops.packing import pack_ops
+
+    rt = tensorize_ranges(trace, batch=1, coalesce=True)
+    n = rt.n_ops
+    arrays = split_insert_runs(
+        rt.kind[:n], rt.pos[:n], rt.rlen[:n], rt.slot0[:n],
+        batch_chars,
+    )
+    kind_a, pos_a, rlen_a, slot_a = arrays
+    # slot0 is only ever read for INSERT ops; the tensorizer's
+    # -1 sentinel on deletes would (rightly) fail the unsigned
+    # lane's range check, so normalize it away first
+    slot_a = np.where(kind_a == INSERT, slot_a, 0)
+    arrays = pack_ops(
+        kind_a, pos_a, rlen_a, slot_a, max_class=max_class,
+    )
+    ins_cum = np.cumsum(
+        np.where(arrays[0] == INSERT, arrays[2], 0)
+    ).astype(np.int32)
+    unit_cum = np.cumsum(arrays[2]).astype(np.int32)
+    return arrays, ins_cum, unit_cum, rt
+
+
+def build_stream_payload(spec, doc_id: int, batch_chars: int,
+                         max_class: int) -> dict:
+    """Materialize ONE doc's session + tensorized stream as a plain
+    dict of arrays — the streaming-construction payload.
+
+    PURE by contract: everything derives from the frozen ``FleetSpec``
+    and scalars, so the prefetch worker can run it off the drain and
+    hand the result back through the declared ``publish=prefetch``
+    point (``Prefetcher.submit_construct``).  Array keys carry an
+    ``_a`` suffix so they never collide with the payload envelope's
+    own ``kind`` tag."""
+    s = spec.session(doc_id)
+    (kind, pos, rlen, slot0), ins_cum, unit_cum, rt = _tensorize_trace(
+        s.trace, batch_chars, max_class
+    )
+    return {
+        "kind_a": kind, "pos_a": pos, "rlen_a": rlen, "slot0_a": slot0,
+        "ins_cum": ins_cum, "unit_cum": unit_cum,
+        "n_patches": rt.n_patches, "n_init": len(rt.init_chars),
+        "capacity": rt.capacity, "chars": rt.chars,
+        "arrival": s.arrival, "burst": s.burst,
+    }
+
+
 def prepare_streams(sessions, pool: DocPool, batch: int = 64,
                     batch_chars: int = 256) -> dict[int, DocStream]:
     """Tensorize every session's trace as coalesced range ops, register
@@ -196,33 +249,14 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
     (``ops/packing.py``): packing here — once per distinct trace, with
     range checking that raises rather than wraps — means staging copies
     narrow-to-narrow and a macro round uploads half the bytes."""
-    from ..ops.packing import pack_ops
-
     streams: dict[int, DocStream] = {}
     cache: dict[int, tuple] = {}  # id(trace) -> (arrays, rt)
     for s in sessions:
         hit = cache.get(id(s.trace))
         if hit is None:
-            rt = tensorize_ranges(s.trace, batch=1, coalesce=True)
-            n = rt.n_ops
-            arrays = split_insert_runs(
-                rt.kind[:n], rt.pos[:n], rt.rlen[:n], rt.slot0[:n],
-                batch_chars,
+            hit = cache[id(s.trace)] = _tensorize_trace(
+                s.trace, batch_chars, max(pool.classes)
             )
-            kind_a, pos_a, rlen_a, slot_a = arrays
-            # slot0 is only ever read for INSERT ops; the tensorizer's
-            # -1 sentinel on deletes would (rightly) fail the unsigned
-            # lane's range check, so normalize it away first
-            slot_a = np.where(kind_a == INSERT, slot_a, 0)
-            arrays = pack_ops(
-                kind_a, pos_a, rlen_a, slot_a,
-                max_class=max(pool.classes),
-            )
-            ins_cum = np.cumsum(
-                np.where(arrays[0] == INSERT, arrays[2], 0)
-            ).astype(np.int32)
-            unit_cum = np.cumsum(arrays[2]).astype(np.int32)
-            hit = cache[id(s.trace)] = (arrays, ins_cum, unit_cum, rt)
         (kind, pos, rlen, slot0), ins_cum, unit_cum, rt = hit
         pool.register(
             s.doc_id, n_init=len(rt.init_chars),
@@ -237,6 +271,188 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
             burst=getattr(s, "burst", None),
         )
     return streams
+
+
+#: shared zero-length arrays for released streams: a drained doc's
+#: DocStream keeps its identity (victim selection, fault paths, repeat
+#: drain notes all still index it) but drops its op arrays — O(1) per
+#: released doc instead of the full stream.
+_EMPTY_I32 = np.zeros(0, np.int32)
+
+
+class LazyStreams:
+    """Mapping-shaped view over a :class:`FleetSpec`: the op queues of
+    a fleet, materialized per doc on first access — the streaming
+    construction path.  Construction cost and host footprint scale
+    with the ACTIVE set: nothing exists for a doc (no session, no
+    trace, no tensorized arrays, no pool record — GENESIS residency)
+    until the scheduler first touches it.
+
+    Dict-compatible surface the scheduler uses: ``[]`` (materializes),
+    ``get``, ``in``, ``len``, ``keys``; ``values()`` / ``items()``
+    iterate the LIVE (materialized) population only — full-fleet
+    aggregates have lazy-aware branches in the scheduler instead.
+
+    Materialization has three entry points:
+
+    - :meth:`__getitem__` — synchronous, on the hot thread (the
+      fallback path, and the common one for cold starts);
+    - :meth:`adopt` — a stream the prefetch worker built off-drain
+      (:func:`build_stream_payload` via ``submit_construct``) arrives
+      through the declared publish point and is installed here;
+    - :meth:`release` — the reverse edge: a drained doc's arrays are
+      swapped for shared empty ones, so a long drain's footprint
+      tracks the active set, not the docs ever seen."""
+
+    def __init__(self, spec, pool: DocPool, batch: int = 64,
+                 batch_chars: int = 256):
+        self.spec = spec
+        self.pool = pool
+        self.batch = batch
+        self.batch_chars = batch_chars
+        self.bounded = False  # queue_cap mode: delivered=cursor at birth
+        self._live: dict[int, DocStream] = {}
+        self._tcache: dict = {}  # (band, trace name) -> tensorized
+        self.materialized = 0
+        self.released = 0
+        self.prefetch_built = 0  # streams adopted from the worker
+        self.patches_total = 0  # n_patches over materialized docs
+        pool.set_genesis_population(spec.n_docs)
+
+    # ---- mapping surface ----
+
+    def __len__(self) -> int:
+        return self.spec.n_docs
+
+    def __contains__(self, doc_id) -> bool:
+        return 0 <= int(doc_id) < self.spec.n_docs
+
+    def keys(self):
+        return range(self.spec.n_docs)
+
+    def values(self):
+        """LIVE streams only (materialized, incl. released stubs)."""
+        return self._live.values()
+
+    def items(self):
+        return self._live.items()
+
+    def get(self, doc_id, default=None):
+        """Non-materializing probe: the live stream or ``default``."""
+        if doc_id is None:
+            return default
+        return self._live.get(int(doc_id), default)
+
+    def __getitem__(self, doc_id: int) -> DocStream:
+        st = self._live.get(doc_id)
+        if st is None:
+            st = self._materialize(self.spec.session(doc_id))
+        return st
+
+    # ---- materialization edges ----
+
+    @fenced
+    def _install(self, st: DocStream, n_init: int, capacity: int,  # graftlint: fence=genesis
+                 chars) -> DocStream:
+        self.pool.register(
+            st.doc_id, n_init=n_init, capacity_need=capacity,
+            chars=chars,
+        )
+        if self.bounded and st.delivered is None:
+            st.delivered = st.cursor
+        self._live[st.doc_id] = st
+        self.materialized += 1
+        self.patches_total += st.n_patches
+        return st
+
+    @fenced
+    def _materialize(self, s) -> DocStream:  # graftlint: fence=genesis
+        # Trace-band docs share the lru-cached ``trace_prefix`` object,
+        # so their tensorization is cached per (band, trace): a few
+        # entries, never more.  Synth traces are unique per doc AND
+        # transient — the eager path's id(trace) key would poison the
+        # cache here the moment CPython recycles a freed trace's id —
+        # so they are tensorized directly, never cached.
+        if s.source == "synth":
+            hit = _tensorize_trace(
+                s.trace, self.batch_chars, max(self.pool.classes)
+            )
+        else:
+            key = (s.band, s.source)
+            hit = self._tcache.get(key)
+            if hit is None:
+                hit = self._tcache[key] = _tensorize_trace(
+                    s.trace, self.batch_chars, max(self.pool.classes)
+                )
+        (kind, pos, rlen, slot0), ins_cum, unit_cum, rt = hit
+        return self._install(
+            DocStream(
+                doc_id=s.doc_id,
+                kind=kind, pos=pos, rlen=rlen, slot0=slot0,
+                ins_cum=ins_cum, unit_cum=unit_cum,
+                n_patches=rt.n_patches, arrival=s.arrival,
+                burst=s.burst,
+            ),
+            n_init=len(rt.init_chars), capacity=rt.capacity,
+            chars=rt.chars,
+        )
+
+    def builder(self, doc_id: int):
+        """The pure construct callable handed to the prefetch worker
+        (it crosses threads ON the request queue — no shared mutable
+        attribute exists, G014 by construction).  Deliberately a
+        ``partial``, not a closure: :func:`build_stream_payload` runs
+        on the PREFETCH thread, so the hot-path walk must not see a
+        call edge into it from here — deferring through ``partial``
+        keeps the static model aligned with the runtime."""
+        return partial(
+            build_stream_payload, self.spec, int(doc_id),
+            self.batch_chars, max(self.pool.classes),
+        )
+
+    def adopt(self, doc_id: int, payload: dict) -> bool:
+        """Install a worker-built stream (harvested construct payload).
+        False when superseded — the doc already materialized through
+        the synchronous path while the construction flew."""
+        if doc_id in self._live:
+            return False
+        self._install(
+            DocStream(
+                doc_id=doc_id,
+                kind=payload["kind_a"], pos=payload["pos_a"],
+                rlen=payload["rlen_a"], slot0=payload["slot0_a"],
+                ins_cum=payload["ins_cum"],
+                unit_cum=payload["unit_cum"],
+                n_patches=payload["n_patches"],
+                arrival=payload["arrival"], burst=payload["burst"],
+            ),
+            n_init=payload["n_init"], capacity=payload["capacity"],
+            chars=payload["chars"],
+        )
+        self.prefetch_built += 1
+        return True
+
+    def release(self, doc_id: int) -> None:
+        """Drop a drained doc's op arrays (keep the stream object: the
+        victim picker and fault paths still index it).  Idempotent."""
+        st = self._live.get(doc_id)
+        if st is None or st.kind is _EMPTY_I32:
+            return
+        st.kind = st.pos = st.rlen = st.slot0 = _EMPTY_I32
+        st.ins_cum = st.unit_cum = _EMPTY_I32
+        st.cursor = 0
+        st.limit = None
+        if st.delivered is not None:
+            st.delivered = 0
+        self.released += 1
+
+    @property
+    def all_done(self) -> bool:
+        """Every doc materialized at least once AND drained."""
+        return (
+            self.materialized >= self.spec.n_docs
+            and all(s.remaining == 0 for s in self._live.values())
+        )
 
 
 #: Cause tags for the per-doc admission-to-drain latency series: how the
@@ -466,26 +682,50 @@ class FleetScheduler:
         self._bp_round = False
         self._snapped = False
         self._n_rounds = 0
-        # FIFO of doc ids not yet arrived or with pending ops, in
-        # arrival order (stable for determinism).
-        self._rr = deque(sorted(
-            streams, key=lambda d: (streams[d].arrival, d)
-        ))
-        # static arrival schedule + ended-doc set: the O(1) inputs the
-        # _select early exit uses to count the unscanned tail's TRUE
-        # waiting docs (arrived and not drained) without touching it
-        self._arrivals_sorted = np.sort(np.fromiter(
-            (st.arrival for st in streams.values()), dtype=np.int64,
-            count=len(streams),
-        ))
+        # streaming construction (LazyStreams): the rotation is FED
+        # from the arrival-sorted order array as rounds reach each
+        # doc's arrival — nothing exists for a doc (no session, no
+        # stream, no pool record) until the scheduler touches it, so
+        # setup cost and footprint scale with the active set.
+        self._lazy = isinstance(streams, LazyStreams)
+        if self._lazy:
+            streams.bounded = self.queue_cap > 0
+            arr = streams.spec.arrivals.astype(np.int64)
+            order = np.argsort(arr, kind="stable")
+            self._order = order.astype(np.int64)
+            self._order_arrivals = arr[order]
+            self._order_ptr = 0
+            # FIFO of ARRIVED doc ids with pending ops (fed lazily)
+            self._rr: deque[int] = deque()
+            self._arrivals_sorted = self._order_arrivals
+            # total patches is only known once every doc materializes:
+            # run() backfills it from the lazy view at drain end
+            self.stats = ServeStats(patches=0)
+        else:
+            self._order = None
+            self._order_arrivals = None
+            self._order_ptr = 0
+            # FIFO of doc ids not yet arrived or with pending ops, in
+            # arrival order (stable for determinism).
+            self._rr = deque(sorted(
+                streams, key=lambda d: (streams[d].arrival, d)
+            ))
+            # static arrival schedule + ended-doc set: the O(1) inputs
+            # the _select early exit uses to count the unscanned
+            # tail's TRUE waiting docs (arrived and not drained)
+            # without touching it
+            self._arrivals_sorted = np.sort(np.fromiter(
+                (st.arrival for st in streams.values()), dtype=np.int64,
+                count=len(streams),
+            ))
+            if self.queue_cap > 0:
+                for st in streams.values():
+                    if st.delivered is None:
+                        st.delivered = st.cursor
+            self.stats = ServeStats(
+                patches=sum(s.n_patches for s in streams.values())
+            )
         self._ended: set[int] = set()
-        if self.queue_cap > 0:
-            for st in streams.values():
-                if st.delivered is None:
-                    st.delivered = st.cursor
-        self.stats = ServeStats(
-            patches=sum(s.n_patches for s in streams.values())
-        )
         self.profiler = profiler  # obs/profiler.py DeviceProfiler (or None)
         self._pending_round: tuple[float, bool, bool] | None = None
         # request lifecycle (obs/reqtrace.py): disarmed, the tracker is
@@ -524,11 +764,13 @@ class FleetScheduler:
         self.telemetry = telemetry
         # ---- predictive prefetch (tiered pool only): hot-thread-owned
         # accounting; the worker thread sees only the queues.  The
-        # inflight table maps doc -> submit round so entries whose
-        # results never arrive (the worker's bounded publish dropped
-        # them during a wedged round) are reaped instead of pinning
-        # the submission budget forever ----
-        self._prefetch_inflight: dict[int, int] = {}
+        # inflight table maps doc -> (submit round, seq) so entries
+        # whose results never arrive (the worker's bounded publish
+        # dropped them during a wedged round) are reaped BY SEQ instead
+        # of pinning the submission budget forever — and a payload that
+        # outlives its reaping is dropped at harvest without a second
+        # inflight decrement ----
+        self._prefetch_inflight: dict[int, tuple[int, int]] = {}
         #: cold docs rehydrated ahead of admission per round: the next
         #: macro-round's worth of admissions is the natural horizon
         self._prefetch_lookahead = max(
@@ -645,6 +887,11 @@ class FleetScheduler:
         dt = self.reqtrace.close_request(
             st.doc_id, tag, round_no=self.round
         )
+        if self._lazy and self.journal is None:
+            # streaming construction: a drained doc's op arrays are
+            # dead weight (nothing replays them without a journal) —
+            # drop them so footprint tracks the ACTIVE set
+            self.streams.release(st.doc_id)
         if dt is None:
             return  # never admitted (or this episode already closed)
         self.stats.note_doc_drained(tag, dt)
@@ -937,11 +1184,21 @@ class FleetScheduler:
         while True:
             self._k_round = self.effective_k
             self._planned_degraded = self._degrade_left > 0
+            self._feed_rotation()
             plan = _Plan(base_round=self.round)
             self._select(plan)
             if plan.lanes:
                 self._place(plan)
                 return plan
+            if self._lazy:
+                # unarrived docs are exactly the unfed tail of the
+                # order array — the next arrival is O(1), no scan
+                if self._order_ptr >= len(self._order):
+                    return None
+                self.round = int(
+                    self._order_arrivals[self._order_ptr]
+                )
+                continue
             pending = [
                 s.arrival for s in self.streams.values()
                 if s.remaining and s.arrival > self.round
@@ -949,6 +1206,19 @@ class FleetScheduler:
             if not pending:
                 return None
             self.round = min(pending)  # idle: jump to the next arrival
+
+    def _feed_rotation(self) -> None:
+        """Streaming construction: admit every doc whose arrival round
+        has come into the rotation (ids only — materialization waits
+        for first selection or an off-drain construct prefetch)."""
+        if not self._lazy:
+            return
+        n = len(self._order)
+        p = self._order_ptr
+        while p < n and self._order_arrivals[p] <= self.round:
+            self._rr.append(int(self._order[p]))
+            p += 1
+        self._order_ptr = p
 
     # ---- staging (host tensorize; overlaps device execution) ----
 
@@ -1062,8 +1332,16 @@ class FleetScheduler:
             doc_id = payload["doc"]
             self._prefetch_inflight.pop(doc_id, None)
             if payload["error"] is not None:
-                # damaged/vanished spool: the synchronous admission
-                # path owns detection + heal; nothing to do here
+                # damaged/vanished spool (or a construct builder that
+                # raised): the synchronous admission path owns
+                # detection + heal; nothing to do here
+                continue
+            if payload.get("kind") == "construct":
+                # a worker-built stream (streaming construction):
+                # install it unless the doc already materialized
+                # synchronously while the construction flew
+                if not self.streams.adopt(doc_id, payload):
+                    self.prefetch_wasted += 1
                 continue
             if not self.pool.store_prefetched(
                 doc_id, payload["row"], payload["length"],
@@ -1091,13 +1369,15 @@ class FleetScheduler:
         # in place they would pin the submission budget forever
         reap_before = self.round - 32 * max(1, self._k_round)
         stale = [
-            d for d, r0 in self._prefetch_inflight.items()
+            (d, seq) for d, (r0, seq) in self._prefetch_inflight.items()
             if r0 < reap_before
         ]
         if stale:
-            for d in stale:
+            for d, _seq in stale:
                 del self._prefetch_inflight[d]
-            pf.note_lost(len(stale))
+            # reap BY SEQ: a payload that merely outlived the reaper is
+            # dropped at harvest without a second inflight decrement
+            pf.note_lost([seq for _d, seq in stale])
         # outstanding work is bounded by the admission horizon AND the
         # worker's queue capacity (never more reads in flight than the
         # result queue can absorb), NOT by warm free space: a full
@@ -1105,21 +1385,49 @@ class FleetScheduler:
         # entries (store_prefetched)
         space = min(self._prefetch_lookahead, pool.warm.budget,
                     pf.capacity) - len(self._prefetch_inflight)
-        wanted: list[tuple[int, str, int]] = []
+        # each entry: ("spool", doc, path, gen) — a cold rehydrate — or
+        # ("construct", doc) — an off-drain stream construction for a
+        # genesis doc the rotation will reach (streaming mode only)
+        wanted: list[tuple] = []
         scanned = 0
         for doc_id in self._rr:
             scanned += 1
             if scanned > self._prefetch_lookahead or len(wanted) >= space:
                 break
-            rec = pool.docs[doc_id]
+            if doc_id in self._prefetch_inflight:
+                continue
+            rec = pool.docs.get(doc_id) if self._lazy \
+                else pool.docs[doc_id]
+            if rec is None:
+                # genesis doc already fed into the rotation: build its
+                # stream off-drain (it is arrived by the feed
+                # invariant, so it is always within the horizon)
+                wanted.append(("construct", doc_id))
+                continue
             if rec.spool is None or rec.cls is not None \
-                    or doc_id in pool.warm \
-                    or doc_id in self._prefetch_inflight:
+                    or doc_id in pool.warm:
                 continue
             st = self.streams[doc_id]
             if st.remaining == 0 or st.arrival > horizon:
                 continue
-            wanted.append((doc_id, rec.spool, pool.spool_gen(doc_id)))
+            wanted.append(
+                ("spool", doc_id, rec.spool, pool.spool_gen(doc_id))
+            )
+        if self._lazy:
+            # look PAST the fed rotation: genesis docs arriving within
+            # the horizon get their streams built before their feed
+            p = self._order_ptr
+            n = len(self._order)
+            while p < n and len(wanted) < space \
+                    and scanned <= self._prefetch_lookahead:
+                if self._order_arrivals[p] > horizon:
+                    break
+                d = int(self._order[p])
+                p += 1
+                scanned += 1
+                if d in self._prefetch_inflight or d in pool.docs:
+                    continue
+                wanted.append(("construct", d))
         if not wanted:
             return
         if self.faults is not None:
@@ -1139,9 +1447,17 @@ class FleetScheduler:
                         dropped=len(wanted),
                     )
                 return
-        for doc_id, path, gen in wanted:
-            if pf.submit(doc_id, path, gen):
-                self._prefetch_inflight[doc_id] = self.round
+        for item in wanted:
+            if item[0] == "spool":
+                _, doc_id, path, gen = item
+                seq = pf.submit(doc_id, path, gen)
+            else:
+                _, doc_id = item
+                seq = pf.submit_construct(
+                    doc_id, self.streams.builder(doc_id)
+                )
+            if seq:
+                self._prefetch_inflight[doc_id] = (self.round, seq)
 
     def _fire_tier_pressure(self) -> None:
         """The ``tier_evict_pressure`` chaos kind: force warm-tier
@@ -1977,8 +2293,14 @@ class FleetScheduler:
         self.stats.evictions = self.pool.evictions
         self.stats.restores = self.pool.restores
         self.stats.promotions = self.pool.promotions
+        if self._lazy:
+            # total patch count is only known once docs materialize:
+            # at drain end the lazy tally IS the eager sum
+            self.stats.patches = self.streams.patches_total
         return self.stats
 
     @property
     def done(self) -> bool:
+        if self._lazy:
+            return self.streams.all_done
         return all(s.remaining == 0 for s in self.streams.values())

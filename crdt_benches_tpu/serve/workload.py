@@ -233,6 +233,130 @@ def _fitting_traces(slot_budget: int, window_cap: int | None) -> tuple:
 ZIPF_EXP = 3.0
 
 
+@dataclass(frozen=True)
+class FleetSpec:
+    """The fleet as ARITHMETIC, not objects: everything `build_fleet`
+    would materialize for doc ``i`` is derivable from this spec in O(1)
+    — band and arrival from three small per-doc arrays drawn up front
+    (the only O(fleet) state, a few bytes per doc), the synth stream
+    from a per-doc generator seeded ``(seed, doc_id)``.  The eager path
+    is :meth:`session` mapped over the full range; the streaming path
+    calls it per doc on first admission, so session/trace/stream cost
+    scales with the ACTIVE set.
+
+    Frozen + read-only arrays: a spec crosses into the prefetch worker
+    inside construct-request builders, so nothing here may be mutable
+    (graftlint G014's shared-state rule, honored by construction)."""
+
+    n_docs: int
+    seed: int
+    horizon: int
+    delivery: str | None
+    #: sorted band names; ``band_of`` indexes into this
+    names: tuple[str, ...]
+    #: band -> (source, sizing) table (BANDS or a test override)
+    table: dict
+    band_of: np.ndarray  # int16 band index per doc
+    arrivals: np.ndarray  # int32 arrival round per doc
+    #: exclusive running count of trace-band docs before each doc — the
+    #: lazy equivalent of the eager path's global round-robin counter
+    trace_ord: np.ndarray  # int32
+
+    @staticmethod
+    def build(
+        n_docs: int,
+        mix: str | dict[str, float] = "mixed",
+        seed: int = 0,
+        arrival_span: int = 8,
+        bands: dict | None = None,
+        delivery: str | None = None,
+        horizon: int = 1,
+        arrival_dist: str = "uniform",
+    ) -> "FleetSpec":
+        """Draw the per-fleet vectors (band assignment, arrivals) in the
+        exact order the eager builder always drew them — same seed, same
+        bands and arrival rounds, byte-for-byte."""
+        weights = MIXES[mix] if isinstance(mix, str) else dict(mix)
+        table = BANDS if bands is None else bands
+        names = sorted(weights)
+        w = np.asarray([weights[b] for b in names], float)
+        if not np.all(w >= 0) or w.sum() <= 0:
+            raise ValueError(f"bad mix weights {weights}")
+        w = w / w.sum()
+        if arrival_dist not in ("uniform", "zipf"):
+            raise ValueError(
+                f"unknown arrival_dist {arrival_dist!r} "
+                "(expected 'uniform' or 'zipf')"
+            )
+        rng = np.random.default_rng(seed)
+        band_of = rng.choice(len(names), size=n_docs, p=w)
+        if arrival_span <= 1:
+            arrivals = np.zeros(n_docs, int)
+        elif arrival_dist == "zipf":
+            arrivals = np.floor(
+                arrival_span * rng.random(n_docs) ** ZIPF_EXP
+            ).astype(int)
+        else:
+            arrivals = rng.integers(0, arrival_span, size=n_docs)
+        is_trace = np.asarray(
+            [1 if table[b][0] == "trace" else 0 for b in names],
+            np.int32,
+        )[band_of] if n_docs else np.zeros(0, np.int32)
+        trace_ord = np.zeros(n_docs, np.int64)
+        if n_docs:
+            np.cumsum(is_trace[:-1], out=trace_ord[1:])
+        band_of = np.ascontiguousarray(band_of, np.int16)
+        arrivals = np.ascontiguousarray(arrivals, np.int32)
+        trace_ord = np.ascontiguousarray(trace_ord, np.int32)
+        for a in (band_of, arrivals, trace_ord):
+            a.flags.writeable = False
+        return FleetSpec(
+            n_docs=int(n_docs), seed=int(seed),
+            horizon=max(1, int(horizon)), delivery=delivery,
+            names=tuple(names), table=dict(table),
+            band_of=band_of, arrivals=arrivals, trace_ord=trace_ord,
+        )
+
+    def band(self, doc_id: int) -> str:
+        return self.names[int(self.band_of[doc_id])]
+
+    def session(self, doc_id: int) -> Session:
+        """Materialize ONE session in O(1) fleet-independent work: the
+        per-doc draws come from ``default_rng((seed, doc_id))`` — the
+        SeedSequence tuple derivation — so any doc's stream is
+        reproducible without touching any other doc's.  Identical
+        between the eager and streaming paths by construction (the
+        eager builder is this method mapped over the full range)."""
+        if not 0 <= doc_id < self.n_docs:
+            raise IndexError(f"doc {doc_id} outside fleet {self.n_docs}")
+        band = self.band(doc_id)
+        source, sizing = self.table[band]
+        if source == "synth":
+            lo, hi = sizing
+            r = np.random.default_rng((self.seed, doc_id))
+            n_ops = int(r.integers(lo, hi + 1)) * self.horizon
+            trace = synth_trace(
+                seed=int(r.integers(1 << 31)), n_ops=n_ops
+            )
+            src = "synth"
+        else:
+            budget, cap = sizing
+            fits = _fitting_traces(int(budget), cap)
+            src = fits[int(self.trace_ord[doc_id]) % len(fits)]
+            trace = trace_prefix(src, int(budget), cap)
+        burst = (
+            DELIVERY_BURST.get(band) if self.delivery == "banded" else None
+        )
+        return Session(
+            doc_id=doc_id, band=band, source=src, trace=trace,
+            arrival=int(self.arrivals[doc_id]), burst=burst,
+        )
+
+    def sessions(self) -> list[Session]:
+        """The whole fleet, eagerly (the legacy shape)."""
+        return [self.session(i) for i in range(self.n_docs)]
+
+
 def build_fleet(
     n_docs: int,
     mix: str | dict[str, float] = "mixed",
@@ -263,48 +387,14 @@ def build_fleet(
     Real-trace windows are bounded by their trace, so they keep the
     band's sizing and supply the capacity-class spread; the synthetic
     streams supply the history depth that stresses WAL growth, delta
-    chains, and the recovery-time objective."""
-    weights = MIXES[mix] if isinstance(mix, str) else dict(mix)
-    table = BANDS if bands is None else bands
-    names = sorted(weights)
-    w = np.asarray([weights[b] for b in names], float)
-    if not np.all(w >= 0) or w.sum() <= 0:
-        raise ValueError(f"bad mix weights {weights}")
-    w = w / w.sum()
-    if arrival_dist not in ("uniform", "zipf"):
-        raise ValueError(
-            f"unknown arrival_dist {arrival_dist!r} "
-            "(expected 'uniform' or 'zipf')"
-        )
-    rng = np.random.default_rng(seed)
-    band_of = rng.choice(len(names), size=n_docs, p=w)
-    if arrival_span <= 1:
-        arrivals = np.zeros(n_docs, int)
-    elif arrival_dist == "zipf":
-        arrivals = np.floor(
-            arrival_span * rng.random(n_docs) ** ZIPF_EXP
-        ).astype(int)
-    else:
-        arrivals = rng.integers(0, arrival_span, size=n_docs)
-    sessions: list[Session] = []
-    trace_rr = 0
-    for doc_id in range(n_docs):
-        band = names[int(band_of[doc_id])]
-        source, sizing = table[band]
-        if source == "synth":
-            lo, hi = sizing
-            n_ops = int(rng.integers(lo, hi + 1)) * max(1, int(horizon))
-            trace = synth_trace(seed=int(rng.integers(1 << 31)), n_ops=n_ops)
-            src = "synth"
-        else:
-            budget, cap = sizing
-            fits = _fitting_traces(int(budget), cap)
-            src = fits[trace_rr % len(fits)]
-            trace_rr += 1
-            trace = trace_prefix(src, int(budget), cap)
-        burst = DELIVERY_BURST.get(band) if delivery == "banded" else None
-        sessions.append(Session(
-            doc_id=doc_id, band=band, source=src, trace=trace,
-            arrival=int(arrivals[doc_id]), burst=burst,
-        ))
-    return sessions
+    chains, and the recovery-time objective.
+
+    Implemented as :class:`FleetSpec` mapped over the full doc range,
+    so the eager fleet and the streaming path's lazily-admitted one are
+    byte-identical by construction — same bands, arrivals, trace
+    assignments, and per-doc synth streams for the same seed."""
+    return FleetSpec.build(
+        n_docs, mix=mix, seed=seed, arrival_span=arrival_span,
+        bands=bands, delivery=delivery, horizon=horizon,
+        arrival_dist=arrival_dist,
+    ).sessions()

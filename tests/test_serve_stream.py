@@ -1,0 +1,507 @@
+"""Streaming fleet construction: lazy ``FleetSpec`` sessions, genesis
+residency, ``LazyStreams`` materialization edges, prefetch-thread
+tensorization, and the construction-cost accounting that gates it all.
+
+Ground truth is double-ended: the streaming path must match the eager
+path byte-for-byte (same seed => same fleet), and both must match an
+uninterrupted oracle replay of the same traces."""
+
+import importlib.util
+import json
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+from crdt_benches_tpu.serve.bench import run_serve_bench
+from crdt_benches_tpu.serve.construction import probe, scaling_table
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.prefetch import Prefetcher
+from crdt_benches_tpu.serve.scheduler import (
+    FleetScheduler,
+    LazyStreams,
+    build_stream_payload,
+    prepare_streams,
+)
+from crdt_benches_tpu.serve.workload import FleetSpec, build_fleet
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_BANDS = {"synth-small": ("synth", (40, 120))}
+TINY_MIX = {"synth-small": 1.0}
+TWO_BANDS = {
+    "synth-small": ("synth", (40, 120)),
+    "synth-medium": ("synth", (300, 600)),
+}
+TWO_MIX = {"synth-small": 0.6, "synth-medium": 0.4}
+
+
+def _spec(n=12, seed=7, arrival_span=3, **kw):
+    kw.setdefault("mix", TINY_MIX)
+    kw.setdefault("bands", TINY_BANDS)
+    return FleetSpec.build(n, seed=seed, arrival_span=arrival_span, **kw)
+
+
+def _lazy_fleet(tmp_path, n=12, seed=7, classes=(128,), slots=(3,),
+                warm_docs=0, bands=TINY_BANDS, mix=TINY_MIX, **kw):
+    spec = FleetSpec.build(n, mix=mix, seed=seed, arrival_span=2,
+                           bands=bands)
+    pool = DocPool(classes=classes, slots=slots,
+                   spool_dir=str(tmp_path / "lspool"),
+                   warm_docs=warm_docs)
+    streams = LazyStreams(spec, pool, batch=8, batch_chars=32)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32, **kw)
+    return spec, pool, streams, sched
+
+
+# ---------------------------------------------------------------------------
+# FleetSpec: seed-stable arithmetic fleet
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_spec_matches_eager_builder_exactly():
+    """Same seed => same fleet: band, arrival, source, and the full
+    trace, doc by doc, across a two-band mix (exercising the lazy
+    trace-ordinal bookkeeping too)."""
+    n, seed = 40, 13
+    spec = FleetSpec.build(n, mix=TWO_MIX, seed=seed, arrival_span=4,
+                           bands=TWO_BANDS)
+    eager = build_fleet(n, mix=TWO_MIX, seed=seed, arrival_span=4,
+                        bands=TWO_BANDS)
+    assert len(eager) == spec.n_docs == n
+    for s in eager:
+        lazy = spec.session(s.doc_id)
+        assert lazy.band == s.band
+        assert lazy.arrival == s.arrival
+        assert lazy.source == s.source
+        # TestData is a dataclass tree: == is deep byte equality
+        assert lazy.trace == s.trace, f"doc {s.doc_id} diverged"
+
+
+def test_fleet_spec_session_is_random_access():
+    """Materializing docs out of order, repeatedly, yields identical
+    sessions — nothing in the spec mutates on access."""
+    spec = _spec(n=10, seed=3)
+    a = spec.session(7)
+    spec.session(2), spec.session(9)
+    b = spec.session(7)
+    assert a.trace == b.trace and a.arrival == b.arrival
+    with pytest.raises(IndexError):
+        spec.session(10)
+    with pytest.raises(IndexError):
+        spec.session(-1)
+
+
+def test_zipf_arrivals_in_range_and_head_heavy():
+    """``arrival_dist="zipf"`` keeps every arrival inside
+    ``[0, arrival_span)``, lands more docs in the head round than the
+    tail round, and is seed-deterministic against the eager builder."""
+    span = 8
+    spec = _spec(n=600, seed=5, arrival_span=span, arrival_dist="zipf")
+    arr = spec.arrivals
+    assert arr.min() >= 0 and arr.max() < span
+    head = int((arr == 0).sum())
+    tail = int((arr == span - 1).sum())
+    assert head > tail > 0
+    eager = build_fleet(600, mix=TINY_MIX, seed=5, arrival_span=span,
+                        bands=TINY_BANDS, arrival_dist="zipf")
+    assert [int(a) for a in arr] == [s.arrival for s in eager]
+
+
+# ---------------------------------------------------------------------------
+# genesis residency
+# ---------------------------------------------------------------------------
+
+
+def test_genesis_population_drains_through_register(tmp_path):
+    """Every doc starts in genesis (no pool record at all); each first
+    registration moves exactly one doc genesis -> tracked, and repeat
+    registrations do not double-count."""
+    pool = DocPool(classes=(128,), slots=(4,),
+                   spool_dir=str(tmp_path / "spool"))
+    chars = np.full(4, ord("a"), np.int32)
+    pool.set_genesis_population(3)
+    assert pool.genesis_docs == 3
+    pool.register(0, n_init=4, capacity_need=16, chars=chars)
+    assert pool.genesis_docs == 2
+    pool.register(0, n_init=4, capacity_need=16, chars=chars)
+    assert pool.genesis_docs == 2  # re-register is not a genesis exit
+    pool.register(1, n_init=4, capacity_need=16, chars=chars)
+    pool.register(2, n_init=4, capacity_need=16, chars=chars)
+    assert pool.genesis_docs == 0
+    assert pool.tier_status()["genesis_docs"] == 0
+    pool.close()
+
+
+def test_lazy_streams_genesis_gauge_reaches_zero(tmp_path):
+    """A lazy fleet is born fully genesis; a full drain materializes
+    every doc, so the gauge ends at zero."""
+    spec, pool, streams, sched = _lazy_fleet(tmp_path, n=8)
+    assert pool.genesis_docs == 8
+    assert streams.materialized == 0
+    sched.run()
+    assert sched.done and streams.all_done
+    assert pool.genesis_docs == 0
+    assert streams.materialized == 8
+
+
+# ---------------------------------------------------------------------------
+# LazyStreams mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_lazy_streams_mapping_surface(tmp_path):
+    spec, pool, streams, _ = _lazy_fleet(tmp_path, n=6)
+    assert len(streams) == 6
+    assert 5 in streams and 6 not in streams
+    assert list(streams.keys()) == list(range(6))
+    # get() never materializes
+    assert streams.get(4) is None and streams.get(None) is None
+    assert streams.materialized == 0
+    st = streams[4]  # [] does
+    assert st.doc_id == 4 and streams.get(4) is st
+    assert streams.materialized == 1
+    assert dict(streams.items()) == {4: st}
+    assert list(streams.values()) == [st]
+
+
+def test_lazy_builder_is_pure_and_matches_sync_path(tmp_path):
+    """The construct callable handed to the prefetch worker is a
+    ``partial`` over the pure payload builder, and its product installs
+    a stream identical to the synchronous materialization."""
+    spec, pool, streams, _ = _lazy_fleet(tmp_path, n=6)
+    b = streams.builder(2)
+    assert isinstance(b, partial) and b.func is build_stream_payload
+    payload = b()
+    assert streams.adopt(2, payload)
+    assert streams.prefetch_built == 1
+    sync = _tensorized_reference(spec, pool, 2)
+    got = streams[2]
+    np.testing.assert_array_equal(got.kind, sync.kind)
+    np.testing.assert_array_equal(got.pos, sync.pos)
+    np.testing.assert_array_equal(got.rlen, sync.rlen)
+    np.testing.assert_array_equal(got.slot0, sync.slot0)
+    assert got.n_patches == sync.n_patches
+    assert got.arrival == sync.arrival
+
+
+def _tensorized_reference(spec, pool, doc_id):
+    """The eager path's stream for one doc (fresh pool-independent
+    tensorization via prepare_streams on a throwaway mapping)."""
+    return prepare_streams(
+        [spec.session(doc_id)], pool, batch=8, batch_chars=32
+    )[doc_id]
+
+
+def test_lazy_adopt_superseded_by_sync_materialization(tmp_path):
+    """A worker-built payload landing after the hot thread already
+    materialized the doc is dropped (False), not double-installed."""
+    spec, pool, streams, _ = _lazy_fleet(tmp_path, n=6)
+    payload = streams.builder(3)()
+    st = streams[3]  # sync path wins the race
+    assert streams.adopt(3, payload) is False
+    assert streams[3] is st
+    assert streams.prefetch_built == 0 and streams.materialized == 1
+
+
+def test_lazy_release_drops_arrays_idempotently(tmp_path):
+    spec, pool, streams, _ = _lazy_fleet(tmp_path, n=6)
+    st = streams[1]
+    assert st.kind.size > 0
+    streams.release(1)
+    assert st.kind.size == 0 and st.ins_cum.size == 0
+    assert streams.released == 1
+    streams.release(1)  # idempotent
+    streams.release(5)  # never materialized: no-op
+    assert streams.released == 1
+    # the stub keeps its identity for victim/fault indexing
+    assert streams.get(1) is st and st.remaining == 0
+
+
+def test_lazy_materialize_does_not_reuse_recycled_trace_ids(tmp_path):
+    """Regression pin: synth traces are transient in the lazy path, so
+    an id(trace)-keyed tensorize cache gets poisoned as soon as CPython
+    recycles a freed trace's id — every doc must tensorize ITS OWN
+    stream.  (Trace-band prefixes are lru-cached and shared; only the
+    unique-per-doc synth source ever hit the recycling hazard.)"""
+    spec, pool, streams, _ = _lazy_fleet(tmp_path, n=30, seed=11)
+    for d in range(30):
+        st = streams[d]  # one at a time: frees each trace before next
+        assert st.n_patches == len(spec.session(d).trace), f"doc {d}"
+
+
+def test_lazy_all_done_requires_full_materialization(tmp_path):
+    spec, pool, streams, _ = _lazy_fleet(tmp_path, n=3)
+    for d in (0, 1):
+        streams[d].cursor = streams[d].n_total
+    assert not streams.all_done  # doc 2 still genesis
+    streams[2].cursor = streams[2].n_total
+    assert streams.all_done
+
+
+# ---------------------------------------------------------------------------
+# byte parity: eager vs streaming, including mid-run evict/restore
+# ---------------------------------------------------------------------------
+
+
+def test_eager_vs_lazy_drain_byte_parity_under_eviction(tmp_path):
+    """The acceptance-criteria pin: the SAME fleet drained through the
+    eager and streaming paths — with slots oversubscribed so docs
+    evict to the spool and restore mid-run — ends byte-identical per
+    doc, and both match the oracle."""
+    n, seed = 18, 11
+    kw = dict(mix=TWO_MIX, seed=seed, arrival_span=3, bands=TWO_BANDS)
+    sessions = build_fleet(n, **kw)
+    epool = DocPool(classes=(128, 1024), slots=(3, 2),
+                    spool_dir=str(tmp_path / "espool"), warm_docs=2)
+    estreams = prepare_streams(sessions, epool, batch=8, batch_chars=32)
+    esched = FleetScheduler(epool, estreams, batch=8, macro_k=4,
+                            batch_chars=32)
+    esched.run()
+    assert esched.done
+    assert epool.evictions > 0  # the mid-run evict/restore actually ran
+
+    spec = FleetSpec.build(n, **kw)
+    lpool = DocPool(classes=(128, 1024), slots=(3, 2),
+                    spool_dir=str(tmp_path / "lspool"), warm_docs=2)
+    lstreams = LazyStreams(spec, lpool, batch=8, batch_chars=32)
+    lsched = FleetScheduler(lpool, lstreams, batch=8, macro_k=4,
+                            batch_chars=32)
+    lsched.run()
+    assert lsched.done and lstreams.all_done
+    assert lpool.evictions > 0
+
+    assert lsched.stats.patches == esched.stats.patches
+    for s in sessions:
+        want = replay_trace(s.trace)
+        assert epool.decode(s.doc_id) == want, f"eager doc {s.doc_id}"
+        assert lpool.decode(s.doc_id) == want, f"lazy doc {s.doc_id}"
+    epool.close(), lpool.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetcher: sequence-reaped inflight accounting
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_inflight_never_underflows_after_reap():
+    """The regression pin for the inflight underflow: a submission
+    reaped via ``note_lost`` whose payload later lands must not
+    decrement ``inflight`` a second time."""
+    pf = Prefetcher(capacity=4)
+    pf.start()
+    try:
+        spec = _spec(n=4, seed=1)
+        pool = DocPool(classes=(128,), slots=(4,))
+        streams = LazyStreams(spec, pool, batch=8, batch_chars=32)
+        seqs = [pf.submit_construct(d, streams.builder(d))
+                for d in range(3)]
+        assert pf.inflight == 3
+        # a LIST of seqs arms the double-decrement protection (a bare
+        # int is the count-only legacy form)
+        pf.note_lost([seqs[0]])  # scheduler reaps one entry
+        assert pf.inflight == 2
+        # wait for the worker to finish all three builds
+        deadline = 200
+        harvested = []
+        while len(harvested) + pf.reap_dropped < 3 and deadline:
+            harvested.extend(pf.drain())
+            deadline -= 1
+            time.sleep(0.01)
+        assert pf.reap_dropped == 1  # the reaped payload was dropped
+        assert {p["doc"] for p in harvested} == {1, 2}
+        assert pf.inflight == 0  # never negative, fully drained
+        pool.close()
+    finally:
+        pf.stop()
+
+
+# ---------------------------------------------------------------------------
+# construction accounting: probe + scaling table + bench artifact
+# ---------------------------------------------------------------------------
+
+
+def test_construction_probe_both_modes():
+    # a dict mix against the default BANDS table keeps the probe on
+    # the fast synth source (no trace loading in a unit test)
+    kw = dict(mix=TINY_MIX, seed=0, arrival_span=2,
+              classes=(4096,), slots=(8,))
+    stream = probe(32, **kw)
+    assert stream["mode"] == "stream" and stream["n_docs"] == 32
+    assert stream["construction_ms"] > 0
+    assert stream["genesis_docs"] == 32  # nothing materialized
+    eager = probe(32, stream=False, **kw)
+    assert eager["mode"] == "eager" and eager["genesis_docs"] == 0
+    # VmRSS and ru_maxrss use different kernel accounting; assert
+    # presence, not a cross-probe ordering
+    assert eager["peak_rss_bytes"] > 0 and eager["rss_before_bytes"] > 0
+
+
+def test_scaling_table_rows_and_eager_limit(monkeypatch):
+    """Table logic without real subprocesses: one fresh cell per
+    (size, mode), eager rows capped at ``eager_limit``, failures and
+    timeouts become error rows instead of silent omissions."""
+    import subprocess as sp
+    calls = []
+
+    class _Out:
+        def __init__(self, payload, rc=0, err=""):
+            self.stdout = json.dumps(payload)
+            self.returncode = rc
+            self.stderr = err
+
+    def fake_run(cmd, **kw):
+        n = int(cmd[cmd.index("--n-docs") + 1])
+        mode = cmd[cmd.index("--mode") + 1]
+        calls.append((n, mode))
+        if n == 64 and mode == "eager":
+            raise sp.TimeoutExpired(cmd, kw.get("timeout", 0))
+        if n == 256:
+            return _Out({}, rc=1, err="boom")
+        return _Out({"n_docs": n, "mode": mode, "construction_ms": 1.0,
+                     "rss_before_bytes": 1, "rss_after_bytes": 2,
+                     "peak_rss_bytes": 3, "genesis_docs": 0})
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    rows = scaling_table([64, 16, 256, 16], eager_limit=64,
+                         log=lambda *_: None)
+    # dedup + sorted sizes; eager stops at the limit (256 > 64)
+    assert calls == [(16, "stream"), (16, "eager"),
+                     (64, "stream"), (64, "eager"), (256, "stream")]
+    by = {(r["n_docs"], r["mode"]): r for r in rows}
+    assert "timeout" in by[(64, "eager")]["error"]
+    assert by[(256, "stream")]["error"] == "boom"
+    assert by[(16, "stream")]["construction_ms"] == 1.0
+
+
+def test_bench_artifact_construction_block_stream(tmp_path):
+    """An end-to-end streamed serve run: verify green, and the
+    artifact's construction block carries the auditable sampled-verify
+    seed + ids and the genesis/materialization accounting."""
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=10, batch=8,
+        classes=(128,), slots=(4,), seed=5, arrival_span=2,
+        verify_sample=4, bands=TINY_BANDS, macro_k=4, batch_chars=32,
+        spool_dir=str(tmp_path / "spool"),
+        results_dir=str(tmp_path / "results"),
+        stream=True, sample_seed=21,
+        log=lambda *_: None,
+    )
+    assert info["verify_ok"]
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    c = d["extra"]["construction"]
+    assert c["mode"] == "stream" and c["version"] == 1
+    assert c["construction_ms"] > 0 and c["peak_rss_bytes"] > 0
+    assert c["fleet_docs"] == 10 == c["materialized_docs"]
+    assert c["genesis_docs_end"] == 0
+    assert c["verify_sample_seed"] == 21
+    ids = d["extra"]["verified_docs"]
+    assert ids == sorted(ids) and len(ids) == 4
+    # auditable: the sample is reproducible from the recorded seed —
+    # single class, no lossy docs, so the census is exactly range(10)
+    rng = np.random.default_rng(21)
+    pick = rng.choice(list(range(10)), size=4, replace=False)
+    assert ids == sorted(int(x) for x in pick)
+
+
+def test_bench_stream_rejects_incompatible_modes(tmp_path):
+    kw = dict(mix=TINY_MIX, n_docs=4, batch=8, classes=(128,),
+              slots=(4,), bands=TINY_BANDS,
+              results_dir=str(tmp_path / "r"), stream=True,
+              log=lambda *_: None)
+    with pytest.raises(ValueError, match="journal"):
+        run_serve_bench(journal_dir=str(tmp_path / "j"), **kw)
+    with pytest.raises(ValueError, match="open"):
+        run_serve_bench(open_spec="64", **kw)
+    with pytest.raises(ValueError, match="longhaul|durability"):
+        run_serve_bench(longhaul=4, measure_recovery=True, **kw)
+
+
+def test_bench_artifact_construction_block_eager(tmp_path):
+    """The block is ALWAYS present — eager runs carry mode="eager" so
+    bench_compare can detect mode mismatches instead of guessing."""
+    r, info = run_serve_bench(
+        mix=TINY_MIX, n_docs=6, batch=8,
+        classes=(128,), slots=(4,), seed=5, arrival_span=2,
+        verify_sample=2, bands=TINY_BANDS, macro_k=4, batch_chars=32,
+        results_dir=str(tmp_path / "results"),
+        log=lambda *_: None,
+    )
+    with open(info["path"]) as f:
+        (d,) = json.load(f)
+    c = d["extra"]["construction"]
+    assert c["mode"] == "eager"
+    assert c["fleet_docs"] == 6 and c["genesis_docs_end"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: construction gating matrix
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_stream", REPO / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare_stream"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(tmp_path, name, *, mode=None, ms=500.0, rss=2**28):
+    extra = {
+        "family": "serve",
+        "patches_per_sec": 100_000.0,
+        "batch_latency": {"p50": 0.001, "p95": 0.004, "p99": 0.005},
+        "rounds": 40,
+        "range_ops": 10_000,
+        "journal": None,
+    }
+    if mode is not None:
+        extra["construction"] = {
+            "version": 1, "mode": mode, "construction_ms": ms,
+            "rss_after_construction_bytes": rss // 2,
+            "peak_rss_bytes": rss, "fleet_docs": 100,
+        }
+    data = [{"group": "serve", "trace": "mixed", "backend": "512",
+             "extra": extra}]
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_construction_matrix(tmp_path, capsys):
+    bc = _bench_compare()
+    stream = _artifact(tmp_path, "stream.json", mode="stream")
+    eager = _artifact(tmp_path, "eager.json", mode="eager", ms=20_000.0)
+    legacy = _artifact(tmp_path, "legacy.json")  # pre-block artifact
+    # same mode, same numbers: gated and green
+    assert bc.main([stream, stream]) == 0
+    out = capsys.readouterr().out
+    assert "construction time (ms)" in out and "peak RSS" in out
+    # regression beyond threshold fails the gate
+    slow = _artifact(tmp_path, "slow.json", mode="stream", ms=5_000.0,
+                     rss=2**31)
+    assert bc.main([slow, stream]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out
+    # improvement direction passes (lower is better)
+    assert bc.main([stream, slow]) == 0
+    # mode mismatch: BOTH directions skip-with-note, never a fail
+    for pair in ((stream, eager), (eager, stream)):
+        assert bc.main(list(pair)) == 0
+        out = capsys.readouterr().out
+        assert "incomparable by design" in out and "SKIP" in out
+    # block missing on one side: skip-with-note both directions (the
+    # one-sided presence matrix), never exit 2
+    for pair in ((stream, legacy), (legacy, stream)):
+        assert bc.main(list(pair)) == 0
+        out = capsys.readouterr().out
+        assert "SKIP" in out

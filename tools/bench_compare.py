@@ -148,10 +148,15 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: (``--serve-open`` / ``--serve-open-sweep``) — both-directions skip:
 #: an open-loop artifact diffed against a closed-loop baseline (or
 #: vice versa) is a family difference, never an error.
+#: ``construction`` is the streaming-fleet-construction block
+#: (construction_ms + RSS, both modes carry it) — artifacts written
+#: before the block existed skip-with-note one-sided, and a
+#: stream-vs-eager pair skips the numeric gates (mode mismatch), so
+#: both directions diff cleanly.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
                     "recovery", "residency", "fs_ops", "ingest",
-                    "knee")
+                    "knee", "construction")
 
 
 def _tier_hit_rate(extra: dict) -> float | None:
@@ -163,6 +168,64 @@ def _tier_hit_rate(extra: dict) -> float | None:
     if not isinstance(res, dict):
         return None
     return res.get("hit_rate")
+
+
+def _construction_mode(extra: dict) -> str | None:
+    """``"stream"`` / ``"eager"`` from the ``construction`` block;
+    None when the artifact predates it."""
+    c = extra.get("construction")
+    return c.get("mode") if isinstance(c, dict) else None
+
+
+def _construction_ms(extra: dict) -> float | None:
+    """Fleet setup wall time (spec/sessions -> pool -> streams ->
+    scheduler ready) in ms.  None when the artifact predates the
+    ``construction`` block."""
+    c = extra.get("construction")
+    return c.get("construction_ms") if isinstance(c, dict) else None
+
+
+def _construction_rss(extra: dict) -> float | None:
+    """Process peak RSS in bytes from the ``construction`` block —
+    the O(active-set)-vs-O(fleet) footprint number.  None when the
+    artifact predates the block."""
+    c = extra.get("construction")
+    return c.get("peak_rss_bytes") if isinstance(c, dict) else None
+
+
+def _construction_checks(new: dict, base: dict,
+                         max_construction_regress: float,
+                         max_rss_regress: float) -> list[Check]:
+    """The streaming-construction gates: setup wall time + peak RSS,
+    one-sided skip-with-note like timeseries — and skipped (with the
+    modes named) when one side built eagerly and the other streamed,
+    since O(fleet) vs O(active-set) numbers are incomparable by
+    design, not a regression."""
+    nm, bm = _construction_mode(new), _construction_mode(base)
+    if nm is not None and bm is not None and nm != bm:
+        note = (f"construction mode differs ({nm} vs {bm}): "
+                "O(active-set) and O(fleet) setup costs are "
+                "incomparable by design")
+        return [
+            Check("construction time (ms)", "skip", note=note),
+            Check("peak RSS (bytes)", "skip", note=note),
+        ]
+    return [
+        _regress(
+            "construction time (ms)",
+            _construction_ms(new), _construction_ms(base),
+            max_construction_regress, higher_is_better=False,
+            skip_note="construction block missing in at least one "
+                      "artifact",
+        ),
+        _regress(
+            "peak RSS (bytes)",
+            _construction_rss(new), _construction_rss(base),
+            max_rss_regress, higher_is_better=False,
+            skip_note="construction block missing in at least one "
+                      "artifact",
+        ),
+    ]
 
 
 def _recover_ms(extra: dict) -> float | None:
@@ -308,7 +371,9 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_slo_regress: float = 5.0,
             max_recover_regress: float = 75.0,
             max_journal_disk_regress: float = 40.0,
-            max_hit_rate_regress: float = 25.0) -> list[Check]:
+            max_hit_rate_regress: float = 25.0,
+            max_construction_regress: float = 60.0,
+            max_rss_regress: float = 40.0) -> list[Check]:
     # open-loop artifacts (--serve-open) invert what the headline
     # numbers mean: throughput TRACKS the offered load (the client
     # decides it, not the engine), so gating it is meaningless — the
@@ -413,6 +478,10 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
                       "artifact",
         ),
     ]
+    # streaming-construction gates: setup wall time + peak RSS (mode
+    # mismatch or a pre-block artifact skips-with-note, never errors)
+    checks.extend(_construction_checks(
+        new, base, max_construction_regress, max_rss_regress))
     checks.extend(_block_presence_checks(new, base))
     return checks
 
@@ -473,6 +542,17 @@ def main(argv: list[str] | None = None) -> int:
                          "footprint at fixed workload (segment GC + "
                          "snapshot pruning keep it O(ops since last "
                          "barrier); unbounded history fails here)")
+    ap.add_argument("--max-construction-regress", type=float,
+                    default=60.0, metavar="PCT",
+                    help="max tolerated fleet-construction wall-time "
+                         "increase (construction block; skipped on a "
+                         "stream-vs-eager mode mismatch — O(active-"
+                         "set) vs O(fleet) setup is incomparable)")
+    ap.add_argument("--max-rss-regress", type=float, default=40.0,
+                    metavar="PCT",
+                    help="max tolerated peak-RSS growth (construction "
+                         "block; same mode-mismatch skip as the "
+                         "construction-time gate)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -496,6 +576,8 @@ def main(argv: list[str] | None = None) -> int:
         max_recover_regress=args.max_recover_regress,
         max_journal_disk_regress=args.max_journal_disk_regress,
         max_hit_rate_regress=args.max_hit_rate_regress,
+        max_construction_regress=args.max_construction_regress,
+        max_rss_regress=args.max_rss_regress,
     )
     failed = [c for c in checks if c.status == "fail"]
     if args.json:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier|serve-open]   (repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier|serve-stream|serve-open]   (repo root)
 #
 # The serve family (the default) drains a tiny document fleet through the
 # macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
@@ -49,6 +49,22 @@
 # G021 cross-check green in both directions against the emitted fs_ops
 # block) and the exhaustive crash-point enumeration harness (a crash
 # at EVERY mutating fs-op boundary must recover byte-verified).
+#
+# The serve-stream family is the STREAMING-CONSTRUCTION smoke: the
+# same tiered fleet built LAZILY (--serve-stream: FleetSpec-derived
+# bands/arrivals/traces, docs born in the pool's genesis state,
+# first-admission tensorization on the prefetch thread), run
+# race-sanitized and gated by bench_compare against the committed
+# bench_results/serve_stream_baseline.json (construction_ms + peak
+# RSS + hit rate) and by G017 against the prefetch publish surface —
+# then an in-process eager-vs-lazy BYTE-PARITY leg (same seed, both
+# paths drained, every doc's decoded bytes and the oracle replay must
+# match exactly, mid-run evict/restore included).  The stream-vs-eager
+# construction gates must also diff skip-with-note in both directions
+# against the eager tier baseline (mode mismatch is a schema
+# difference, never an error).  Exits NONZERO on a verify failure, a
+# parity mismatch, a missing construction block, or an undeclared
+# cross-thread handoff.
 #
 # The serve-open family is the LIVE-INGEST smoke (serve/ingest/): the
 # fleet's ops arrive over a real loopback TCP front under an open-loop
@@ -684,6 +700,144 @@ print(f"tier smoke: {res['warm_hits']} warm hits "
       f"race sanitizer ({tc['publishes']['Prefetcher._publish']} entries)")
 PYEOF
     ;;
+  serve-stream)
+    # Streaming-construction smoke: the serve-tier recipe rebuilt
+    # LAZILY — 40 docs born in genesis on a 14-row hot budget with a
+    # 6-doc warm tier, bands/arrivals/traces derived from (seed,
+    # doc_id) at first admission, tensorization riding the prefetch
+    # thread's declared publish point — run RACE-SANITIZED so the new
+    # construct payload shape is proven thread-confined.  The explicit
+    # --serve-sample-seed exercises the auditable-verify knob (seed +
+    # picked doc ids land in the artifact).
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 40 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-tiers hot=14,warm=6 --serve-arrival-dist zipf \
+        --serve-arrival-span 4 --serve-verify-sample 6 \
+        --serve-stream --serve-sample-seed 5 \
+        --serve-save-name serve_stream_smoke
+    # Regression gate vs the committed streaming baseline (same
+    # recipe, same mode): construction time + peak RSS are the
+    # tentpole numbers; hit rate guards the genesis->prefetch path.
+    # Thresholds are loose — a 40-doc drain is compile-dominated and
+    # ms-scale construction jitters — but an eager build sneaking back
+    # into the lazy path fails the construction gate outright.
+    python tools/bench_compare.py \
+      bench_results/serve_stream_smoke.json \
+      bench_results/serve_stream_baseline.json \
+      --max-throughput-regress 40 --max-p99-regress 200 \
+      --max-hit-rate-regress 40 \
+      --max-construction-regress 150 --max-rss-regress 60
+    # Mode-mismatch contract, both directions: stream-vs-eager
+    # construction numbers are incomparable by design — the gates must
+    # SKIP with the modes named, never fail or error (the other
+    # thresholds are moot, the runs are different scales).
+    python tools/bench_compare.py \
+      bench_results/serve_stream_smoke.json \
+      bench_results/serve_tier_baseline.json \
+      --max-throughput-regress 100 --max-p99-regress 100000 \
+      --max-syncs-regress 100000 --max-drain-p999-regress 100000 \
+      --max-hit-rate-regress 100
+    python tools/bench_compare.py \
+      bench_results/serve_tier_baseline.json \
+      bench_results/serve_stream_smoke.json \
+      --max-throughput-regress 100 --max-p99-regress 100000 \
+      --max-syncs-regress 100000 --max-drain-p999-regress 100000 \
+      --max-hit-rate-regress 100
+    # G017 vs the streaming artifact: the construct payloads ride the
+    # same Prefetcher._publish surface — the cross-check proves the
+    # runtime counters still match the declared annotations.
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_stream_smoke.json
+    # Artifact contract: sampled verify green + auditable, the
+    # construction block present with the streaming counters, and the
+    # new payload shape attributed to the declared publish point.
+    python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_stream_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+assert x["verify_ok"], "stream smoke failed oracle byte-verify"
+c = x["construction"]
+assert c is not None, "construction block missing from the artifact"
+assert c["mode"] == "stream", c
+assert c["construction_ms"] > 0, c
+assert c["peak_rss_bytes"] > 0, c
+assert c["materialized_docs"] == c["fleet_docs"] == 40, c
+assert c["released_docs"] > 0, f"no drained stream was released: {c}"
+assert c["prefetch_built"] > 0, f"no stream tensorized off-drain: {c}"
+assert c["genesis_docs_end"] == 0, c
+# the auditable sampled verify: the explicit seed + the picked ids
+assert c["verify_sample_seed"] == 5, c
+assert x["verified_docs"], x["verified_docs"]
+res = x["residency"]
+assert res is not None and res["prefetch_submitted"] > 0, res
+tc = x["thread_crossings"]
+assert tc["sanitized"] and tc["prefetch"], tc
+assert tc["publishes"].get("Prefetcher._publish"), tc
+assert set(tc["crossings"] or {}) <= set(tc["publishes"]), tc
+g = x["metrics"]["gauges"]
+assert "serve.tier.genesis_docs" in g, sorted(g)
+print(f"stream smoke: construction {c['construction_ms']:.0f}ms "
+      f"(peak rss {c['peak_rss_bytes'] / 2**20:.0f} MiB), "
+      f"{c['prefetch_built']} streams tensorized off-drain / "
+      f"{c['released_docs']} released after drain, sampled verify "
+      f"green (seed {c['verify_sample_seed']}, docs {x['verified_docs']}), "
+      f"publish point proven under the race sanitizer "
+      f"({tc['publishes']['Prefetcher._publish']} entries)")
+PYEOF
+    # Eager-vs-lazy byte parity, in-process: SAME seed, both paths
+    # drained on a hot budget small enough to force mid-run
+    # evict/restore traffic; every doc's decoded bytes must match the
+    # eager fleet's AND the oracle replay.  This is the acceptance
+    # pin: the lazy derivation is byte-stable, not just statistically
+    # similar.
+    exec timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'PYEOF'
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import (
+    FleetScheduler, LazyStreams, prepare_streams,
+)
+from crdt_benches_tpu.serve.workload import FleetSpec, build_fleet
+from crdt_benches_tpu.oracle.text_oracle import replay_trace
+
+KW = dict(mix="mixed", seed=11, arrival_span=4, arrival_dist="zipf")
+CLASSES = (256, 1024, 4096, 8192, 49152)
+SLOTS = (6, 3, 2, 2, 2)  # tight: evict/restore churn by construction
+N = 24
+
+sessions = build_fleet(N, **KW)
+epool = DocPool(classes=CLASSES, slots=SLOTS, warm_docs=4)
+estreams = prepare_streams(sessions, epool, batch=16, batch_chars=64)
+esched = FleetScheduler(epool, estreams, batch=16, batch_chars=64)
+estats = esched.run()
+assert esched.done
+assert estats.evictions > 0, "hot budget too loose: no tier churn"
+
+spec = FleetSpec.build(N, **KW)
+lpool = DocPool(classes=CLASSES, slots=SLOTS, warm_docs=4)
+lstreams = LazyStreams(spec, lpool, batch=16, batch_chars=64)
+lsched = FleetScheduler(lpool, lstreams, batch=16, batch_chars=64)
+lstats = lsched.run()
+assert lsched.done
+assert lstats.patches == estats.patches, (lstats.patches, estats.patches)
+assert lstreams.materialized == N, lstreams.materialized
+
+mismatch = []
+for d in range(N):
+    want = replay_trace(sessions[d].trace)
+    eager, lazy = epool.decode(d), lpool.decode(d)
+    if not (eager == lazy == want):
+        mismatch.append(d)
+assert not mismatch, f"eager-vs-lazy byte mismatch on docs {mismatch}"
+epool.close(); lpool.close()
+print(f"parity: {N} docs byte-identical across eager/lazy/oracle "
+      f"({estats.patches} patches each; {estats.evictions} evictions "
+      f"exercised mid-run)")
+PYEOF
+    ;;
   serve-open)
     # Leg 1: the open-loop drain over the live wire — 24 docs, two
     # tenants (gold generously provisioned, free budget-capped so the
@@ -825,7 +979,7 @@ print(f"open chaos: churn dropped {ing['front']['churn_drops']} conns, "
 PYEOF
     ;;
   *)
-    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier, serve-open)" >&2
+    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier, serve-stream, serve-open)" >&2
     exit 2
     ;;
 esac
