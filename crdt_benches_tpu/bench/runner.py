@@ -677,6 +677,7 @@ def run_serve(args) -> int:
             ("--serve-longhaul", args.serve_longhaul > 0),
             ("--serve-recover", args.serve_recover),
             ("--serve-crash-round", args.serve_crash_round > 0),
+            ("--serve-reshard", args.serve_reshard is not None),
             ("--serve-mesh", args.serve_mesh > 1),
             ("--serve-tiers", args.serve_tiers is not None),
             ("--serve-queue-cap", args.serve_queue_cap > 0),
@@ -744,6 +745,7 @@ def run_serve(args) -> int:
             ("--serve-longhaul", args.serve_longhaul > 0),
             ("--serve-recover", args.serve_recover),
             ("--serve-crash-round", args.serve_crash_round > 0),
+            ("--serve-reshard", args.serve_reshard is not None),
             ("--serve-mesh", args.serve_mesh > 1),
             ("--serve-tiers", args.serve_tiers is not None),
             ("--serve-stream", args.serve_stream),
@@ -817,6 +819,7 @@ def run_serve(args) -> int:
         longhaul=args.serve_longhaul,
         measure_recovery=args.serve_recover,
         crash_after=args.serve_crash_round,
+        reshard_spec=args.serve_reshard,
         faults=args.serve_faults,
         queue_cap=args.serve_queue_cap,
         overflow_policy=args.serve_overflow_policy,
@@ -980,6 +983,18 @@ def run_serve(args) -> int:
             f"WAL {rec['journal_disk_bytes']} B on disk, "
             f"verify {'ok' if rec['verify_ok'] else 'FAILED'}"
         )
+    if r.extra.get("reshard") is not None:
+        rs = r.extra["reshard"]
+        mid = rs["mid_latency"]
+        print(
+            f"  reshard: {rs['kind']} {rs['shards']} {rs['state']} "
+            f"(rounds {rs['begin_round']}..{rs['commit_round']}); "
+            f"{rs['migrated']} row moves + {rs['evicted']} demotions, "
+            f"{rs['deferred_lanes']} lanes / {rs['deferred_ops']} ops "
+            f"deferred, {rs['resumes']} crash resumes"
+            + (f"; mid-reshard round p99 {mid['p99'] * 1e3:.1f}ms"
+               if mid else "")
+        )
     if r.extra.get("anomalies") is not None:
         a = r.extra["anomalies"]
         print(
@@ -1082,6 +1097,14 @@ def main(argv=None) -> int:
                     help="seeded chaos drain: serve/faults.py spec, e.g. "
                          "'seed=7,span=8,spool_corrupt=1,device_loss=1,"
                          "queue_overflow=1,dup_batch=1,stall=1'")
+    ap.add_argument("--serve-reshard", default=None, metavar="SPEC",
+                    help="live shard-map change mid-drain "
+                         "(serve/reshard.py): 'shrink:FROM:TO[@R]', "
+                         "'grow:FROM:TO[@R]' or 'drain:S[,of=N]'; "
+                         "options batch=N (doc moves per round), "
+                         "imbalance=X (PR 7 gauge trigger).  Requires "
+                         "--serve-journal; its own bench family "
+                         "serve/reshard/<mix>/<fleet>")
     ap.add_argument("--serve-queue-cap", type=int, default=0,
                     help="bound each doc's pending op queue (0 = "
                          "unbounded legacy behavior; overflow past the "

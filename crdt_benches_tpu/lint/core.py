@@ -62,6 +62,9 @@ accounting against serve bench artifacts:
 - ``fence=flight`` — crosses only when the flight recorder DUMPED
   (``boundary_syncs.flight``); even an armed recorder on a clean
   chaos run never enters it, so chaos-scoping would false-positive;
+- ``fence=reshard`` — crosses only with a live-reshard coordinator
+  bound (``boundary_syncs.reshard``); the per-round tick and the
+  end-of-drain finalize are the two declared boundaries;
 - ``fence=cold`` — an off-drain API boundary (direct pool calls from
   tests/tools): still a G002 barrier, never dead-fence accounted.
 """
@@ -123,7 +126,7 @@ _MARKER_RE = re.compile(
 )
 
 #: Recognized ``fence=<tag>`` spellings (see module docstring).
-FENCE_TAGS = ("chaos", "journal", "flight", "cold")
+FENCE_TAGS = ("chaos", "journal", "flight", "reshard", "cold")
 
 
 def dotted(e: ast.expr) -> str | None:

@@ -56,7 +56,8 @@ from contextlib import contextmanager
 _ENV = "CRDT_BENCH_SANITIZE_FS"
 
 #: The protocol vocabulary (the static rules reject any other tag).
-KNOWN_PROTOCOLS = ("snapshot", "gc", "wal", "spool", "flight")
+KNOWN_PROTOCOLS = ("snapshot", "gc", "wal", "spool", "flight",
+                   "reshard")
 
 #: Ops that change the filesystem — the crash-point boundaries.
 #: ``update`` is an ``r+``-mode open (the WAL torn-tail truncate

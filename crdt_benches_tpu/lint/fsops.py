@@ -69,18 +69,21 @@ from .core import Finding, FuncInfo, PackageIndex, dotted
 from .threads import load_artifact_block
 
 #: The declared-protocol vocabulary (shared with the runtime twin).
-KNOWN_PROTOCOLS = ("snapshot", "gc", "wal", "spool", "flight")
+KNOWN_PROTOCOLS = ("snapshot", "gc", "wal", "spool", "flight",
+                   "reshard")
 
 #: Armed-surface scoping for the G021 dead-protocol accounting: a tag
 #: is only dead-checked against artifacts whose run armed its surface
 #: (``journal`` = the WAL + barriers ran; ``spool`` = the pool actually
-#: spooled; ``flight`` = a dump fired this drain).
+#: spooled; ``flight`` = a dump fired this drain; ``reshard`` = a live
+#: shard-map change committed its migration manifest).
 PROTOCOL_SURFACES = {
     "snapshot": "journal",
     "gc": "journal",
     "wal": "journal",
     "spool": "spool",
     "flight": "flight",
+    "reshard": "reshard",
 }
 
 _COMMIT_OPS = ("replace", "rename")

@@ -678,8 +678,9 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
     the real one); a runtime counter with no matching ``# graftlint:
     fence`` marker is an UNATTRIBUTED sync boundary the static model
     does not know about.  ``fence=chaos`` / ``fence=journal`` /
-    ``fence=flight`` fences are accounted only against artifacts whose
-    run had faults / a journal / a flight-recorder dump;
+    ``fence=flight`` / ``fence=reshard`` fences are accounted only
+    against artifacts whose run had faults / a journal / a
+    flight-recorder dump / a live-reshard coordinator;
     ``fence=cold`` fences (off-drain APIs) are never dead-checked."""
     block, err = _load_boundary_syncs(artifact_path)
     if block is None:
@@ -690,6 +691,7 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
     chaos = bool(block.get("chaos"))
     journal = bool(block.get("journal"))
     flight = bool(block.get("flight"))
+    reshard = bool(block.get("reshard"))
     out = []
     fences = {
         fi.qualname: fi
@@ -704,6 +706,8 @@ def g011_fence_cost(index: PackageIndex, artifact_path: str
         if tag == "journal" and not journal:
             continue
         if tag == "flight" and not flight:
+            continue
+        if tag == "reshard" and not reshard:
             continue
         if not entries.get(qual):
             out.append(Finding(

@@ -355,6 +355,15 @@ class ServeTelemetry:  # graftlint: thread=hot
         if self.status is not None:
             self.status.publish_status({"phase": phase})
 
+    def publish_metrics_now(self) -> None:
+        """Out-of-window registry publish for rare operator-visible
+        state transitions (a reshard begin/resume/commit).  The normal
+        cadence publishes only at window closes — a migration that
+        begins AND commits inside one window would never render on
+        /metrics while in flight without this."""
+        if self.status is not None and self.registry is not None:
+            self.status.publish_metrics(self.registry.to_dict())
+
     # -- drain end (driver side, off the hot path) --
 
     def drain_end(self, status: dict | None = None) -> None:

@@ -62,6 +62,7 @@ from .faults import (
     INGEST_KINDS,
     JOURNAL_KINDS,
     REPLICATION_KINDS,
+    RESHARD_KINDS,
     TIER_KINDS,
     FaultInjector,
     FaultPlan,
@@ -84,6 +85,11 @@ from .ingest.loadgen import (
 from .construction import current_rss_bytes, peak_rss_bytes
 from .journal import DEFAULT_SEGMENT_BYTES, OpJournal, recover_fleet
 from .pool import DocPool
+from .reshard import (
+    ReshardCoordinator,
+    check_shard_partition,
+    parse_reshard_spec,
+)
 from .scheduler import FleetScheduler, LazyStreams, prepare_streams
 from .workload import FleetSpec, build_fleet
 
@@ -280,6 +286,7 @@ def run_serve_bench(
     longhaul: int = 0,
     measure_recovery: bool = False,
     crash_after: int = 0,
+    reshard_spec: str | None = None,
     open_spec: str | None = None,
     tenants_spec: str | None = None,
     deadline: bool = False,
@@ -442,8 +449,33 @@ def run_serve_bench(
                 "--serve-stream is single-host for now (lazy "
                 "materialization feeds one scheduler)"
             )
-    mix_label = f"longhaul/{mix_name}" if longhaul else (
-        f"tier/{mix_name}" if warm_docs
+    # elastic reconfiguration (--serve-reshard): a live shard-map change
+    # mid-drain — its own bench-id family serve/reshard/<mix>/<fleet>.
+    # The coordinator journals every migration decision, so the WAL is
+    # mandatory; the other families pin their own topology assumptions.
+    rplan = parse_reshard_spec(reshard_spec) if reshard_spec else None
+    if rplan is not None:
+        if not journal_dir:
+            raise ValueError(
+                "--serve-reshard journals every migration decision "
+                "(the RESHARD_MANIFEST commit point lives in the "
+                "journal dir): --serve-journal is required"
+            )
+        if longhaul or warm_docs or open_spec or stream:
+            raise ValueError(
+                "--serve-reshard is its own bench family "
+                "(serve/reshard/*); --serve-longhaul / --serve-tiers / "
+                "--serve-open / --serve-stream do not compose with it"
+            )
+        if mesh_devices <= 1 and rplan.n_shards < 2:
+            raise ValueError(
+                f"reshard spec {reshard_spec!r} does not determine a "
+                "shard count: pass --serve-mesh, or drain:S,of=N for "
+                "single-host logical sharding"
+            )
+    mix_label = f"reshard/{mix_name}" if rplan is not None else (
+        f"longhaul/{mix_name}" if longhaul
+        else f"tier/{mix_name}" if warm_docs
         else f"open/{mix_name}" if open_rate else mix_name
     )
 
@@ -478,6 +510,16 @@ def run_serve_bench(
                 f"fault kinds {ingest_kinds} target the live ingest "
                 "front: --serve-open is required — a closed-loop "
                 "replay never polls them"
+            )
+        reshard_kinds = sorted({
+            e.kind for e in plan.events if e.kind in RESHARD_KINDS
+        })
+        if reshard_kinds and rplan is None:
+            raise ValueError(
+                f"fault kinds {reshard_kinds} kill the live-reshard "
+                "coordinator between its manifest commit and the "
+                "per-doc moves: --serve-reshard is required — a fixed "
+                "shard map never reaches the injection point"
             )
         if queue_cap <= 0 and any(
             e.kind == "queue_overflow" for e in plan.events
@@ -522,7 +564,8 @@ def run_serve_bench(
     slo = parse_slo(slo_spec)
 
     default_name = (
-        f"serve_longhaul_{mix_name}_{n_docs}" if longhaul
+        f"serve_reshard_{mix_name}_{n_docs}" if rplan is not None
+        else f"serve_longhaul_{mix_name}_{n_docs}" if longhaul
         else f"serve_tier_{mix_name}_{n_docs}" if warm_docs
         else f"serve_open_{mix_name}_{n_docs}" if open_rate
         else f"serve_{mix_name}_{n_docs}"
@@ -607,9 +650,16 @@ def run_serve_bench(
                 bands=bands, delivery=delivery, horizon=max(1, longhaul),
                 arrival_dist=arrival_dist,
             )
+        # single-host reshard runs shard the pool LOGICALLY (shards=)
+        # so the live map has something to change; with a mesh the
+        # device count is the shard count and the coordinator validates
+        # the spec against it
+        pool_shards = None
+        if rplan is not None and mesh is None:
+            pool_shards = rplan.n_shards
         pool = DocPool(classes=classes, slots=slots, mesh=mesh,
                        spool_dir=spool_dir, serve_kernel=serve_kernel,
-                       warm_docs=warm_docs)
+                       warm_docs=warm_docs, shards=pool_shards)
         fs_sanitizer.watch_root(pool.spool_dir)
         if warm_docs:
             log(
@@ -652,11 +702,27 @@ def run_serve_bench(
 
         profiler = DeviceProfiler(profile_rounds) \
             if profile_rounds > 0 else None
+        injector = FaultInjector(plan) if plan else None
+        reshard_coord = None
+        if rplan is not None:
+            reshard_coord = ReshardCoordinator(
+                pool, journal, rplan, faults=injector,
+                telemetry=telemetry,
+            )
+            log(
+                f"serve: reshard ARMED — {rplan.kind} shards "
+                f"{list(reshard_coord._shards)} of {pool.n_sh} "
+                f"(batch {rplan.batch}/round; trigger "
+                + (f"round {rplan.at_round}" if rplan.at_round is not None
+                   else f"imbalance > {rplan.imbalance:g}"
+                   if rplan.imbalance is not None else "round 2")
+                + ")"
+            )
         sched_kw = dict(
             batch=batch, macro_k=macro_k,
             batch_chars=batch_chars,
             queue_cap=queue_cap, overflow_policy=overflow_policy,
-            faults=FaultInjector(plan) if plan else None,
+            faults=injector, reshard=reshard_coord,
             journal=journal, snapshot_every=snapshot_every,
             snapshot_keep=snapshot_keep,
             snapshot_full_every=snapshot_full_every,
@@ -888,6 +954,30 @@ def run_serve_bench(
                 f"degraded rounds {stats.degraded_rounds}, "
                 f"snapshots {stats.snapshots}"
             )
+        partition_errors: list[str] = []
+        if reshard_coord is not None:
+            rs = reshard_coord.summary()
+            mid = rs["mid_latency"]
+            log(
+                f"serve: reshard — {rs['kind']} {rs['shards']} "
+                f"{rs['state']} (begin r{rs['begin_round']} commit "
+                f"r{rs['commit_round']}, {rs['rounds_active']} rounds); "
+                f"{rs['migrated']} row moves + {rs['evicted']} "
+                f"demotions, {rs['deferred_lanes']} lanes deferred "
+                f"({rs['deferred_ops']} ops), {rs['resumes']} resumes; "
+                f"live shards {rs['live_shards']}/{pool.n_sh}"
+                + (f"; mid-reshard round p99 {mid['p99'] * 1e3:.1f}ms"
+                   if mid else "")
+            )
+            if not crashed:
+                # the partition invariant — every doc on exactly one
+                # shard, none on a retired one — gates the run like the
+                # oracle does; fscrash.py checks it at every crash
+                # point, this checks the live end state
+                partition_errors = check_shard_partition(pool)
+                if partition_errors:
+                    log("serve: SHARD PARTITION VIOLATED — "
+                        + "; ".join(partition_errors[:8]))
 
         # ---- per-class byte verification against the oracle ----
         # docs whose ops were shed by an EXPLICIT decision (overflow shed /
@@ -945,7 +1035,8 @@ def run_serve_bench(
             # lossy (mass shed/quarantine) there is nothing left to
             # verify, and a vacuous green would let the chaos smoke
             # pass while checking nothing
-            verify_ok = not failures and bool(sample)
+            verify_ok = not failures and bool(sample) \
+                and not partition_errors
             log(
                 f"serve: verified {len(sample)} docs across classes "
                 f"{used_classes}: "
@@ -969,7 +1060,7 @@ def run_serve_bench(
                 telemetry.note_phase("recovering")
             rpool = DocPool(classes=classes, slots=slots,
                             serve_kernel=serve_kernel,
-                            warm_docs=warm_docs)
+                            warm_docs=warm_docs, shards=pool_shards)
             rstreams = prepare_streams(
                 sessions, rpool, batch=batch, batch_chars=batch_chars
             )
@@ -998,7 +1089,13 @@ def run_serve_bench(
                 d for d in rsample
                 if rpool.decode(d) != replay_trace(session_of[d].trace)
             ]
-            recovered_ok = not rfail and bool(rsample)
+            rpartition = check_shard_partition(rpool) \
+                if rplan is not None else []
+            if rpartition:
+                log("serve: recovered fleet SHARD PARTITION VIOLATED — "
+                    + "; ".join(rpartition[:8]))
+            recovered_ok = not rfail and bool(rsample) \
+                and not rpartition
             wal_disk = journal.on_disk_bytes()
             recovery_block = {
                 "version": 1,
@@ -1019,6 +1116,13 @@ def run_serve_bench(
                 "journal_disk_bytes": wal_disk,
                 "verified_docs": len(rsample),
                 "verify_ok": recovered_ok,
+                # reshard recovery (zeros when no reshard ran): shards
+                # the recovered fleet re-retired from journal commit
+                # records / a torn manifest, docs moved off them, and
+                # whether a torn reshard was rolled forward to done
+                "reshard_retired": rep.reshard_retired,
+                "reshard_docs_moved": rep.reshard_docs_moved,
+                "reshard_completed": rep.reshard_completed,
             }
             log(
                 f"serve: recovery — {recover_ms:.1f}ms to restore "
@@ -1113,6 +1217,10 @@ def run_serve_bench(
                 telemetry is not None and telemetry.flight is not None
                 and telemetry.flight.dumps > flight_dumps_at_start
             ),
+            # fence=reshard fences (the coordinator's per-round tick +
+            # its end-of-drain finalize) cross on every armed run —
+            # G011 dead-checks them only against reshard artifacts
+            "reshard": reshard_coord is not None,
             "entries": sync_counts["entries"],
             "syncs": sync_counts["syncs"] if sanitized else None,
         }
@@ -1176,6 +1284,13 @@ def run_serve_bench(
             "spool": (stats.evictions + stats.restores
                       + pool.warm_evictions) > 0,
             "flight": boundary_syncs["flight"],
+            # the reshard surface arms when the coordinator actually
+            # committed a manifest (state left "idle") — an armed-but-
+            # untriggered reshard never enters the protocol
+            "reshard": (
+                reshard_coord is not None
+                and reshard_coord.state != "idle"
+            ),
             "protocols": fs_counts["protocols"],
             "ops": fs_counts["ops"] if fs_sanitized else None,
             "unattributed": (
@@ -1343,6 +1458,18 @@ def run_serve_bench(
                 # recovery leg ran): recover_ms + redo-span +
                 # chain-depth breakdown, gated by bench_compare
                 "recovery": recovery_block,
+                # elastic reconfiguration (None unless --serve-reshard
+                # armed): the coordinator's full ledger — move/demote
+                # counts, deferred lanes/ops, crash resumes, and the
+                # mid-reshard round-latency quantiles bench_compare
+                # gates (one-sided skip-with-note, like recovery)
+                "reshard": (
+                    None if reshard_coord is None
+                    else {
+                        **reshard_coord.summary(),
+                        "partition_errors": partition_errors,
+                    }
+                ),
                 # live ingest (None unless --serve-open armed): wire +
                 # admission + deadline ground truth — offered load,
                 # front/session counters, per-tenant admit/defer/shed,

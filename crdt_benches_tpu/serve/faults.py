@@ -75,6 +75,12 @@ Spec grammar (comma-separated ``key=value`` tokens)::
                      the flooder while other tenants keep admitting —
                      recovery is the flood window closing with the
                      pressure absorbed
+  ``reshard_crash``  kill the reshard coordinator at its worst window:
+                     AFTER the migration-manifest commit, BEFORE the
+                     first per-doc move (reshard runs only): the next
+                     round's tick (or ``recover_fleet``'s roll-forward)
+                     must complete the reshard from the manifest alone
+                     — recovery is the resumed coordinator committing
   =================  ======================================================
 
 Every event records whether it fired and whether the engine recovered
@@ -107,6 +113,7 @@ KINDS = (
     "prefetch_miss",
     "conn_churn",
     "tenant_flood",
+    "reshard_crash",
 )
 
 #: Kinds that need the write-ahead journal armed (``--serve-journal``):
@@ -135,6 +142,14 @@ TIER_KINDS = ("tier_evict_pressure", "prefetch_miss")
 #: them without the open-loop family up front instead of ending in a
 #: confusing not_fired chaos-gate failure.
 INGEST_KINDS = ("conn_churn", "tenant_flood")
+
+#: Kinds only the reshard coordinator polls (``--serve-reshard``): they
+#: target the live-migration state machine — a static-topology drain
+#: never reaches the injection point, so ``run_serve_bench`` rejects a
+#: spec that arms them without a reshard up front instead of ending in
+#: a confusing not_fired chaos-gate failure.  (The reshard itself also
+#: requires the journal: the manifest lives in the journal dir.)
+RESHARD_KINDS = ("reshard_crash",)
 
 
 @dataclass
@@ -296,6 +311,13 @@ class FaultInjector:
 
     def overflow_event(self, rnd: int) -> FaultEvent | None:
         return self._pending(rnd, "queue_overflow")
+
+    def reshard_crash_event(self, rnd: int) -> FaultEvent | None:
+        """Polled by the reshard coordinator exactly once per reshard,
+        in the window between the committed migration manifest and the
+        first per-doc move — the worst crash point the recovery
+        protocol must absorb."""
+        return self._pending(rnd, "reshard_crash")
 
     def dup_event(self, rnd: int, doc_id: int,
                   cursor: int) -> FaultEvent | None:

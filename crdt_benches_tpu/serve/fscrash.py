@@ -3,8 +3,9 @@
 The headline capability of graftlint v4's runtime twin: drive a small
 real fleet through EVERY declared durable protocol — WAL appends +
 segment seals, delta/full snapshot barriers with hard-linked spool
-members, crash-safe segment GC, spool evict/rehydrate churn, and a
-flight-recorder dump — under ``lint/fs_sanitizer.py`` interposition,
+members, crash-safe segment GC, spool evict/rehydrate churn, a live
+reshard (manifest commit + journaled moves + read-witnessed retire),
+and a flight-recorder dump — under ``lint/fs_sanitizer.py`` interposition,
 record the complete mutating-op sequence, then re-run the whole
 workload once per op with an :class:`InjectedCrash` at exactly that
 boundary and require **byte-verified recovery** at every single
@@ -19,7 +20,11 @@ ordering in the stack were wrong — an unlink before its install, a
 rename whose directory entry a recovery depends on, a torn GC pass —
 some boundary in the enumeration would recover to the wrong bytes or
 not at all.  The per-protocol point counts are asserted NONZERO so the
-harness can never silently cover nothing.
+harness can never silently cover nothing.  The fleet is sharded
+(``shards=2``) with a ``drain:1`` reshard armed, so every boundary
+also proves the shard-partition invariant: after recovery each doc
+exists on exactly one non-retired shard
+(:func:`serve.reshard.check_shard_partition`).
 
 Runs as a tier-1 test (tests/test_fs_sanitizer.py) and as the
 ``serve-longhaul`` smoke's fs leg::
@@ -39,6 +44,11 @@ from ..obs.flight import FlightRecorder
 from ..oracle.text_oracle import replay_trace
 from .journal import OpJournal, recover_fleet
 from .pool import DocPool
+from .reshard import (
+    ReshardCoordinator,
+    check_shard_partition,
+    parse_reshard_spec,
+)
 from .scheduler import FleetScheduler, prepare_streams
 from .workload import build_fleet
 
@@ -57,7 +67,9 @@ _MIX = {"synth-small": 0.7, "synth-medium": 0.3}
 _SMALL_BANDS = {"synth-small": ("synth", (8, 36))}
 _SMALL_MIX = {"synth-small": 1.0}
 _CLASSES = (256, 1024)
-_SLOTS = (2, 1)
+_SLOTS = (2, 2)  # % _SHARDS == 0: one row of each class per shard
+_SHARDS = 2
+_RESHARD = "drain:1@0,of=2,batch=2"  # begins on the first round
 _DOCS = 5
 _SEED = 11
 _BATCH = 16
@@ -85,13 +97,17 @@ def _drain(base: str, small: bool = False) -> None:
     fs_sanitizer.watch_root(sp)
     fs_sanitizer.watch_root(fl)
     sessions = _sessions(small)
-    pool = DocPool(classes=_CLASSES, slots=_SLOTS, spool_dir=sp)
+    pool = DocPool(classes=_CLASSES, slots=_SLOTS, spool_dir=sp,
+                   shards=_SHARDS)
     streams = prepare_streams(sessions, pool, batch=_BATCH,
                               batch_chars=_CHARS)
     journal = OpJournal(jd, segment_bytes=128 if small else 192)
+    reshard = ReshardCoordinator(
+        pool, journal, parse_reshard_spec(_RESHARD)
+    )
     sched = FleetScheduler(
         pool, streams, batch=_BATCH, macro_k=_MACRO_K,
-        batch_chars=_CHARS, journal=journal,
+        batch_chars=_CHARS, journal=journal, reshard=reshard,
         snapshot_every=2, snapshot_full_every=2,
     )
     try:
@@ -110,14 +126,32 @@ def _recover_and_verify(base: str, small: bool = False) -> None:
     jd = os.path.join(base, "journal")
     sessions = _sessions(small)
     pool = DocPool(classes=_CLASSES, slots=_SLOTS,
-                   spool_dir=os.path.join(base, "spool_recover"))
+                   spool_dir=os.path.join(base, "spool_recover"),
+                   shards=_SHARDS)
     streams = prepare_streams(sessions, pool, batch=_BATCH,
                               batch_chars=_CHARS)
     rep = recover_fleet(pool, streams, jd)
+    # the shard-partition invariant holds at EVERY crash boundary: the
+    # recovered map has each doc on exactly one shard, none on a
+    # retired one — whether the crash tore the reshard (rolled
+    # forward), preceded it (rolled back) or followed its commit
+    problems = check_shard_partition(pool)
+    if problems:
+        raise AssertionError(
+            "post-recovery shard partition violated (reshard "
+            f"{'completed' if rep.reshard_completed else 'torn/absent'},"
+            f" retired {rep.reshard_retired}): " + "; ".join(problems)
+        )
     FleetScheduler(
         pool, streams, batch=_BATCH, macro_k=_MACRO_K,
         batch_chars=_CHARS, start_round=rep.resume_round,
     ).run()
+    problems = check_shard_partition(pool)
+    if problems:
+        raise AssertionError(
+            "post-resume shard partition violated: "
+            + "; ".join(problems)
+        )
     for s in sessions:
         got = pool.decode(s.doc_id)
         want = replay_trace(s.trace)

@@ -52,6 +52,7 @@ __all__ = [
     "OpenLoadPlan",
     "build_open_plan",
     "OpenLoadClient",
+    "RetryBudgetExceeded",
     "IngestPump",
     "drive_open_loop",
 ]
@@ -204,6 +205,55 @@ def build_open_plan(streams, *, rate: float, process: str = "poisson",
                         total_ops=total, horizon=horizon)
 
 
+class RetryBudgetExceeded(RuntimeError):
+    """A session burned its whole retry budget without progress — the
+    front is unreachable (dead listener) or permanently refusing.  The
+    typed error carries enough to act on: silent ``errors`` counters
+    made a dead listener look like load-shedding."""
+
+    def __init__(self, session: str, doc: int, attempts: int,
+                 elapsed_s: float, last_error: str):
+        super().__init__(
+            f"session {session} (doc {doc}): retry budget exhausted "
+            f"after {attempts} attempts over {elapsed_s:.2f}s "
+            f"(last error: {last_error})"
+        )
+        self.session = session
+        self.doc = doc
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.last_error = last_error
+
+
+class _Backoff:
+    """Capped exponential backoff with seeded jitter and a TOTAL retry
+    budget.  ``sleep()`` returns False once the budget is spent —
+    progress (an acked frame) resets the exponent, never the budget,
+    so a flapping front still terminates."""
+
+    def __init__(self, rng, *, base: float, cap: float, budget: int):
+        self.rng = rng
+        self.base = float(base)
+        self.cap = float(cap)
+        self.budget = int(budget)
+        self.attempts = 0  # total, never reset
+        self._streak = 0  # consecutive failures, reset on progress
+
+    def sleep(self) -> bool:
+        self.attempts += 1
+        if self.attempts > self.budget:
+            return False
+        delay = min(self.cap, self.base * (2.0 ** self._streak))
+        self._streak += 1
+        # full jitter (seeded): uniform over (0.5, 1.0] * delay keeps
+        # the expected wait near delay while decorrelating shards
+        time.sleep(delay * (0.5 + 0.5 * float(self.rng.random())))
+        return True
+
+    def progress(self) -> None:
+        self._streak = 0
+
+
 class OpenLoadClient:
     """Sharded wire clients replaying an :class:`OpenLoadPlan` against
     a live front.
@@ -216,18 +266,38 @@ class OpenLoadClient:
     delivery is idempotent downstream, so redelivery is safe.  Shard
     results cross back through a plain results queue read only after
     the shards finish.
+
+    Every retry path — connect refusals, socket drops, ``retry``
+    backpressure — shares one per-session :class:`_Backoff`: capped
+    exponential delays with seeded jitter and a total budget of
+    ``retry_budget`` attempts.  A session that exhausts the budget
+    raises :class:`RetryBudgetExceeded`; ``join()`` re-raises the
+    first such failure on the driver thread.
     """
 
-    MAX_RECONNECTS = 20
+    RETRY_BASE_S = 0.005
+    RETRY_CAP_S = 0.25
+    RETRY_BUDGET = 128
 
     def __init__(self, port: int, plan: OpenLoadPlan, *, shards: int = 2,
-                 connect_timeout: float = 10.0):
+                 connect_timeout: float = 10.0, seed: int | None = None,
+                 retry_base: float | None = None,
+                 retry_cap: float | None = None,
+                 retry_budget: int | None = None):
         self.port = int(port)
         self.plan = plan
         self.shards = max(1, min(int(shards), len(plan.sessions) or 1))
         self.connect_timeout = float(connect_timeout)
+        self.seed = int(plan.seed if seed is None else seed)
+        self.retry_base = float(self.RETRY_BASE_S if retry_base is None
+                                else retry_base)
+        self.retry_cap = float(self.RETRY_CAP_S if retry_cap is None
+                               else retry_cap)
+        self.retry_budget = int(self.RETRY_BUDGET if retry_budget is None
+                                else retry_budget)
         self._threads: list[threading.Thread] = []
         self._done_q: queue.Queue = queue.Queue()
+        self._failures: queue.Queue = queue.Queue()
         # aggregated by join() after every shard reported
         self.sent_frames = 0
         self.retries = 0
@@ -262,6 +332,10 @@ class OpenLoadClient:
             self.retries += retries
             self.reconnects += reconnects
             self.errors += errors
+        try:
+            raise self._failures.get_nowait()
+        except queue.Empty:
+            pass
 
     def to_dict(self) -> dict:
         return {
@@ -270,6 +344,7 @@ class OpenLoadClient:
             "retries": self.retries,
             "reconnects": self.reconnects,
             "errors": self.errors,
+            "retry_budget": self.retry_budget,
         }
 
     # ---- the load threads ----
@@ -278,7 +353,15 @@ class OpenLoadClient:
         sent = retries = reconnects = errors = 0
         try:
             for sess in self.plan.sessions[shard::self.shards]:
-                s, r, rc, e = self._run_session(sess)
+                try:
+                    s, r, rc, e = self._run_session(sess)
+                except RetryBudgetExceeded as exc:
+                    # surface the TYPED failure to join() instead of
+                    # burying it in a counter; remaining sessions on
+                    # this shard are abandoned (the front is dead)
+                    self._failures.put(exc)
+                    errors += 1
+                    break
                 sent += s
                 retries += r
                 reconnects += rc
@@ -292,16 +375,29 @@ class OpenLoadClient:
         seq = 0
         idx = 0
         resume = False
-        attempts = 0
+        t0 = time.perf_counter()
+        # one backoff per session, seeded from (client seed, doc): the
+        # jitter sequence is deterministic given the plan, and distinct
+        # sessions never sleep in lockstep
+        bo = _Backoff(
+            np.random.default_rng((self.seed << 20) ^ (sess.doc + 1)),
+            base=self.retry_base, cap=self.retry_cap,
+            budget=self.retry_budget,
+        )
+
+        def _spend(last: str) -> None:
+            if not bo.sleep():
+                raise RetryBudgetExceeded(
+                    sess.session, sess.doc, bo.attempts - 1,
+                    time.perf_counter() - t0, last,
+                )
+
         while idx < len(sess.frames) or not resume:
             try:
                 sk = socket.create_connection(
                     ("127.0.0.1", self.port), timeout=self.connect_timeout)
-            except OSError:
-                attempts += 1
-                if attempts > self.MAX_RECONNECTS:
-                    return sent, retries, reconnects, 1
-                time.sleep(0.01)
+            except OSError as e:
+                _spend(f"connect: {e}")
                 continue
             try:
                 f = sk.makefile("rwb")
@@ -327,9 +423,10 @@ class OpenLoadClient:
                         seq += 1
                         idx += 1
                         sent += 1
+                        bo.progress()
                     elif t == "retry":
                         retries += 1
-                        time.sleep(0.002)
+                        _spend("pump backpressure (retry)")
                     elif t == "churn":
                         raise _Churned()
                     else:
@@ -339,13 +436,10 @@ class OpenLoadClient:
             except _Churned:
                 reconnects += 1
                 resume = True
-            except (OSError, ValueError):
-                attempts += 1
-                if attempts > self.MAX_RECONNECTS:
-                    return sent, retries, reconnects, 1
+            except (OSError, ValueError) as e:
                 reconnects += 1
                 resume = True
-                time.sleep(0.01)
+                _spend(f"{type(e).__name__}: {e}")
             finally:
                 try:
                     sk.close()

@@ -1220,6 +1220,12 @@ class RecoveryReport:
     chain_fallbacks: int = 0  # damaged candidates skipped on the way down
     gc_segments_completed: int = 0  # torn GC finished by this recovery
     staging_removed: int = 0  # abandoned snap_*.tmp dirs swept
+    # elastic reconfiguration (serve/reshard.py): shard-map changes
+    # re-applied from journal commit records, plus any torn reshard
+    # whose committed manifest this recovery rolled FORWARD
+    reshard_retired: list[int] = field(default_factory=list)
+    reshard_docs_moved: int = 0  # restored residents demoted off them
+    reshard_completed: bool = False  # a torn manifest was resolved
 
 
 @durable_protocol("snapshot")
@@ -1304,6 +1310,19 @@ def recover_fleet(pool, streams, journal_dir: str) -> RecoveryReport:  # graftli
         report.ops_replayed += max(
             0, min(hw, st.n_total) - st.cursor
         )
+
+    # ---- elastic shard map: committed reshards are settled history
+    # (their shards re-retire, restored residents from OLDER snapshots
+    # are demoted off them), and a torn reshard — committed manifest,
+    # no commit record — rolls FORWARD deterministically.  AFTER the
+    # snapshot restore: _restore_snapshot places docs by the OLD map.
+    from .reshard import recover_torn_reshard
+
+    rs = recover_torn_reshard(pool, journal_dir, records)
+    report.reshard_retired = rs["retired"]
+    report.reshard_docs_moved = rs["moved"]
+    report.reshard_completed = rs["completed"]
+
     report.resume_round = max(0, max_r + 1)
     return report
 

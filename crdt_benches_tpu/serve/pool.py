@@ -63,6 +63,7 @@ balanced across devices.
 from __future__ import annotations
 
 import heapq
+import json
 import os
 import tempfile
 from dataclasses import dataclass
@@ -94,12 +95,23 @@ from ..ops.serve_fused import (
     serve_macro_rounds_xla,
     trivial_round_tokens,
 )
+from ..lint.fs_sanitizer import fs_protocol
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
     CorruptCheckpointError,
     load_state,
     save_state,
 )
+from ..utils.fsdur import fsync_dir
+
+#: Two-phase spool GC manifest (drained-doc footprint reclamation):
+#: the same commit-point discipline as the journal's GC_MANIFEST —
+#: the manifest names every member about to die, so a crash mid-pass
+#: is completed (not re-decided) on the next pool construction.
+SPOOL_GC_MANIFEST = "SPOOL_GC_MANIFEST.json"
+
+#: Garbage a manifest read must absorb (G020).
+_SPOOL_GC_ERRORS = (OSError, ValueError, KeyError, TypeError)
 
 #: Serve-step kernel selections (`--serve-kernel`): "fused" = the
 #: ops/serve_fused.py path (shared resolve executables, host-tuned
@@ -209,6 +221,12 @@ class Bucket:
         self._free: list[set[int]] = [
             set(range(self.Rg)) for _ in range(n_sh)
         ]
+        #: elastic shard map (serve/reshard.py): allocation is confined
+        #: to LIVE shards; a draining/retired shard keeps its physical
+        #: rows (the device array never reshapes mid-run) but never
+        #: receives another doc.  Residents of a draining shard still
+        #: serve until their migration round.
+        self.live: list[bool] = [True] * n_sh
         self.steps = 0
 
     # ---- row allocation ----
@@ -228,10 +246,40 @@ class Bucket:
     def free_locals(self, shard: int) -> set[int]:
         return self._free[shard]
 
+    @property
+    def n_free_live(self) -> int:
+        """Free rows on LIVE shards — the allocatable supply.  Distinct
+        from :attr:`n_free` (physical): ``hot_rows`` and the occupancy
+        gauges count physical rows, the scheduler's make-room loop and
+        the reshard coordinator count live ones."""
+        return sum(
+            len(f) for s, f in enumerate(self._free) if self.live[s]
+        )
+
+    @property
+    def live_rows(self) -> int:
+        """Physical row budget of the live shards."""
+        return self.Rg * sum(self.live)
+
+    @property
+    def usable_rows(self) -> int:
+        """Rows a round may schedule: every live row, plus the still-
+        occupied rows of draining shards (their residents keep serving
+        until migrated).  Free rows of non-live shards are the only
+        exclusion — they can never be filled again."""
+        return self.R - (self.n_free - self.n_free_live)
+
+    def set_live(self, shard: int, flag: bool) -> None:
+        self.live[shard] = bool(flag)
+
     def alloc_row(self) -> int:
-        """Lowest local index on the emptiest shard (ties -> lowest
-        shard) — balances the mesh while packing rows toward the front."""
-        s = max(range(self.n_sh), key=lambda i: (len(self._free[i]), -i))
+        """Lowest local index on the emptiest LIVE shard (ties ->
+        lowest shard) — balances the mesh while packing rows toward the
+        front.  Draining/retired shards never allocate."""
+        lives = [i for i in range(self.n_sh) if self.live[i]]
+        if not lives:
+            raise RuntimeError(f"bucket c{self.C}: no live shard")
+        s = max(lives, key=lambda i: (len(self._free[i]), -i))
         if not self._free[s]:
             raise RuntimeError(f"bucket c{self.C}: no free row")
         h = self._heaps[s]
@@ -341,6 +389,7 @@ class DocPool:
         warm_docs: int = 0,
         prefetch: bool = True,
         prefetch_capacity: int = 256,
+        shards: int | None = None,
     ):
         if len(classes) != len(slots):
             raise ValueError("classes and slots must have equal length")
@@ -372,6 +421,24 @@ class DocPool:
             # staged macro tensors (K, R, B): shard the row axis
             self._op_sharding = NamedSharding(mesh, P(None, AXIS, None))
             self.n_sh = n_dev
+        if shards is not None:
+            # logical shard map without (or validating) a device mesh:
+            # reshard workloads and single-host tests exercise the full
+            # topology machinery on one device
+            if mesh is not None and shards != self.n_sh:
+                raise ValueError(
+                    f"shards={shards} conflicts with mesh size {self.n_sh}"
+                )
+            for r in slots:
+                if r % shards:
+                    raise ValueError(
+                        f"bucket slots {r} not divisible by shards={shards}"
+                    )
+            self.n_sh = shards
+        #: elastic shard lifecycle (serve/reshard.py): live -> draining
+        #: (no allocation, residents still serve) -> retired (empty,
+        #: closed); grow revives retired/pre-provisioned shards.
+        self.shard_state: list[str] = ["live"] * self.n_sh
         self.classes = tuple(classes)
         self.buckets = {
             c: Bucket(c, r, self.n_sh, self._sharding)
@@ -381,6 +448,10 @@ class DocPool:
         self._owns_spool = spool_dir is None
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="crdt_serve_")
         os.makedirs(self.spool_dir, exist_ok=True)
+        # adopt-time completion of a torn drained-doc GC pass: the
+        # committed manifest is the predecessor's promise, kept before
+        # any member could be re-read as live state
+        self.finish_torn_spool_gc()
         self.serve_kernel = serve_kernel
         #: staged op-lane dtypes (ops/packing.py): static per pool, so
         #: every class shares one resolve executable and a quiet round
@@ -717,6 +788,106 @@ class DocPool:
         self._free_row(rec)
         self.evictions += 1
         return rec.spool
+
+    # ---- drained-doc footprint GC (two-phase, manifest-committed) ----
+
+    def gc_drained_docs(self, doc_ids) -> int:  # graftlint: durable=spool
+        """Reclaim the O(fleet) footprint of drained docs: the pool
+        record, the spool member (live claim OR the stale file the
+        deferred-unlink discipline leaves behind), and any warm
+        entry/shadow.  Two-phase like the journal's segment GC: the
+        manifest naming every member is committed first (tmp + fsync +
+        replace), then the members die, then the manifest — a crash at
+        any point is completed (never re-decided) by
+        :meth:`finish_torn_spool_gc` at the next pool construction.
+        Non-resident docs only; resident ids are skipped, not errors.
+        Returns the number of docs reclaimed."""
+        victims: list[tuple[int, list[str]]] = []
+        seen: set[int] = set()
+        for d in doc_ids:
+            rec = self.docs.get(d)
+            if rec is None or rec.cls is not None or d in seen:
+                continue
+            seen.add(d)
+            paths: list[str] = []
+            if rec.spool is not None:
+                paths.append(rec.spool)
+            else:
+                p = self._spool_path(d)
+                if os.path.exists(p):
+                    paths.append(p)  # stale deferred-unlink leftover
+            e = self.warm.take(d)
+            if e is not None and e.shadow and e.shadow not in paths:
+                paths.append(e.shadow)
+            victims.append((d, paths))
+        if not victims:
+            return 0
+        manifest = os.path.join(self.spool_dir, SPOOL_GC_MANIFEST)
+        tmp = manifest + ".tmp"
+        members = sorted({p for _d, ps in victims for p in ps})
+        with fs_protocol("spool"):
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({
+                    "version": 1,
+                    "members": [os.path.basename(p) for p in members],
+                }, f, separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, manifest)  # the GC commit point
+            fsync_dir(self.spool_dir)
+            for p in members:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            os.unlink(manifest)
+            fsync_dir(self.spool_dir)
+        for d, _paths in victims:
+            rec = self.docs.pop(d)
+            self._set_spool(rec, None)
+            self._spool_gens.pop(d, None)
+        return len(victims)
+
+    def finish_torn_spool_gc(self) -> int:
+        """Complete a predecessor's torn spool-GC pass.  A committed
+        manifest means the decision was durable: finish the member
+        unlinks it names, then retire it (read-witnessed).  A staged
+        ``.tmp`` never committed and rolls back.  Called from
+        ``__init__`` for adopted spool dirs; returns members removed."""
+        manifest = os.path.join(self.spool_dir, SPOOL_GC_MANIFEST)
+        tmp = manifest + ".tmp"
+        if not (os.path.exists(manifest) or os.path.exists(tmp)):
+            return 0
+        done = 0
+        with fs_protocol("spool"):
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)  # uncommitted: rolls back
+                except OSError:
+                    pass
+            if not os.path.exists(manifest):
+                return 0
+            try:
+                with open(manifest, encoding="utf-8") as f:
+                    names = json.load(f)["members"]
+            except _SPOOL_GC_ERRORS:
+                names = []
+            for name in names:
+                p = os.path.join(
+                    self.spool_dir, os.path.basename(str(name))
+                )
+                if os.path.exists(p):
+                    try:
+                        os.unlink(p)
+                        done += 1
+                    except OSError:
+                        pass
+            try:
+                os.unlink(manifest)
+            except OSError:
+                pass
+            fsync_dir(self.spool_dir)
+        return done
 
     def admit(self, doc_id: int, need: int) -> tuple[int, int]:
         """Make ``doc_id`` resident in the class covering ``need`` slots
@@ -1494,6 +1665,52 @@ class DocPool:
             for s in range(b.n_sh):
                 out[s] += b.Rg - len(b.free_locals(s))
         return out
+
+    # ---- elastic shard map (serve/reshard.py drives these) ----
+
+    @property
+    def live_shard_count(self) -> int:
+        return sum(1 for s in self.shard_state if s == "live")
+
+    def docs_on_shard(self, shard: int) -> list[tuple[int, int, int]]:
+        """``(doc_id, cls, row)`` for every resident of ``shard``, read
+        from the bucket row tables (ground truth, not the records)."""
+        out: list[tuple[int, int, int]] = []
+        for cls, b in self.buckets.items():
+            base = shard * b.Rg
+            for l in range(b.Rg):
+                d = b.rows[base + l]
+                if d is not None:
+                    out.append((d, cls, base + l))
+        return out
+
+    def drain_shard(self, shard: int) -> None:
+        """live → draining: allocation stops NOW (every bucket drops
+        the shard from its live mask); residents keep serving until the
+        reshard coordinator migrates them.  Idempotent — recovery
+        re-applies drains."""
+        if self.shard_state[shard] == "retired":
+            raise ValueError(f"shard {shard} already retired")
+        self.shard_state[shard] = "draining"
+        for b in self.buckets.values():
+            b.set_live(shard, False)
+
+    def retire_shard(self, shard: int) -> None:
+        """draining → retired: requires the shard empty in every
+        class — the coordinator's commit precondition."""
+        occupied = len(self.docs_on_shard(shard))
+        if occupied:
+            raise RuntimeError(
+                f"shard {shard}: {occupied} residents, cannot retire"
+            )
+        self.shard_state[shard] = "retired"
+
+    def revive_shard(self, shard: int) -> None:
+        """→ live (the grow path): the shard re-enters allocation in
+        every bucket."""
+        self.shard_state[shard] = "live"
+        for b in self.buckets.values():
+            b.set_live(shard, True)
 
     def close(self) -> None:
         """Stop the prefetch thread and delete the spool directory if

@@ -1,0 +1,824 @@
+"""Elastic fleet reconfiguration: live shard-map changes without downtime.
+
+The serve stack froze its topology at pool construction: row ``r`` of
+every capacity class lives on shard ``r // Rg`` forever, and the only
+topology event the chaos model knew was ``device_loss`` — a rebuild
+*within* the static map.  This module makes the shard map a live,
+journaled object:
+
+- a **shard-map change** (``shrink:FROM:TO``, ``grow:FROM:TO``, or
+  ``drain:S``) flips shards between ``live`` → ``draining`` →
+  ``retired`` (or back to ``live`` on grow) while the fleet keeps
+  serving — allocation stops on a draining shard immediately, but its
+  resident docs keep taking ops until their migration round;
+- **migrations are batched cross-shard doc moves** through the existing
+  boundary-bucket machinery: each migrated doc is either a row-to-row
+  ``("pull", cls, src_row)`` install onto a live shard (stays hot) or,
+  when its class has no free live row, a plain eviction (readmitted on
+  a live shard at its next scheduling).  Migrating docs briefly DEFER
+  (their lane is pulled from the round), they are never shed;
+- **every migration decision is durable before it executes**: the
+  coordinator's commit point is ``RESHARD_MANIFEST.json`` (tmp + fsync
+  + ``os.replace`` — the ``# graftlint: durable=reshard`` protocol),
+  per-round move batches are journaled ``reshard``/``phase=move``
+  records ahead of the boundary, and the final commit record is
+  followed by a read-witnessed manifest unlink (G019's torn-pass
+  completion form).  A crash at ANY mutating-op boundary leaves a state
+  :func:`recover_torn_reshard` resolves deterministically: manifest
+  present → roll the reshard FORWARD (retire the shards, move restored
+  docs off); manifest absent → the journal's ``phase=commit`` records
+  are the truth (a staged ``.tmp`` never committed and rolls back);
+- the chaos kind ``reshard_crash`` kills the coordinator exactly
+  between the manifest commit and the first per-doc move; the next
+  round's tick resumes from the on-disk manifest (the same roll-forward
+  recovery uses), so the event always closes recovered.
+
+The invariant "every doc exists on exactly one shard at every crash
+point" is machine-checked by :func:`check_shard_partition`, called at
+every boundary of the ``serve/fscrash.py`` enumeration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from ..lint.fs_sanitizer import fs_protocol
+from ..lint.sanitizer import fenced
+from ..utils.fsdur import fsync_dir
+
+#: The migration manifest: the reshard's durable commit point, living
+#: in the journal directory next to ``GC_MANIFEST.json`` (same
+#: two-phase discipline, PR 12).
+RESHARD_MANIFEST = "RESHARD_MANIFEST.json"
+
+#: The benign-garbage error set a manifest read must absorb (G020): a
+#: bit-flipped manifest that still parses surfaces as one of these.
+_MANIFEST_ERRORS = (OSError, json.JSONDecodeError, KeyError, TypeError,
+                    ValueError)
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReshardPlan:
+    """One parsed ``--serve-reshard`` spec.
+
+    Grammar (see README "Elastic reconfiguration")::
+
+        shrink:FROM:TO[@ROUND][,batch=N][,imbalance=X]
+        grow:FROM:TO[@ROUND][,batch=N]
+        drain:SHARD[@ROUND][,of=N][,batch=N][,imbalance=X]
+
+    ``@ROUND`` arms a round trigger; ``imbalance=X`` arms the PR 7
+    per-shard gauge as an alternative trigger (the reshard begins at
+    the FIRST round where either condition holds).  A spec with neither
+    trigger begins at round 2.  ``batch`` bounds doc moves per
+    macro-round (default 8) — the knob that trades migration duration
+    for mid-reshard tail latency.  ``drain`` takes its physical shard
+    count from the mesh when one is present; single-host logical
+    sharding needs ``of=N`` (drain shard S of N).
+    """
+
+    kind: str  # "shrink" | "grow" | "drain"
+    from_sh: int  # live shard count before the change
+    to_sh: int  # live shard count after the change
+    shards: tuple[int, ...]  # shard ids changing state
+    at_round: int | None = None
+    imbalance: float | None = None
+    batch: int = 8
+    spec: str = ""
+
+    @property
+    def n_shards(self) -> int:
+        """Physical shard count the pool must be built with."""
+        return max(self.from_sh, self.to_sh)
+
+    @property
+    def initial_live(self) -> int:
+        """Live shards at construction (grow starts below physical)."""
+        return self.from_sh
+
+
+def parse_reshard_spec(spec: str) -> ReshardPlan:
+    """Parse a ``--serve-reshard`` spec string (grammar above)."""
+    head, *opts = str(spec).split(",")
+    head = head.strip()
+    at_round: int | None = None
+    if "@" in head:
+        head, at = head.rsplit("@", 1)
+        at_round = int(at)
+    parts = head.split(":")
+    kind = parts[0].strip()
+    try:
+        if kind in ("shrink", "grow"):
+            if len(parts) != 3:
+                raise ValueError("expected KIND:FROM:TO")
+            from_sh, to_sh = int(parts[1]), int(parts[2])
+        elif kind == "drain":
+            if len(parts) != 2:
+                raise ValueError("expected drain:SHARD")
+            shard = int(parts[1])
+            from_sh, to_sh = shard + 1, shard  # lower bounds; fixed below
+        else:
+            raise ValueError(f"unknown reshard kind {kind!r}")
+    except ValueError as e:
+        raise ValueError(
+            f"reshard spec {spec!r}: {e} "
+            "(grammar: shrink:FROM:TO[@R] | grow:FROM:TO[@R] | "
+            "drain:SHARD[@R], options batch=N, imbalance=X)"
+        ) from None
+    imbalance: float | None = None
+    batch = 8
+    of = 0
+    for tok in opts:
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" not in tok:
+            raise ValueError(
+                f"reshard spec option {tok!r}: expected key=value"
+            )
+        key, val = tok.split("=", 1)
+        key = key.strip()
+        if key == "batch":
+            batch = max(1, int(val))
+        elif key == "imbalance":
+            imbalance = float(val)
+        elif key == "of":
+            if kind != "drain":
+                raise ValueError(
+                    "reshard spec: of=N only applies to drain:SHARD"
+                )
+            of = int(val)
+        else:
+            raise ValueError(
+                f"reshard spec: unknown option {key!r} "
+                "(expected batch, imbalance or of)"
+            )
+    if kind == "shrink":
+        if not 1 <= to_sh < from_sh:
+            raise ValueError(
+                f"reshard spec {spec!r}: shrink needs FROM > TO >= 1"
+            )
+        shards = tuple(range(to_sh, from_sh))
+    elif kind == "grow":
+        if not 1 <= from_sh < to_sh:
+            raise ValueError(
+                f"reshard spec {spec!r}: grow needs TO > FROM >= 1"
+            )
+        shards = tuple(range(from_sh, to_sh))
+    else:  # drain one specific shard
+        shard = int(parts[1])
+        if shard < 0:
+            raise ValueError(f"reshard spec {spec!r}: negative shard id")
+        shards = (shard,)
+        if of:
+            if not 0 <= shard < of or of < 2:
+                raise ValueError(
+                    f"reshard spec {spec!r}: drain:{shard},of={of} "
+                    "needs 0 <= SHARD < N and N >= 2"
+                )
+            from_sh, to_sh = of, of - 1
+        else:
+            from_sh, to_sh = 0, 0  # resolved against the mesh at bind
+    return ReshardPlan(
+        kind=kind, from_sh=from_sh, to_sh=to_sh, shards=shards,
+        at_round=at_round, imbalance=imbalance, batch=batch,
+        spec=str(spec),
+    )
+
+
+# ---------------------------------------------------------------------------
+# manifest (the durable commit point)
+# ---------------------------------------------------------------------------
+
+
+def commit_manifest(journal_dir: str, manifest: dict) -> str:  # graftlint: durable=reshard
+    """Commit the migration manifest: the reshard's point of no return.
+    Staged to a ``.tmp`` sibling, fsynced, then atomically installed
+    (G018) — after the ``os.replace`` the reshard WILL complete, by the
+    coordinator, by its in-run resume, or by recovery's roll-forward."""
+    path = os.path.join(journal_dir, RESHARD_MANIFEST)
+    tmp = path + ".tmp"
+    with fs_protocol("reshard"):
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # THE reshard commit point
+        fsync_dir(journal_dir)
+    return path
+
+
+def read_manifest(journal_dir: str) -> dict | None:
+    """The committed migration manifest, or None (absent/garbage —
+    garbage rolls back exactly like absence: nothing was promised)."""
+    path = os.path.join(journal_dir, RESHARD_MANIFEST)
+    try:
+        with open(path, encoding="utf-8") as f:
+            m = json.load(f)
+        return {
+            "id": int(m["id"]),
+            "kind": str(m["kind"]),
+            "shards": [int(s) for s in m["shards"]],
+            "round": int(m["round"]),
+            "docs": int(m.get("docs", 0)),
+        }
+    except _MANIFEST_ERRORS:
+        return None
+
+
+def retire_manifest(journal_dir: str) -> bool:  # graftlint: durable=reshard
+    """Retire a completed reshard's manifest (idempotent).  The unlink
+    is read-witnessed inside the protocol entry — G019's torn-pass
+    completion form: destruction dominated by a read of the committed
+    record.  A staged ``.tmp`` (crash before the commit) is discarded
+    too: it promised nothing."""
+    path = os.path.join(journal_dir, RESHARD_MANIFEST)
+    with fs_protocol("reshard"):
+        tmp = path + ".tmp"
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path, encoding="utf-8") as f:
+                json.load(f)  # read-witness of the committed record
+        except _MANIFEST_ERRORS:
+            pass  # garbage manifest: still ours to retire
+        try:
+            os.unlink(path)
+        except OSError:
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the partition invariant (machine-checked at every fscrash boundary)
+# ---------------------------------------------------------------------------
+
+
+def check_shard_partition(pool) -> list[str]:
+    """Every doc exists on exactly one shard (or on no shard at all,
+    when warm/cold/genesis).  Returns human-readable violations; empty
+    means the invariant holds.  Checked against ground truth — the
+    bucket row tables and free sets — not the per-doc records alone,
+    so a half-applied move shows up from either side:
+
+    - a doc occupying two rows anywhere in the pool;
+    - a bucket row naming a doc whose record points elsewhere;
+    - a record naming a row the bucket believes is free;
+    - a resident doc on a RETIRED shard;
+    - a resident doc still carrying a cold-spool claim (its tier state
+      would be ambiguous — the deferred-unlink discipline requires
+      ``rec.spool is None`` while hot);
+    - per-shard occupancy failing to sum to the resident-doc count.
+    """
+    problems: list[str] = []
+    owner: dict[int, tuple[int, int]] = {}  # doc -> (cls, row)
+    occupied = 0
+    for cls, b in pool.buckets.items():
+        free = set(b.free)
+        for row, doc_id in enumerate(b.rows):
+            if doc_id is None:
+                continue
+            occupied += 1
+            if row in free:
+                problems.append(
+                    f"c{cls} row {row}: doc {doc_id} occupies a row "
+                    "the free set also lists"
+                )
+            if doc_id in owner:
+                o_cls, o_row = owner[doc_id]
+                problems.append(
+                    f"doc {doc_id}: resident on two shards/rows "
+                    f"(c{o_cls} r{o_row} and c{cls} r{row})"
+                )
+            owner[doc_id] = (cls, row)
+            rec = pool.docs.get(doc_id)
+            if rec is None:
+                problems.append(
+                    f"c{cls} row {row}: doc {doc_id} has no pool record"
+                )
+            elif (rec.cls, rec.row) != (cls, row):
+                problems.append(
+                    f"doc {doc_id}: bucket says c{cls} r{row}, record "
+                    f"says c{rec.cls} r{rec.row}"
+                )
+            shard = row // b.Rg
+            if pool.shard_state[shard] == "retired":
+                problems.append(
+                    f"doc {doc_id}: resident on RETIRED shard {shard} "
+                    f"(c{cls} r{row})"
+                )
+    for doc_id, rec in pool.docs.items():
+        if rec.cls is not None and doc_id not in owner:
+            problems.append(
+                f"doc {doc_id}: record claims c{rec.cls} r{rec.row} but "
+                "no bucket row names it"
+            )
+        if rec.cls is not None and rec.spool is not None:
+            problems.append(
+                f"doc {doc_id}: resident AND cold (spool claim "
+                f"{os.path.basename(rec.spool)}) — ambiguous tier"
+            )
+    if sum(pool.shard_occupancy()) != occupied:
+        problems.append(
+            f"shard occupancy {pool.shard_occupancy()} does not sum to "
+            f"the {occupied} occupied rows"
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# the coordinator
+# ---------------------------------------------------------------------------
+
+
+class ReshardCoordinator:
+    """Drives one shard-map change through a serving fleet.
+
+    Ticked by the scheduler once per macro-round, AFTER the round's
+    plan is placed and BEFORE its WAL record — so every migration this
+    round executes lands in the same boundary compose as the round's
+    own moves, and the journal sees the decision before the bytes
+    move.  States: ``idle`` → (trigger) → ``active`` → ``done``, with
+    a ``crashed`` detour when the ``reshard_crash`` chaos kind kills
+    the first attempt between the manifest commit and the per-doc
+    moves."""
+
+    def __init__(self, pool, journal, plan: ReshardPlan, faults=None,
+                 telemetry=None):
+        if journal is None:
+            raise ValueError(
+                "reshard requires the write-ahead journal "
+                "(--serve-journal): migration decisions must be durable"
+            )
+        self.pool = pool
+        self.journal = journal
+        self.plan = plan
+        self.faults = faults
+        self.telemetry = telemetry
+        self.state = "idle"
+        self.reshard_id = 0
+        self._shards: tuple[int, ...] = self._resolve_shards()
+        if plan.kind == "grow":
+            # the target shards are provisioned (rows exist) but not
+            # yet live: docs place on the FROM set until the grow's
+            # begin revives them
+            for s in self._shards:
+                self.pool.drain_shard(s)
+        self._crash_ev = None
+        self.begin_round = -1
+        self.commit_round = -1
+        self.migrated = 0  # row-to-row moves (stayed hot)
+        self.evicted = 0  # no free live row: demoted, readmits live
+        self.deferred_lanes = 0  # scheduled lanes pulled for migration
+        self.deferred_ops = 0  # ops those lanes would have applied
+        self.rounds_active = 0
+        self.resumes = 0
+        # mid-reshard tail visibility: per-round latencies while the
+        # move is in flight (the bench's reshard block quantiles them)
+        self.round_latencies: list[float] = []
+        self._g = {}
+
+    def _resolve_shards(self) -> tuple[int, ...]:
+        n = self.pool.n_sh
+        p = self.plan
+        if p.kind == "drain":
+            if p.shards[0] >= n:
+                raise ValueError(
+                    f"reshard drain:{p.shards[0]}: pool has {n} shards"
+                )
+            if p.from_sh and p.from_sh != n:
+                raise ValueError(
+                    f"reshard {p.spec!r}: of={p.from_sh} but the pool "
+                    f"has {n} physical shards"
+                )
+            return p.shards
+        if p.n_shards != n:
+            raise ValueError(
+                f"reshard {p.spec!r}: pool has {n} physical shards, "
+                f"spec needs {p.n_shards} (pass --serve-mesh or shards=)"
+            )
+        return p.shards
+
+    def bind_metrics(self, registry) -> None:
+        """Pre-register the ``serve.reshard.*`` series (G013: never on
+        the hot path)."""
+        g = registry.gauge
+        c = registry.counter
+        self._g = {
+            "active": g("serve.reshard.active"),
+            "draining": g("serve.reshard.draining_shards"),
+            "pending": g("serve.reshard.pending_docs"),
+            "migrated": c("serve.reshard.migrated"),
+            "evicted": c("serve.reshard.evicted"),
+            "deferred": c("serve.reshard.deferred_lanes"),
+            "rounds": c("serve.reshard.rounds"),
+            "resumes": c("serve.reshard.resumes"),
+        }
+
+    # ---- helpers ----
+
+    def _draining_docs(self) -> list[tuple[int, int, int]]:
+        """(doc_id, cls, row) of every doc resident on a changing
+        shard, deterministic order."""
+        out = []
+        for s in self._shards:
+            if self.pool.shard_state[s] != "draining":
+                continue
+            out.extend(self.pool.docs_on_shard(s))
+        out.sort()
+        return out
+
+    def _event(self, phase: str, rnd: int, **fields) -> None:
+        self.journal.event(
+            "reshard", phase=phase, id=self.reshard_id, r=rnd, **fields
+        )
+        if self.telemetry is not None:
+            self.telemetry.note_event(
+                "reshard", phase=phase, id=self.reshard_id, round=rnd,
+                **fields,
+            )
+
+    def _gauge_refresh(self, pending: int) -> None:
+        if not self._g:
+            return
+        self._g["active"].set(1 if self.state in ("active", "crashed")
+                              else 0)
+        self._g["draining"].set(sum(
+            1 for s in self._shards
+            if self.pool.shard_state[s] == "draining"
+        ))
+        self._g["pending"].set(pending)
+        if self.telemetry is not None:
+            # out-of-window publish: a shard-map change is exactly the
+            # event an operator scrapes for, and a small fleet's whole
+            # migration can begin and commit INSIDE one telemetry
+            # window — without this the live /metrics endpoint would
+            # never show the move in flight
+            self.telemetry.publish_metrics_now()
+
+    @property
+    def active(self) -> bool:
+        return self.state in ("active", "crashed")
+
+    def migrating_docs(self) -> set[int]:
+        """Docs currently mid-move (resident on a draining shard while
+        the reshard is active): these DEFER, they are never shed."""
+        if not self.active:
+            return set()
+        return {d for d, _cls, _row in self._draining_docs()}
+
+    # ---- the per-round hook ----
+
+    @fenced
+    def tick(self, rnd: int, plan, imbalance: float,  # graftlint: fence=reshard
+             note_deferred=None) -> None:
+        """One round of coordination: trigger, (re)plan, migrate a
+        batch, commit when drained.  ``plan`` is the round's placed
+        ``_Plan`` — migrations append to its installs/evictions so the
+        boundary executes them with everything else.  ``note_deferred``
+        receives the op count of every lane pulled for migration.
+
+        A declared sync boundary (``fence=reshard``): the manifest
+        commit, journal records, and host-side row staging all live
+        inside the per-round tick, so the fence sits at its mouth —
+        the same place the scheduler crosses it."""
+        if self.state == "done":
+            return
+        if self.state == "idle":
+            if not self._should_begin(rnd, imbalance):
+                return
+            self._begin(rnd)
+            if self.state != "active":
+                return  # reshard_crash: coordinator died post-commit
+        elif self.state == "crashed":
+            self._resume(rnd)
+        self.rounds_active += 1
+        if self._g:
+            self._g["rounds"].inc()
+        pending = self._draining_docs()
+        if pending and plan is not None:
+            self._migrate_batch(rnd, plan, pending, note_deferred)
+            pending = self._draining_docs()
+        if not pending:
+            self._commit(rnd)
+        self._gauge_refresh(len(pending))
+
+    def _should_begin(self, rnd: int, imbalance: float) -> bool:
+        p = self.plan
+        if p.at_round is not None and rnd >= p.at_round:
+            return True
+        if p.imbalance is not None and imbalance > p.imbalance:
+            return True
+        return p.at_round is None and p.imbalance is None and rnd >= 2
+
+    def _begin(self, rnd: int) -> None:
+        """The commit point: manifest first (durable decision), then
+        the live shard-map flip, then the begin record.  The
+        ``reshard_crash`` kill point sits immediately after — between
+        the committed manifest and the first per-doc move."""
+        self.reshard_id += 1
+        self.begin_round = rnd
+        docs0 = 0
+        if self.plan.kind != "grow":
+            for s in self._shards:
+                docs0 += len(self.pool.docs_on_shard(s))
+        commit_manifest(self.journal.dir, {
+            "id": self.reshard_id,
+            "kind": self.plan.kind,
+            "shards": list(self._shards),
+            "round": rnd,
+            "docs": docs0,
+        })
+        if self.plan.kind == "grow":
+            for s in self._shards:
+                self.pool.revive_shard(s)
+        else:
+            for s in self._shards:
+                self.pool.drain_shard(s)
+        self._event("begin", rnd, change=self.plan.kind,
+                    shards=list(self._shards), docs=docs0)
+        self.state = "active"
+        if self.faults is not None:
+            ev = self.faults.reshard_crash_event(rnd)
+            if ev is not None:
+                # the coordinator dies here: its in-memory migration
+                # plan is gone, the manifest is not.  The next tick's
+                # resume (or a real recovery's roll-forward) completes
+                # the reshard from the manifest alone.
+                ev.fire(rnd, stage="post_manifest_pre_moves",
+                        shards=list(self._shards), docs=docs0)
+                self._crash_ev = ev
+                self.state = "crashed"
+        self._gauge_refresh(docs0)
+
+    def _resume(self, rnd: int) -> None:
+        """Deterministic in-run recovery of a crashed coordinator:
+        everything needed to finish lives in the committed manifest
+        and the pool's own shard map — re-read the manifest (the
+        read-witness), re-derive the pending set, carry on."""
+        m = read_manifest(self.journal.dir)
+        if m is not None:
+            self._shards = tuple(int(s) for s in m["shards"])
+        self.resumes += 1
+        if self._g:
+            self._g["resumes"].inc()
+        self._event("resume", rnd, shards=list(self._shards))
+        if self._crash_ev is not None:
+            self._crash_ev.recover(via="coordinator_resume", round=rnd)
+            self._crash_ev = None
+        self.state = "active"
+
+    def _migrate_batch(self, rnd: int, plan, pending, note_deferred
+                       ) -> None:
+        """Move up to ``batch`` docs off the draining shards through
+        the round's boundary compose.  A doc scheduled this round has
+        its lane pulled first (defer, never shed) — its ops reschedule
+        next round from the live shard."""
+        pool = self.pool
+        moved: list[list[int]] = []
+        # A doc ADMITTED this very round is not movable yet: its row
+        # install composes at this round's boundary, but both migration
+        # paths (row-to-row "pull" and demote-to-spool) read the PRE-
+        # compose bucket snapshot — the row's bytes before the install
+        # land, i.e. a previous tenant's state or garbage.  Skip it;
+        # the next tick's pending recompute picks it up with real state.
+        installing = {
+            d for items in plan.installs.values() for d, _row, _src in items
+        }
+        batch = [m for m in pending
+                 if m[0] not in installing][: self.plan.batch]
+        for doc_id, cls, src_row in batch:
+            b = pool.buckets[cls]
+            lane_ops = self._pull_lane(plan, cls, doc_id, note_deferred)
+            rec = pool.docs[doc_id]
+            if b.n_free_live > 0:
+                # row-to-row move onto a live shard: the doc stays hot
+                dst = b.alloc_row()
+                inst = plan.installs.setdefault(cls, [])
+                inst.append((doc_id, dst, ("pull", cls, src_row)))
+                plan.pull_classes.add(cls)
+                b.rows[dst] = doc_id
+                b.rows[src_row] = None
+                b.release_row(src_row)
+                rec.row = dst
+                self.migrated += 1
+                if self._g:
+                    self._g["migrated"].inc()
+                if self.telemetry is not None:
+                    self.telemetry.shards.note_relocation(dst // b.Rg)
+                moved.append([doc_id, cls, src_row, dst])
+            else:
+                # no free live row in the class: demote through the
+                # normal eviction boundary; the next admission lands it
+                # on a live shard (draining shards refuse allocation)
+                plan.evictions.append((doc_id, cls, src_row))
+                plan.pull_classes.add(cls)
+                if pool.warm.budget <= 0:
+                    pool._set_spool(rec, pool._spool_path(doc_id))
+                b.rows[src_row] = None
+                b.release_row(src_row)
+                rec.cls = rec.row = None
+                pool.evictions += 1
+                self.evicted += 1
+                if self._g:
+                    self._g["evicted"].inc()
+                moved.append([doc_id, cls, src_row, -1])
+        if moved:
+            # the decision is journaled BEFORE the boundary applies it
+            self._event("move", rnd, docs=moved)
+
+    def _pull_lane(self, plan, cls: int, doc_id: int, note_deferred
+                   ) -> int:
+        """Remove the doc's lane from the round (if it was scheduled):
+        a migrating doc defers.  Returns the deferred op count."""
+        lanes = plan.lanes.get(cls)
+        if not lanes:
+            return 0
+        for i, lane in enumerate(lanes):
+            if lane.stream.doc_id != doc_id:
+                continue
+            ops = lane.end - lane.stream.cursor
+            del lanes[i]
+            if not lanes:
+                del plan.lanes[cls]
+            self.deferred_lanes += 1
+            self.deferred_ops += ops
+            if self._g:
+                self._g["deferred"].inc()
+            if note_deferred is not None:
+                note_deferred(ops)
+            return ops
+        return 0
+
+    def _commit(self, rnd: int) -> None:
+        """The draining shards are empty: retire them, journal the
+        commit record, retire the manifest (read-witnessed unlink)."""
+        retired: list[int] = []
+        if self.plan.kind != "grow":
+            for s in self._shards:
+                if self.pool.shard_state[s] == "draining":
+                    self.pool.retire_shard(s)
+                    retired.append(s)
+        self.commit_round = rnd
+        self._event(
+            "commit", rnd, change=self.plan.kind, retired=retired,
+            revived=(list(self._shards) if self.plan.kind == "grow"
+                     else []),
+            migrated=self.migrated, evicted=self.evicted,
+        )
+        retire_manifest(self.journal.dir)
+        self.state = "done"
+        self._gauge_refresh(0)
+
+    @fenced
+    def finalize(self, rnd: int) -> None:  # graftlint: fence=reshard
+        """End-of-drain sweep: a reshard still in flight when the last
+        op drains completes NOW — remaining residents of the draining
+        shards are demoted host-side (their streams are done; nothing
+        re-admits them) and the commit lands.  A crashed coordinator
+        resumes first, closing its chaos event — a completed drain
+        never ends with a torn manifest."""
+        if self.state == "done":
+            return
+        if self.state == "idle":
+            return
+        if self.state == "crashed":
+            self._resume(rnd)
+        moved = []
+        for doc_id, cls, _row in self._draining_docs():
+            self.pool.evict(doc_id)
+            self.evicted += 1
+            if self._g:
+                self._g["evicted"].inc()
+            moved.append([doc_id, cls, _row, -1])
+        if moved:
+            self._event("move", rnd, docs=moved, finalize=True)
+        self._commit(rnd)
+
+    # ---- reporting ----
+
+    def note_round_latency(self, seconds: float) -> None:
+        if self.active:
+            self.round_latencies.append(seconds)
+
+    def status_fields(self) -> dict:
+        return {
+            "state": self.state,
+            "kind": self.plan.kind,
+            "shards": list(self._shards),
+            "pending_docs": (len(self._draining_docs())
+                             if self.active else 0),
+            "migrated": self.migrated,
+            "evicted": self.evicted,
+            "deferred_lanes": self.deferred_lanes,
+        }
+
+    def summary(self) -> dict:
+        """The artifact's ``reshard`` block body."""
+        import numpy as np
+
+        lat = sorted(self.round_latencies)
+        qs = {}
+        if lat:
+            arr = np.asarray(lat)
+            qs = {
+                "p50": float(np.quantile(arr, 0.5)),
+                "p99": float(np.quantile(arr, 0.99)),
+                "max": float(arr[-1]),
+            }
+        return {
+            "version": 1,
+            "spec": self.plan.spec,
+            "kind": self.plan.kind,
+            "state": self.state,
+            "shards": list(self._shards),
+            "begin_round": self.begin_round,
+            "commit_round": self.commit_round,
+            "rounds_active": self.rounds_active,
+            "migrated": self.migrated,
+            "evicted": self.evicted,
+            "deferred_lanes": self.deferred_lanes,
+            "deferred_ops": self.deferred_ops,
+            "resumes": self.resumes,
+            "mid_latency": qs,
+            "live_shards": self.pool.live_shard_count,
+        }
+
+
+# ---------------------------------------------------------------------------
+# recovery (complete or roll back, deterministically)
+# ---------------------------------------------------------------------------
+
+
+def scan_reshard_records(records) -> tuple[set[int], int]:
+    """Replay the journal's reshard lifecycle records in order: the
+    retired-shard set a recovered pool must honor, and the count of
+    commit records seen.  Grow commits revive — the set is a running
+    state, not a union."""
+    retired: set[int] = set()
+    commits = 0
+    for rec in records:
+        if rec.get("t") != "reshard":
+            continue
+        if rec.get("phase") != "commit":
+            continue
+        commits += 1
+        for s in rec.get("retired", []):
+            retired.add(int(s))
+        for s in rec.get("revived", []):
+            retired.discard(int(s))
+    return retired, commits
+
+
+def recover_torn_reshard(pool, journal_dir: str, records) -> dict:
+    """Resolve any reshard state a crash left behind — called by
+    ``recover_fleet`` after the snapshot restore, before serving
+    resumes.  Deterministic by construction:
+
+    - journaled ``commit`` records are settled history: their retired
+      shards are re-retired (a snapshot OLDER than the reshard may
+      have restored docs onto them — those docs are demoted to the
+      spool, the same migration semantics, before the shard closes);
+    - a committed manifest with no commit record is a torn reshard:
+      ROLLED FORWARD the same way (the manifest was the promise);
+    - no manifest and no commit record: the reshard never committed —
+      rolled back by doing nothing (a staged ``.tmp`` is discarded).
+
+    Returns ``{"retired": [...], "moved": n, "completed": bool}``.
+    """
+    retired, _commits = scan_reshard_records(records)
+    manifest = read_manifest(journal_dir)
+    completed = False
+    if manifest is not None and manifest["kind"] != "grow":
+        retired |= set(manifest["shards"])
+    moved = 0
+    for s in sorted(retired):
+        if s >= pool.n_sh:
+            continue
+        if pool.shard_state[s] != "retired":
+            pool.drain_shard(s)
+        for doc_id, _cls, _row in pool.docs_on_shard(s):
+            pool.evict(doc_id)
+            moved += 1
+        if pool.shard_state[s] != "retired":
+            pool.retire_shard(s)
+    if manifest is not None or os.path.exists(
+            os.path.join(journal_dir, RESHARD_MANIFEST + ".tmp")):
+        completed = retire_manifest(journal_dir) or manifest is not None
+    return {
+        "retired": sorted(retired),
+        "moved": moved,
+        "completed": completed,
+    }

@@ -644,7 +644,9 @@ class FleetScheduler:
                  degrade_rounds: int = 4,
                  start_round: int = 0, profiler=None, telemetry=None,
                  reqtrace=None, slo=None,
-                 warm_start: bool = False):
+                 warm_start: bool = False,
+                 reshard=None,
+                 drained_gc: bool = False, gc_keep=None):
         if overflow_policy not in ("defer", "shed"):
             raise ValueError(f"unknown overflow policy {overflow_policy!r}")
         self.pool = pool
@@ -726,6 +728,22 @@ class FleetScheduler:
                 patches=sum(s.n_patches for s in streams.values())
             )
         self._ended: set[int] = set()
+        #: elastic reconfiguration (serve/reshard.py ReshardCoordinator,
+        #: or None): ticked once per round after placement, finalized at
+        #: drain end before the fault sweep
+        self.reshard = reshard
+        # drained-doc footprint GC (two-phase spool reclamation): only
+        # journal-less drains may reclaim — recovery replays snapshot
+        # chains whose members live in the spool dir
+        if drained_gc and journal is not None:
+            raise ValueError(
+                "drained-doc GC requires a journal-less drain "
+                "(recovery re-adopts spool members)"
+            )
+        self.drained_gc = drained_gc
+        self._gc_keep = set(gc_keep or ())
+        self._gc_queue: list[int] = []
+        self.spool_gc_docs = 0  # records+members reclaimed so far
         self.profiler = profiler  # obs/profiler.py DeviceProfiler (or None)
         self._pending_round: tuple[float, bool, bool] | None = None
         # request lifecycle (obs/reqtrace.py): disarmed, the tracker is
@@ -745,6 +763,8 @@ class FleetScheduler:
             faults.bind_metrics(reg)
         if slo is not None:
             slo.bind(reg)  # burn-rate gauges pre-registered (G013)
+        if reshard is not None:
+            reshard.bind_metrics(reg)  # serve.reshard.* (G013)
         self.reqtrace.bind(self.stats)
         self._m_faults_seen = reg.counter("serve.faults.seen")
         # durability gauges (pre-registered off the hot path, G013):
@@ -892,9 +912,47 @@ class FleetScheduler:
             # dead weight (nothing replays them without a journal) —
             # drop them so footprint tracks the ACTIVE set
             self.streams.release(st.doc_id)
+        if self.drained_gc and st.doc_id not in self._gc_keep:
+            # past its last arrival window: the pool record and any
+            # spool/shadow member are reclaimable (batched two-phase
+            # GC at the next boundary — see _flush_drained_gc)
+            self._gc_queue.append(st.doc_id)
         if dt is None:
             return  # never admitted (or this episode already closed)
         self.stats.note_doc_drained(tag, dt)
+
+    # ---- elastic reconfiguration hooks (serve/reshard.py) ----
+
+    def _shard_imbalance(self) -> float:
+        """Live-shard occupancy imbalance (peak x live / total, the
+        PR 7 ``serve.shard.imbalance`` formula restricted to live
+        shards): the reshard coordinator's rebalance trigger."""
+        occ = self.pool.shard_occupancy()
+        live = [
+            occ[s] for s in range(self.pool.n_sh)
+            if self.pool.shard_state[s] == "live"
+        ]
+        total = sum(live)
+        if not live or total <= 0:
+            return 1.0
+        return max(live) * len(live) / total
+
+    def _note_reshard_deferred(self, ops: int) -> None:
+        """A migrating doc's lane was pulled from the round: its ops
+        defer (re-scheduled next round from the live shard), they are
+        never shed."""
+        self.stats.deferred_ops += ops
+
+    def _flush_drained_gc(self, force: bool = False) -> None:
+        """Batched two-phase reclamation of drained docs (pool record +
+        spool/shadow member).  Batching amortizes the manifest fsyncs;
+        the final flush at drain end is forced."""
+        if not self.drained_gc or not self._gc_queue:
+            return
+        if not force and len(self._gc_queue) < 32:
+            return
+        batch, self._gc_queue = self._gc_queue, []
+        self.spool_gc_docs += self.pool.gc_drained_docs(batch)
 
     def _select(self, plan: _Plan) -> None:
         """Pick this macro-round's lanes: {class: [_Lane]}, bounded by
@@ -908,8 +966,10 @@ class FleetScheduler:
         the prefix that filled the buckets)."""
         scheduled: list[int] = []
         deferred: list[int] = []
+        live_need: dict[int, int] = {}  # lanes consuming a LIVE row
         open_classes = {
-            c for c in self.pool.classes if self.pool.buckets[c].R > 0
+            c for c in self.pool.classes
+            if self.pool.buckets[c].usable_rows > 0
         }
         popped_live = 0  # arrived, undrained docs this scan handled
         while self._rr:
@@ -959,14 +1019,29 @@ class FleetScheduler:
             rec = self.pool.docs[doc_id]
             need = rec.n_init + st.ins_before(end)
             cls = self.pool.class_for(max(need, rec.length, 1))
+            b = self.pool.buckets[cls]
             lanes = plan.lanes.setdefault(cls, [])
-            if len(lanes) >= self.pool.buckets[cls].R:
+            # lane cap = the LIVE-row budget, consumed by every lane
+            # except a resident already serving from a draining shard
+            # (it has its row until migrated).  Counting unselected
+            # draining residents as capacity would let _place run out
+            # of eviction candidates mid-drain, so they are free riders
+            # on top of the cap, never part of it.
+            on_drain = (rec.cls == cls
+                        and not b.live[rec.row // b.Rg])
+            need = live_need.get(cls, 0)
+            if not on_drain and need >= b.live_rows:
                 plan.waiting += 1
                 deferred.append(doc_id)
-                open_classes.discard(cls)
+                if b.usable_rows <= b.live_rows:
+                    # no draining residents left to free-ride: full
+                    open_classes.discard(cls)
                 continue
             lanes.append(_Lane(stream=st, takes=takes, end=end))
-            if len(lanes) >= self.pool.buckets[cls].R:
+            if not on_drain:
+                need += 1
+                live_need[cls] = need
+            if need >= b.live_rows and b.usable_rows <= b.live_rows:
                 open_classes.discard(cls)
             # the admission edge: one request context per episode
             # (G012 allows context creation here, in the per-DOC
@@ -987,8 +1062,13 @@ class FleetScheduler:
         would turn its direct promotion into a spool round-trip — but
         remain the liveness fallback: only this class's own selected set
         is guaranteed to leave a candidate."""
+        b = self.pool.buckets[cls]
         candidates = [
-            d for d, _row in self.pool.residents(cls) if d not in selected
+            d for d, row in self.pool.residents(cls)
+            if d not in selected and b.live[row // b.Rg]
+            # draining-shard residents are the reshard coordinator's to
+            # move (never shed, never evicted under it) — and evicting
+            # one would not free an allocatable row anyway
         ]
         if not candidates:
             raise RuntimeError(
@@ -1067,7 +1147,7 @@ class FleetScheduler:
             # boundary (no spool write); the two-tier pool keeps the
             # historical direct-to-spool path.
             warm_mode = pool.warm.budget > 0
-            while b.n_free < len(pending):
+            while b.n_free_live < len(pending):
                 victim = self._pick_victim(cls, selected, selected_all)
                 vrec = pool.docs[victim]
                 plan.evictions.append((victim, cls, vrec.row))
@@ -1107,7 +1187,12 @@ class FleetScheduler:
             for rt_total in pool.tiers(cls):
                 rt = rt_total // b.n_sh
                 fb = [
+                    # non-live shards never receive installs or relocs:
+                    # their free rows are withdrawn from the tier's
+                    # budget (tiers degrade to full-R while a draining
+                    # shard still holds scheduled high rows)
                     sorted(l for l in b.free_locals(s) if l < rt)
+                    if b.live[s] else []
                     for s in range(b.n_sh)
                 ]
                 high = [[] for _ in range(b.n_sh)]
@@ -1279,6 +1364,12 @@ class FleetScheduler:
             d for d, s in self.streams.items()
             if s.remaining > 0 and s.delivered is not None
         )
+        if self.overflow_policy == "shed" and self.reshard is not None:
+            # admission stays open during a reshard: a doc mid-move
+            # briefly defers, it is NEVER the shed victim
+            migrating = self.reshard.migrating_docs()
+            if migrating:
+                cands = [d for d in cands if d not in migrating]
         if not cands:
             return  # stays pending; retried next round
         deep = [d for d in cands
@@ -1845,6 +1936,9 @@ class FleetScheduler:
                 cls, doc_w, len_w, nvis_w,
                 dirty_rows=[row for _d, row, _s in items],
             )
+        # drained-doc reclamation rides the same boundary: disk work
+        # belongs here, not in the per-doc drain notification
+        self._flush_drained_gc()
 
     # ---- dispatch + mirrors ----
 
@@ -2126,6 +2220,11 @@ class FleetScheduler:
             # burn rates + top-K slowest docs with segment breakdowns
             # (pure host arithmetic over pre-registered state, G013)
             out["slo"] = self.slo.status_fields()
+        if self.reshard is not None:
+            # live migration view: state machine position, pending doc
+            # count, moved/deferred tallies (the gauges mirror these
+            # on /metrics as serve.reshard.*)
+            out["reshard"] = self.reshard.status_fields()
         return out
 
     # ---- driver ----
@@ -2170,6 +2269,18 @@ class FleetScheduler:
                     plan = self._plan()
                 if plan is None:
                     return False
+                if self.reshard is not None \
+                        and self.reshard.state != "done":
+                    # placed plan in hand, WAL record not yet written:
+                    # the coordinator's migrations join THIS round's
+                    # boundary compose, and the journal sees the round
+                    # only after every move decision is in it
+                    with span("serve.reshard"):
+                        self.reshard.tick(
+                            plan.base_round, plan,
+                            imbalance=self._shard_imbalance(),
+                            note_deferred=self._note_reshard_deferred,
+                        )
                 if rt.armed:
                     # the lane set is final: publishes from here to the
                     # drain fence carry exactly these docs' data, so
@@ -2248,6 +2359,12 @@ class FleetScheduler:
             self._pending_round = (
                 time.perf_counter() - t0, compiled, self._snapped
             )
+            if self.reshard is not None:
+                # mid-reshard tail visibility: rounds served while the
+                # move is in flight feed the artifact's reshard block
+                self.reshard.note_round_latency(
+                    time.perf_counter() - t0
+                )
             if self.profiler is not None:
                 self.profiler.round_end(
                     steady=not compiled and not self._snapped
@@ -2281,6 +2398,15 @@ class FleetScheduler:
                 dt + time.perf_counter() - tail0, c, b
             )
         self._flush_round()
+        if self.reshard is not None and self.done:
+            # BEFORE the fault sweep: a crashed coordinator resumes and
+            # commits here, closing its reshard_crash event as a real
+            # recovery — finalize_faults must never sweep it as merely
+            # "terminal".  An interrupted run (crash round) skips this
+            # and leaves the manifest for recover_fleet's roll-forward.
+            with span("serve.reshard.finalize"):
+                self.reshard.finalize(self.round)
+        self._flush_drained_gc(force=True)
         if self.faults is not None and self.done:
             # gate on DONE, not on max_rounds: a --serve-crash-round
             # larger than the natural drain length completes the drain,

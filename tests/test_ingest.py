@@ -524,3 +524,49 @@ def test_open_loop_drain_end_to_end(tmp_path):
     assert ing["deadline"]["met"] + ing["deadline"]["missed"] == 12
     # the ingest surface is armed AND published in the crossings map
     assert d["extra"]["thread_crossings"]["ingest"] is True
+
+
+def test_dead_listener_exhausts_retry_budget_with_typed_error():
+    """The regression the backoff satellite pins: a client pointed at a
+    port nobody listens on must NOT spin forever (nor crash with a raw
+    socket error) — it burns its capped, jittered retry budget and
+    surfaces a typed ``RetryBudgetExceeded`` naming the session, the
+    attempt count, and the last transport error."""
+    import socket
+    import time as _time
+
+    from crdt_benches_tpu.serve.ingest.loadgen import (
+        OpenLoadClient,
+        OpenLoadPlan,
+        RetryBudgetExceeded,
+        _SessionLoad,
+    )
+
+    # bind-then-close: a port that is guaranteed dead right now
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    plan = OpenLoadPlan(
+        [_SessionLoad("s0", 0, "default", [(0, 0, 4)]),
+         _SessionLoad("s1", 1, "default", [(0, 0, 4)])],
+        rate=8.0, process="poisson", seed=3, total_ops=8, horizon=1,
+    )
+    client = OpenLoadClient(port, plan, shards=1, connect_timeout=0.2,
+                            retry_base=0.0005, retry_cap=0.002,
+                            retry_budget=6)
+    t0 = _time.monotonic()
+    client.start()
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        client.join(timeout=30.0)
+    # the budget bounds wall time: 6 capped 2ms sleeps, not minutes
+    assert _time.monotonic() - t0 < 10.0
+    err = ei.value
+    assert err.session == "s0" and err.doc == 0
+    assert err.attempts == 6  # the whole budget, no more
+    assert err.last_error  # the transport cause is carried, not eaten
+    assert "retry budget exhausted" in str(err)
+    # the shard abandoned its remaining sessions instead of burning a
+    # fresh budget per session against a front known to be dead
+    assert client.sent_frames == 0

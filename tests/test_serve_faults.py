@@ -291,3 +291,39 @@ def test_bench_chaos_artifact_and_gates(tmp_path):
     assert ex["journal"]["snapshots"] >= 1
     assert ex["shed_ops"] == 0  # defer policy: chaos without data loss
     assert ex["verify_ok"] is True
+
+
+def test_device_loss_under_tiered_pool_rebuilds_all_tiers(tmp_path):
+    """``device_loss`` on a TIERED lazy fleet mid-drain: the warm tier
+    is host memory the loss cannot touch, a still-genesis doc has no
+    device state to lose at all, and every lost hot row rebuilds at its
+    applied cursor — the drain converges to oracle parity across all
+    four residency tiers."""
+    from crdt_benches_tpu.serve.scheduler import LazyStreams
+    from crdt_benches_tpu.serve.workload import FleetSpec
+
+    spec = FleetSpec.build(8, mix=TINY_MIX, seed=9, arrival_span=3,
+                           bands=TINY_BANDS)
+    pool = DocPool(classes=(128,), slots=(2,),
+                   spool_dir=str(tmp_path / "spool"), warm_docs=4)
+    streams = LazyStreams(spec, pool, batch=8, batch_chars=32)
+    plan = FaultPlan([FaultEvent(kind="device_loss", round=3)], seed=5)
+    sched = FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32, faults=FaultInjector(plan))
+    assert pool.genesis_docs == 8  # a lazy fleet is born fully genesis
+    sched.run()
+    assert sched.done and streams.all_done
+    (ev,) = plan.events
+    assert ev.fired and ev.recovered
+    assert ev.detail["docs"] >= 1
+    assert sched.stats.recoveries >= 1
+    ts = pool.tier_status()
+    assert ts["genesis_docs"] == 0  # every doc materialized post-loss
+    # 8 docs over 2 hot rows with a 4-entry warm tier: demotions land
+    # warm, so the loss round had host-side state to rebuild from
+    assert ts["warm_evictions"] + ts["warm_hits"] + len(pool.warm) > 0
+    for d in range(spec.n_docs):
+        s = spec.session(d)
+        assert pool.decode(d) == replay_trace(s.trace), (
+            f"doc {d} diverged"
+        )

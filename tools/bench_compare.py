@@ -153,10 +153,15 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: before the block existed skip-with-note one-sided, and a
 #: stream-vs-eager pair skips the numeric gates (mode mismatch), so
 #: both directions diff cleanly.
+#: ``reshard`` is the elastic-reconfiguration block (``--serve-reshard``
+#: runs: live shard-map change mid-drain) — both-directions skip: a
+#: resharding run diffed against a fixed-map baseline (or vice versa)
+#: is a family difference, never an error, and a shrink-vs-grow pair
+#: skips the mid-reshard latency gate (kind mismatch).
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
                     "recovery", "residency", "fs_ops", "ingest",
-                    "knee", "construction")
+                    "knee", "construction", "reshard")
 
 
 def _tier_hit_rate(extra: dict) -> float | None:
@@ -224,6 +229,56 @@ def _construction_checks(new: dict, base: dict,
             max_rss_regress, higher_is_better=False,
             skip_note="construction block missing in at least one "
                       "artifact",
+        ),
+    ]
+
+
+def _reshard_kind(extra: dict) -> str | None:
+    """``"shrink"`` / ``"grow"`` / ``"drain"`` from the ``reshard``
+    block (elastic reconfiguration, ``--serve-reshard`` runs); None
+    for fixed-shard-map artifacts."""
+    r = extra.get("reshard")
+    return r.get("kind") if isinstance(r, dict) else None
+
+
+def _reshard_mid_p99(extra: dict) -> float | None:
+    """Mid-reshard round p99 in seconds — the latency of macro-rounds
+    SERVED WHILE the shard-map change was in flight, the number the
+    "no downtime" claim lives or dies on (the end-of-run p99 averages
+    the migration window away).  None when the artifact carries no
+    ``reshard`` block or the move never spanned a served round."""
+    r = extra.get("reshard")
+    if not isinstance(r, dict):
+        return None
+    lat = r.get("mid_latency")
+    return lat.get("p99") if isinstance(lat, dict) else None
+
+
+def _reshard_checks(new: dict, base: dict,
+                    max_reshard_p99_regress: float) -> list[Check]:
+    """The elastic-reconfiguration gate: mid-reshard round p99,
+    one-sided skip-with-note like recovery — and skipped (with the
+    kinds named) when the two artifacts ran different shard-map
+    changes, since the tail under a shrink (docs funneling onto fewer
+    shards) and under a grow (an emptier map absorbing moves) are
+    incomparable by design, not a regression.  The worst-class
+    SLO-burn leg of the reshard gate is the ordinary ``slo`` check —
+    both reshard artifacts carry an slo block, so violation growth
+    during the migration window fails there."""
+    nk, bk = _reshard_kind(new), _reshard_kind(base)
+    if nk is not None and bk is not None and nk != bk:
+        return [Check(
+            "mid-reshard round p99 (s)", "skip",
+            note=(f"reshard kind differs ({nk} vs {bk}): the tail "
+                  "under a shrink and under a grow are incomparable "
+                  "by design"),
+        )]
+    return [
+        _regress(
+            "mid-reshard round p99 (s)",
+            _reshard_mid_p99(new), _reshard_mid_p99(base),
+            max_reshard_p99_regress, higher_is_better=False,
+            skip_note="reshard block missing in at least one artifact",
         ),
     ]
 
@@ -373,7 +428,8 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
             max_journal_disk_regress: float = 40.0,
             max_hit_rate_regress: float = 25.0,
             max_construction_regress: float = 60.0,
-            max_rss_regress: float = 40.0) -> list[Check]:
+            max_rss_regress: float = 40.0,
+            max_reshard_p99_regress: float = 60.0) -> list[Check]:
     # open-loop artifacts (--serve-open) invert what the headline
     # numbers mean: throughput TRACKS the offered load (the client
     # decides it, not the engine), so gating it is meaningless — the
@@ -482,6 +538,9 @@ def compare(new: dict, base: dict, *, max_throughput_regress: float,
     # mismatch or a pre-block artifact skips-with-note, never errors)
     checks.extend(_construction_checks(
         new, base, max_construction_regress, max_rss_regress))
+    # elastic-reconfiguration gate: the mid-reshard tail (kind
+    # mismatch or a fixed-map artifact skips-with-note, never errors)
+    checks.extend(_reshard_checks(new, base, max_reshard_p99_regress))
     checks.extend(_block_presence_checks(new, base))
     return checks
 
@@ -553,6 +612,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="max tolerated peak-RSS growth (construction "
                          "block; same mode-mismatch skip as the "
                          "construction-time gate)")
+    ap.add_argument("--max-reshard-p99-regress", type=float,
+                    default=60.0, metavar="PCT",
+                    help="max tolerated increase of the mid-reshard "
+                         "round p99 — the rounds served WHILE the "
+                         "shard-map change was in flight (reshard "
+                         "block; skipped on a shrink-vs-grow kind "
+                         "mismatch — the migration-window tails are "
+                         "incomparable)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -578,6 +645,7 @@ def main(argv: list[str] | None = None) -> int:
         max_hit_rate_regress=args.max_hit_rate_regress,
         max_construction_regress=args.max_construction_regress,
         max_rss_regress=args.max_rss_regress,
+        max_reshard_p99_regress=args.max_reshard_p99_regress,
     )
     failed = [c for c in checks if c.status == "fail"]
     if args.json:

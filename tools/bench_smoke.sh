@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Sub-minute bench smoke for CI, runnable alongside tools/tier1.sh.
 #
-# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier|serve-stream|serve-open]   (repo root)
+# Usage: tools/bench_smoke.sh [--family serve|serve-repl|serve-faults|serve-soak|serve-longhaul|serve-tier|serve-stream|serve-open|serve-reshard]   (repo root)
 #
 # The serve family (the default) drains a tiny document fleet through the
 # macro-round engine (K=4) on host CPU and exits NONZERO when the in-run
@@ -79,6 +79,21 @@
 # reconnect-and-resume) + tenant_flood (admission must defer/shed and
 # drain the backlog) — the runner exits nonzero on a verify failure or
 # an unfired/unrecovered ingest fault.
+#
+# The serve-reshard family is the ELASTIC-RECONFIGURATION smoke: a
+# 2-shard fleet drained race-sanitized while a live shrink:2:1 retires
+# shard 1 mid-run — every migration journaled, admission open
+# throughout — with reshard_crash armed so the coordinator is killed
+# between its manifest commit and the per-doc moves and MUST resume
+# deterministically.  A sidecar scrapes the serve.reshard.* gauges on
+# the LIVE /metrics endpoint WHILE the move is in flight, the
+# mid-reshard round p99 is gated by bench_compare against the
+# committed bench_results/serve_reshard_baseline.json (plus the
+# both-directions skip contract vs a fixed-map artifact), G017
+# cross-checks the race artifact, and an fs-sanitized second leg
+# proves the reshard durable protocol under G021.  Exits NONZERO on a
+# verify failure, a shard-partition violation, an unfired/unrecovered
+# reshard_crash, a missed mid-move scrape, or an unattributed fs op.
 #
 # Artifacts land in bench_results/ under smoke-specific names so they
 # never clobber committed headline numbers.
@@ -978,8 +993,188 @@ print(f"open chaos: churn dropped {ing['front']['churn_drops']} conns, "
       f"({tc['publishes']['IngestFront._publish']} entries)")
 PYEOF
     ;;
+  serve-reshard)
+    # Leg 1: the live shrink under chaos, race-sanitized, status
+    # server on an ephemeral port.  24 docs on 2 logical shards;
+    # shrink:2:1 begins at round 4 with batch=2 so the migration spans
+    # several served rounds (the sidecar's mid-move window), and
+    # reshard_crash@4 kills the coordinator between its manifest
+    # commit and the first per-doc move — the next round's tick must
+    # resume from the journaled manifest, finish the moves, retire
+    # shard 1, and the drain must stay verify-green with the
+    # partition invariant intact (the runner exits nonzero otherwise).
+    rm -f bench_results/serve_reshard_smoke.log
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_RACES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 3 \
+        --serve-reshard "shrink:2:1@4,batch=2" \
+        --serve-faults "seed=5,reshard_crash@4=1" \
+        --serve-status 0 --serve-slo "default=p99:60000" \
+        --serve-save-name serve_reshard_smoke \
+        2> >(tee bench_results/serve_reshard_smoke.log >&2) &
+    reshard_pid=$!
+    # Mid-move sidecar: the serve.reshard.* gauges must render on the
+    # LIVE /metrics endpoint WHILE the migration is in flight —
+    # serve_reshard_active flips to 1 at the manifest commit and stays
+    # up until the retire, so catching it mid-run (with pending docs
+    # still counting down) proves the fleet was serving and observable
+    # DURING the shard-map change, not just around it.
+    python - <<'PYEOF'
+import re, sys, time, urllib.request
+
+log = "bench_results/serve_reshard_smoke.log"
+port = None
+deadline = time.time() + 120
+while time.time() < deadline:
+    try:
+        m = re.search(r"status server on http://127\.0\.0\.1:(\d+)",
+                      open(log, encoding="utf-8").read())
+    except OSError:
+        m = None
+    if m:
+        port = int(m.group(1))
+        break
+    time.sleep(0.25)
+assert port, "reshard smoke: status server never announced its port"
+base = f"http://127.0.0.1:{port}"
+seen, err = [], None
+deadline = time.time() + 150
+while time.time() < deadline:
+    try:
+        text = urllib.request.urlopen(base + "/metrics", timeout=2).read().decode()
+        act = re.search(r"^serve_reshard_active (\d+)", text, re.M)
+        pend = re.search(r"^serve_reshard_pending_docs (\d+)", text, re.M)
+        drn = re.search(r"^serve_reshard_draining_shards (\d+)", text, re.M)
+        if act:
+            seen.append((int(act.group(1)),
+                         int(pend.group(1)) if pend else -1,
+                         int(drn.group(1)) if drn else -1))
+        if act and act.group(1) == "1":
+            assert pend and drn, f"reshard gauges incomplete mid-move: {seen[-1]}"
+            assert int(drn.group(1)) >= 1, seen[-1]
+            print(f"reshard scrape ok: mid-move /metrics shows active=1, "
+                  f"pending_docs={pend.group(1)}, "
+                  f"draining_shards={drn.group(1)} "
+                  f"({len(seen)} scrapes to catch it)")
+            break
+    except (OSError, AssertionError) as e:  # not serving yet: retry
+        err = e
+    time.sleep(0.05)
+else:
+    sys.exit(f"reshard scrape: never saw serve_reshard_active=1 mid-run "
+             f"(observed {seen[-5:]!r}, last error {err!r})")
+PYEOF
+    wait "$reshard_pid"
+    # The elastic-reconfiguration regression gate: mid-reshard round
+    # p99 (+ the worst-class SLO burn riding the ordinary slo check)
+    # vs the committed baseline — same recipe, run plain, so the
+    # thresholds are loose where the smoke leg pays the sanitizer +
+    # chaos overhead on a compile-dominated 24-doc drain.
+    python tools/bench_compare.py \
+      bench_results/serve_reshard_smoke.json \
+      bench_results/serve_reshard_baseline.json \
+      --max-throughput-regress 60 --max-p99-regress 200 \
+      --max-drain-p999-regress 200 --max-reshard-p99-regress 300
+    # ...and the reshard block must diff skip-with-note in BOTH
+    # directions against a fixed-shard-map artifact — a family
+    # difference, never an error (exit 0, not 2; the other thresholds
+    # are moot, the runs are different scales — the point is the
+    # schema).
+    python tools/bench_compare.py \
+      bench_results/serve_reshard_smoke.json \
+      bench_results/serve_baseline.json \
+      --max-throughput-regress 100 --max-p99-regress 100000 \
+      --max-syncs-regress 100000 --max-drain-p999-regress 100000
+    python tools/bench_compare.py \
+      bench_results/serve_baseline.json \
+      bench_results/serve_reshard_smoke.json \
+      --max-throughput-regress 100 --max-p99-regress 100000 \
+      --max-syncs-regress 100000 --max-drain-p999-regress 100000
+    # G017 vs the race artifact: the reshard runs on the scheduler
+    # thread, so the cross-check proves the migration added no
+    # undeclared cross-thread handoff anywhere on the
+    # gauge -> registry -> scrape path it was observed through.
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G017 \
+      --thread-artifact bench_results/serve_reshard_smoke.json
+    python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_reshard_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+assert x["verify_ok"], "reshard smoke failed oracle byte-verify"
+rs = x["reshard"]
+assert rs is not None, "reshard block missing from the artifact"
+assert rs["kind"] == "shrink" and rs["state"] == "done", rs
+assert rs["partition_errors"] == [], rs["partition_errors"]
+assert rs["live_shards"] == 1, rs
+# the move was real work, spread over served rounds: docs left the
+# retiring shard (by row move or demotion), lanes deferred briefly
+# (never shed), and the mid-reshard latency window is non-empty
+assert rs["migrated"] + rs["evicted"] > 0, rs
+assert rs["rounds_active"] >= 2, rs
+assert rs["mid_latency"], rs
+# the chaos contract: the coordinator was killed after its manifest
+# commit and the NEXT tick resumed from the journal
+f = {e["kind"]: e for e in x["faults"]["events"]}
+assert f["reshard_crash"]["fired"] and f["reshard_crash"]["recovered"], f
+assert rs["resumes"] >= 1, rs
+tc = x["thread_crossings"]
+assert tc["sanitized"], tc
+assert set(tc["crossings"] or {}) <= set(tc["publishes"]), tc
+g = x["metrics"]["gauges"]
+for name in ("serve.reshard.active", "serve.reshard.draining_shards",
+             "serve.reshard.pending_docs"):
+    assert name in g, (name, sorted(g))
+print(f"reshard smoke: shrink 2->1 live ({rs['migrated']} row moves + "
+      f"{rs['evicted']} demotions over {rs['rounds_active']} served "
+      f"rounds, {rs['deferred_lanes']} lanes deferred, 0 shed); "
+      f"reshard_crash fired + resumed ({rs['resumes']} resumes), "
+      f"partition invariant clean, verify green")
+PYEOF
+    # Leg 2: the same shrink under CRDT_BENCH_SANITIZE_FS=1 — every fs
+    # op of the reshard protocol (manifest tmp-write -> fsync ->
+    # rename -> dir fsync, and the retire record) attributed live,
+    # G019 orderings enforced at the callsite, then G021 cross-checks
+    # the emitted fs_ops block in both directions: the `reshard`
+    # protocol must have real runtime entries (a dead declaration
+    # fails) and no fs op may go unattributed.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CRDT_BENCH_SANITIZE_FS=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 12 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 4 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 16,6,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 6 \
+        --serve-journal auto --serve-snapshot-every 3 \
+        --serve-reshard "shrink:2:1@2,batch=2" \
+        --serve-save-name serve_reshard_fs_smoke
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G021 \
+      --fs-artifact bench_results/serve_reshard_fs_smoke.json
+    exec python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_reshard_fs_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+x = extras[0]
+assert x["verify_ok"], "reshard fs smoke failed oracle byte-verify"
+assert x["reshard"] and x["reshard"]["state"] == "done", x["reshard"]
+assert x["reshard"]["partition_errors"] == [], x["reshard"]
+fo = x["fs_ops"]
+assert fo["sanitized"] and fo["reshard"], fo
+assert fo["protocols"].get("reshard", 0) > 0, fo["protocols"]
+assert fo["unattributed"] == {}, fo["unattributed"]
+assert set(fo["ops"]) <= set(fo["protocols"]), (fo["ops"], fo["protocols"])
+print(f"reshard fs leg: {fo['protocols']['reshard']} reshard protocol "
+      f"entries attributed ({sum(fo['protocols'].values())} total), "
+      "zero unattributed, G021 clean both directions")
+PYEOF
+    ;;
   *)
-    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier, serve-stream, serve-open)" >&2
+    echo "unknown family: $family (expected: serve, serve-repl, serve-faults, serve-soak, serve-longhaul, serve-tier, serve-stream, serve-open, serve-reshard)" >&2
     exit 2
     ;;
 esac
