@@ -678,6 +678,7 @@ def run_serve(args) -> int:
             ("--serve-recover", args.serve_recover),
             ("--serve-crash-round", args.serve_crash_round > 0),
             ("--serve-reshard", args.serve_reshard is not None),
+            ("--serve-record-evict", args.serve_record_evict),
             ("--serve-mesh", args.serve_mesh > 1),
             ("--serve-tiers", args.serve_tiers is not None),
             ("--serve-queue-cap", args.serve_queue_cap > 0),
@@ -783,6 +784,14 @@ def run_serve(args) -> int:
             )
             return 2
 
+    if args.serve_record_evict and args.serve_journal is not None:
+        print(
+            "--serve-record-evict requires a journal-less drain: "
+            "recovery re-adopts the spool members the GC reclaims",
+            file=sys.stderr,
+        )
+        return 2
+
     if args.serve_stream_scaling is not None and (
             args.serve_soak is not None
             or args.serve_open_sweep is not None):
@@ -820,6 +829,7 @@ def run_serve(args) -> int:
         measure_recovery=args.serve_recover,
         crash_after=args.serve_crash_round,
         reshard_spec=args.serve_reshard,
+        record_evict=args.serve_record_evict,
         faults=args.serve_faults,
         queue_cap=args.serve_queue_cap,
         overflow_policy=args.serve_overflow_policy,
@@ -1105,6 +1115,13 @@ def main(argv=None) -> int:
                          "imbalance=X (PR 7 gauge trigger).  Requires "
                          "--serve-journal; its own bench family "
                          "serve/reshard/<mix>/<fleet>")
+    ap.add_argument("--serve-record-evict", action="store_true",
+                    help="reclaim drained docs' pool records + spool "
+                         "members mid-drain (two-phase GC, "
+                         "serve/pool.py gc_drained_docs): steady-state "
+                         "footprint tracks the ACTIVE set, not the "
+                         "fleet.  Journal-less drains only (recovery "
+                         "re-adopts spool members)")
     ap.add_argument("--serve-queue-cap", type=int, default=0,
                     help="bound each doc's pending op queue (0 = "
                          "unbounded legacy behavior; overflow past the "

@@ -6,6 +6,7 @@
                                     [--sync-artifact bench.json]
                                     [--thread-artifact bench.json]
                                     [--fs-artifact bench.json]
+                                    [--lifecycle-artifact bench.json]
 
 Exits nonzero when any finding survives suppression (CI gates on this);
 ``--format sarif`` emits SARIF 2.1.0 for CI annotation surfaces with
@@ -35,6 +36,13 @@ cross-thread-access counters) is cross-checked against the static
 sanitizer's per-protocol entry and op counters) is cross-checked
 against the static ``# graftlint: durable=`` protocol markers — dead
 declared protocols and unattributed runtime fs ops both fail.
+
+``--lifecycle-artifact`` is G025's: the artifact's ``lifecycle`` block
+(the lifecycle sanitizer's state-machine transition and resource
+acquire/release counters) is cross-checked against the static
+``# graftlint: state=`` / ``acquire=`` / ``release=`` markers — dead
+declared machines/resources and unattributed runtime transitions both
+fail.
 
 ``--boundaries`` dumps the jit-boundary contract registry as JSON by
 importing the package modules that declare them (the only mode that
@@ -134,6 +142,11 @@ def main(argv: list[str] | None = None) -> int:
              "cross-check (fs_ops block)",
     )
     ap.add_argument(
+        "--lifecycle-artifact", default=None, metavar="JSON",
+        help="serve bench artifact for the G025 lifecycle machine/"
+             "resource cross-check (lifecycle block)",
+    )
+    ap.add_argument(
         "--boundaries", action="store_true",
         help="dump the jit-boundary contract registry as JSON and exit",
     )
@@ -187,6 +200,7 @@ def main(argv: list[str] | None = None) -> int:
         paths, select=select, sync_artifact=args.sync_artifact,
         thread_artifact=args.thread_artifact,
         fs_artifact=args.fs_artifact,
+        lifecycle_artifact=args.lifecycle_artifact,
     )
     out = (
         format_json(findings) if args.format == "json"

@@ -633,13 +633,15 @@ ARTIFACT_RULES = {
     "G011": ("sync_artifact", "--sync-artifact"),
     "G017": ("thread_artifact", "--thread-artifact"),
     "G021": ("fs_artifact", "--fs-artifact"),
+    "G025": ("lifecycle_artifact", "--lifecycle-artifact"),
 }
 
 
 def run_lint(paths: list[str], select: set[str] | None = None,
              sync_artifact: str | None = None,
              thread_artifact: str | None = None,
-             fs_artifact: str | None = None) -> list[Finding]:
+             fs_artifact: str | None = None,
+             lifecycle_artifact: str | None = None) -> list[Finding]:
     """Run the rule suite over ``paths``.  ``sync_artifact`` names a
     serve bench artifact (or raw ``boundary_syncs`` JSON) to enable the
     G011 fence-cost cross-check — without it G011 is skipped (it has no
@@ -647,13 +649,17 @@ def run_lint(paths: list[str], select: set[str] | None = None,
     ``thread_artifact`` is the same for G017's ``thread_crossings``
     publish-point cross-check (usually the same artifact file);
     ``fs_artifact`` for G021's ``fs_ops`` durable-protocol cross-check
-    (the fs sanitizer's per-protocol op counters)."""
+    (the fs sanitizer's per-protocol op counters);
+    ``lifecycle_artifact`` for G025's ``lifecycle`` machine/resource
+    cross-check (the lifecycle sanitizer's transition and
+    acquire/release counters)."""
     from . import rules as _rules
 
     artifacts = {
         "sync_artifact": sync_artifact,
         "thread_artifact": thread_artifact,
         "fs_artifact": fs_artifact,
+        "lifecycle_artifact": lifecycle_artifact,
     }
     index, findings = build_index(paths)
     for rule_id, fn in _rules.RULES.items():

@@ -1,0 +1,341 @@
+"""Runtime lifecycle sanitizer: the dynamic half of the G022-G025
+lifecycle & ownership model (lint/lifecycle.py), and the leak oracle
+behind the churn-drain harness (serve/lifecheck.py).
+
+graftlint's lifecycle rules prove *statically* that every declared
+state machine (``# graftlint: state=<machine>``) only moves along its
+declared edge graph through its declared transition functions, and
+that every declared resource acquisition (``# graftlint:
+acquire=<resource>``) is dominated by a release — but the static model
+trusts the annotations and the call-graph walk.  This module supplies
+the runtime evidence, the same architecture as the sync, race and fs
+sanitizers:
+
+- every declared transition function routes through
+  :func:`transition` (keyed ``machine, frm, to`` so runtime counters
+  line up with the static ``transition=`` markers) and counts its
+  **edges** — always, in every mode, one lock-guarded dict increment
+  per transition; likewise :func:`acquire`/:func:`release` count per
+  resource.  These counters are the ground truth the serve artifact
+  exports as its ``lifecycle`` block (lint G025 cross-validates dead
+  declared machines and unattributed runtime transitions against it,
+  G011/G017/G021's mirror);
+- with ``CRDT_BENCH_SANITIZE_LIFECYCLE=1`` the model is enforced
+  **live**: a transition along an edge missing from the declared
+  graph (:func:`declare_machine`) raises
+  :class:`UndeclaredTransitionError` at the callsite; releasing a
+  ``(resource, key)`` that is not live raises
+  :class:`DoubleReleaseError`; touching a released key
+  (:func:`touch` — e.g. reading a released stream's arrays) raises
+  :class:`UseAfterReleaseError`; a gauge observed below zero
+  (:func:`gauge` — the PR 17 prefetch-inflight underflow) raises
+  :class:`NegativeGaugeError`.  Live keys carry a **generation**
+  bumped on every re-acquire, so an id recycled by the allocator (the
+  PR 17 ``id(trace)`` cache poisoning) is a *different* live object,
+  never a stale hit;
+- :func:`assert_all_released` is the drain-end leak gate: any
+  ``(resource, key)`` still live raises :class:`LifecycleLeakError`
+  naming every leaked key — zero unreleased acquisitions is the
+  lifecheck harness's acceptance criterion.
+
+Disarmed (the default), nothing is enforced and nothing is tracked —
+the only cost anywhere is the counter bump, exactly the zero-overhead
+contract every sanitizer in this repo keeps.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ENV = "CRDT_BENCH_SANITIZE_LIFECYCLE"
+
+#: The machine vocabulary (the static rules reject any other tag).
+KNOWN_MACHINES = ("doc", "row", "spool", "stream", "session")
+
+#: The resource vocabulary for acquire/release pairing.
+KNOWN_RESOURCES = ("rows", "spool", "stream", "segment", "socket",
+                   "thread")
+
+
+class LifecycleError(RuntimeError):
+    """Base class for every armed lifecycle violation."""
+
+
+class UndeclaredTransitionError(LifecycleError):
+    """A runtime transition along an edge missing from the declared
+    state-machine graph — the static G022 model just met a
+    counterexample (the PR 18 same-round-admit migration shape)."""
+
+
+class DoubleReleaseError(LifecycleError):
+    """A release of a ``(resource, key)`` that is not live: either it
+    was already released (the duplicate-GC-enqueue shape) or it was
+    never acquired at all."""
+
+
+class UseAfterReleaseError(LifecycleError):
+    """A touch of a ``(resource, key)`` after its release — reading a
+    released stream's arrays is reading freed memory in spirit."""
+
+
+class NegativeGaugeError(LifecycleError):
+    """A paired inc/dec counter observed below zero — the PR 17
+    prefetch inflight underflow as a typed error."""
+
+
+class LifecycleLeakError(LifecycleError):
+    """Drain ended with live acquisitions: the leak the G023 static
+    pairing rule exists to prevent, caught at runtime."""
+
+
+#: Transition/acquire counts come from whatever thread runs the
+#: protocol (the prefetch worker releases off-thread), so the counter
+#: tables take a real mutex — same reasoning as fs_sanitizer._mu.
+_mu = threading.Lock()
+_machines: dict[str, dict[str, int]] = {}  # machine -> edge -> count
+_resources: dict[str, dict[str, int]] = {}  # resource -> acq/rel count
+_unattributed: list[str] = []  # transitions on undeclared machines
+_gauges: dict[str, int] = {}  # gauge -> last observed value
+
+_decls: dict[str, dict] = {}  # machine -> {"states": set, "edges": set}
+_live: dict[tuple[str, object], int] = {}  # (resource, key) -> gen
+_released: dict[tuple[str, object], int] = {}  # last released gen
+_gens: dict[tuple[str, object], int] = {}  # next generation per key
+
+_armed = False
+_forced = False  # armed explicitly (lifecheck harness), not via env
+
+_UNATTRIBUTED_CAP = 256  # bounded: a hot loop must not grow a list
+
+
+def sanitizing() -> bool:
+    """True when ``CRDT_BENCH_SANITIZE_LIFECYCLE`` arms the sanitizer.
+    Read at reset (not at import) so tests can flip it."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+def _sync_armed() -> None:
+    global _armed
+    if not _forced:
+        _armed = sanitizing()
+
+
+def armed() -> bool:
+    return _armed
+
+
+def arm() -> None:
+    """Force-arm (the lifecheck harness; tests), independent of the
+    env flag."""
+    global _armed, _forced
+    _armed = True
+    _forced = True
+
+
+def disarm() -> None:
+    global _armed, _forced
+    _armed = False
+    _forced = False
+
+
+def reset_counters() -> None:
+    """Zero the counter tables and the live-object model (each bench
+    run owns its window).  Machine declarations survive — they
+    describe the code, not the run's history.  When the env flag is
+    set the sanitizer arms HERE, eagerly, so acquisitions before the
+    first transition are tracked too."""
+    _sync_armed()
+    with _mu:
+        _machines.clear()
+        _resources.clear()
+        _unattributed.clear()
+        _gauges.clear()
+        _live.clear()
+        _released.clear()
+        _gens.clear()
+        _states.clear()
+
+
+def declare_machine(name: str, states, edges) -> None:
+    """Register a state machine's legal graph: ``states`` an iterable
+    of state names, ``edges`` an iterable of ``(frm, to)`` pairs.
+    Idempotent per name; the declaration mirrors the static
+    ``# graftlint: state=<name> states=... edges=...`` marker so the
+    runtime model and the G022 model enforce the same graph."""
+    with _mu:
+        _decls[name] = {
+            "states": frozenset(states),
+            "edges": frozenset(tuple(e) for e in edges),
+        }
+
+
+def transition(machine: str, frm: str, to: str, key=None) -> None:
+    """One state-machine edge traversal.  Counted in EVERY mode (the
+    G025 ground truth); armed, the edge must be in the declared graph
+    and — when ``key`` identifies the instance — must depart from the
+    instance's actual current state."""
+    edge = f"{frm}->{to}"
+    decl = _decls.get(machine)
+    with _mu:
+        if decl is None:
+            if len(_unattributed) < _UNATTRIBUTED_CAP:
+                _unattributed.append(f"{machine}:{edge}")
+        else:
+            t = _machines.setdefault(machine, {})
+            t[edge] = t.get(edge, 0) + 1
+    if not _armed:
+        return
+    if decl is None:
+        raise UndeclaredTransitionError(
+            f"transition `{edge}` on undeclared machine `{machine}` — "
+            f"declare_machine() it (and mirror the static "
+            f"`# graftlint: state={machine}` marker) ({_ENV}=1)"
+        )
+    if (frm, to) not in decl["edges"]:
+        raise UndeclaredTransitionError(
+            f"illegal `{machine}` transition `{edge}`: not in the "
+            f"declared edge graph "
+            f"{sorted('->'.join(e) for e in decl['edges'])} ({_ENV}=1)"
+        )
+    if key is not None:
+        k = (machine, key)
+        with _mu:
+            cur = _states.get(k)
+            if cur is not None and cur != frm:
+                raise UndeclaredTransitionError(
+                    f"`{machine}` instance {key!r} is in state "
+                    f"`{cur}`, not `{frm}` — transition `{edge}` "
+                    f"departs from a state the instance never reached "
+                    f"({_ENV}=1)"
+                )
+            _states[k] = to
+
+
+_states: dict[tuple[str, object], str] = {}  # (machine, key) -> state
+
+
+def acquire(resource: str, key) -> None:
+    """One resource acquisition.  Counted in EVERY mode; armed, the
+    ``(resource, key)`` pair becomes live under a fresh generation
+    (re-acquiring a recycled key is a NEW object, never a stale
+    hit)."""
+    with _mu:
+        t = _resources.setdefault(resource, {})
+        t["acquire"] = t.get("acquire", 0) + 1
+        if _armed:
+            k = (resource, key)
+            gen = _gens.get(k, 0) + 1
+            _gens[k] = gen
+            _live[k] = gen
+            _released.pop(k, None)
+
+
+def release(resource: str, key) -> None:
+    """One resource release.  Counted in EVERY mode; armed, releasing
+    a key that is not live is a typed error at the callsite."""
+    with _mu:
+        t = _resources.setdefault(resource, {})
+        t["release"] = t.get("release", 0) + 1
+        if not _armed:
+            return
+        k = (resource, key)
+        gen = _live.pop(k, None)
+        if gen is not None:
+            _released[k] = gen
+            return
+        prior = _released.get(k)
+    if prior is not None:
+        raise DoubleReleaseError(
+            f"double release of {resource} key {key!r} "
+            f"(generation {prior} already released) ({_ENV}=1)"
+        )
+    raise DoubleReleaseError(
+        f"release of {resource} key {key!r} that was never acquired "
+        f"({_ENV}=1)"
+    )
+
+
+def touch(resource: str, key) -> None:
+    """Assert a resource is live before use — armed, touching a
+    released key raises at the callsite (use-after-release); a key the
+    model has never seen is out of jurisdiction and passes."""
+    if not _armed:
+        return
+    k = (resource, key)
+    with _mu:
+        live = k in _live
+        was_released = _released.get(k)
+    if not live and was_released is not None:
+        raise UseAfterReleaseError(
+            f"use of {resource} key {key!r} after its release "
+            f"(generation {was_released}) ({_ENV}=1)"
+        )
+
+
+def generation(resource: str, key) -> int | None:
+    """The live generation of ``(resource, key)``, or None — cache
+    layers key entries as ``(key, generation(...))`` so a recycled id
+    can never alias a dead object's entry."""
+    with _mu:
+        return _live.get((resource, key))
+
+
+def gauge(name: str, value: int) -> None:
+    """Observe a paired inc/dec counter.  Recorded in every mode;
+    armed, a negative observation is the PR 17 underflow as a typed
+    error."""
+    with _mu:
+        _gauges[name] = value
+    if _armed and value < 0:
+        raise NegativeGaugeError(
+            f"gauge `{name}` observed at {value} — an inc/dec "
+            f"imbalance drove a paired counter negative ({_ENV}=1)"
+        )
+
+
+def live_count(resource: str | None = None) -> int:
+    """Live (unreleased) acquisitions, optionally for one resource —
+    only meaningful armed (disarmed, nothing is tracked)."""
+    with _mu:
+        if resource is None:
+            return len(_live)
+        return sum(1 for (r, _k) in _live if r == resource)
+
+
+def live_keys() -> list[tuple[str, object]]:
+    with _mu:
+        return sorted(_live, key=repr)
+
+
+def assert_all_released() -> None:
+    """The drain-end leak gate: every acquisition released, or a
+    :class:`LifecycleLeakError` naming the leaked keys."""
+    with _mu:
+        leaked = sorted(_live, key=repr)
+    if leaked:
+        raise LifecycleLeakError(
+            f"{len(leaked)} unreleased acquisition(s) at drain end: "
+            + ", ".join(f"{r}:{k!r}" for r, k in leaked[:20])
+            + (" ..." if len(leaked) > 20 else "")
+        )
+
+
+def counters() -> dict:
+    """Snapshot: ``{"machines": {m: {edge: n}}, "resources": {r:
+    {"acquire": n, "release": n}}, "gauges": {name: last},
+    "unattributed": [...]}``.  Machine/resource tables are populated
+    in every mode (the G025 ground truth)."""
+    with _mu:
+        return {
+            "machines": {
+                m: dict(sorted(t.items()))
+                for m, t in sorted(_machines.items())
+            },
+            "resources": {
+                r: dict(sorted(t.items()))
+                for r, t in sorted(_resources.items())
+            },
+            "gauges": dict(sorted(_gauges.items())),
+            "unattributed": sorted(set(_unattributed)),
+        }

@@ -1,4 +1,4 @@
-"""graftlint rules G001-G021.
+"""graftlint rules G001-G025.
 
 Each rule is ``fn(index: PackageIndex) -> list[Finding]`` and is
 registered in :data:`RULES`.  Every rule is motivated by a real hazard
@@ -7,7 +7,9 @@ rule table and the incident each one encodes).  G008 lives in
 :mod:`crdt_benches_tpu.lint.flow` (the interprocedural constant pass),
 G009/G010 in :mod:`crdt_benches_tpu.lint.pallas_rules`, the
 thread-confinement suite G014-G017 in
-:mod:`crdt_benches_tpu.lint.threads`; G011 (below) cross-validates the
+:mod:`crdt_benches_tpu.lint.threads`, the lifecycle & ownership suite
+G022-G025 in :mod:`crdt_benches_tpu.lint.lifecycle`; G011 (below)
+cross-validates the
 static fence graph against a serve bench artifact's ``boundary_syncs``
 counters and only runs when the driver hands it one (G017 does the
 same for the ``thread_crossings`` publish-point counters).
@@ -36,6 +38,12 @@ from .fsops import (
     g019_durable_ordering,
     g020_verify_before_trust,
     g021_fs_protocols,
+)
+from .lifecycle import (
+    g022_state_discipline,
+    g023_acquire_release,
+    g024_identity_hazards,
+    g025_lifecycle_artifact,
 )
 from .pallas_rules import g009_pallas_grid, g010_block_lane
 from .threads import (
@@ -1003,6 +1011,12 @@ def _g013_call_finding(fi: FuncInfo, node: ast.Call, chain: str
     is_mutator = False
     if isinstance(f, ast.Attribute) and f.attr in _G013_REG_MUTATORS:
         is_mutator = True
+        if (isinstance(f.value, ast.Name)
+                and "sanitizer" in m.imports.get(f.value.id, "")):
+            # runtime-sanitizer record calls (fs/race/lifecycle) share
+            # the metric verbs but mutate no registry shape: a
+            # fixed-key dict write the status server never snapshots
+            is_mutator = False
     elif isinstance(f, ast.Name) and f.id in _G013_REG_MUTATORS:
         is_mutator = "obs.metrics" in m.imports.get(f.id, "")
     if is_mutator:
@@ -1062,4 +1076,8 @@ RULES = {
     "G019": g019_durable_ordering,
     "G020": g020_verify_before_trust,
     "G021": g021_fs_protocols,  # artifact-driven; see run_lint
+    "G022": g022_state_discipline,
+    "G023": g023_acquire_release,
+    "G024": g024_identity_hazards,
+    "G025": g025_lifecycle_artifact,  # artifact-driven; see run_lint
 }

@@ -48,7 +48,12 @@ from ..bench.harness import (
     save_results,
     summarize,
 )
-from ..lint import fs_sanitizer, race_sanitizer, sanitizer
+from ..lint import (
+    fs_sanitizer,
+    lifecycle_sanitizer,
+    race_sanitizer,
+    sanitizer,
+)
 from ..obs import trace as obs_trace
 from ..obs.anomaly import AnomalyDetector
 from ..obs.flight import FlightRecorder
@@ -287,6 +292,7 @@ def run_serve_bench(
     measure_recovery: bool = False,
     crash_after: int = 0,
     reshard_spec: str | None = None,
+    record_evict: bool = False,
     open_spec: str | None = None,
     tenants_spec: str | None = None,
     deadline: bool = False,
@@ -624,6 +630,17 @@ def run_serve_bench(
         fs_sanitized = fs_sanitizer.sanitizing()
         if fs_sanitized:
             log("serve: fs sanitizer ARMED (CRDT_BENCH_SANITIZE_FS)")
+        # lifecycle ground truth (lint G025's lifecycle block): state-
+        # machine edge + ownership acquire/release counters, reset per
+        # drain; with CRDT_BENCH_SANITIZE_LIFECYCLE=1 illegal edges,
+        # wrong-state departures, double releases, use-after-release
+        # and gauge underflows raise typed errors at their callsites
+        # (lint/lifecycle_sanitizer.py)
+        lifecycle_sanitizer.reset_counters()
+        lifecycle_sanitized = lifecycle_sanitizer.armed()
+        if lifecycle_sanitized:
+            log("serve: lifecycle sanitizer ARMED "
+                "(CRDT_BENCH_SANITIZE_LIFECYCLE)")
         if journal_dir:
             fs_sanitizer.watch_root(journal_dir)
         if telemetry is not None:
@@ -729,6 +746,10 @@ def run_serve_bench(
             profiler=profiler, telemetry=telemetry,
             reqtrace=reqtrace, slo=slo,
             warm_start=True,
+            # drained-doc record eviction (--serve-record-evict): the
+            # scheduler rejects the combination with a journal itself
+            # (recovery re-adopts spool members)
+            drained_gc=record_evict,
         )
         open_plan = admission = pump = load_client = None
         if open_rate:
@@ -1297,6 +1318,34 @@ def run_serve_bench(
                 fs_counts["unattributed"] if fs_sanitized else None
             ),
         }
+        # ---- lifecycle ground truth (lint G025 cross-checks the
+        # static state-machine/ownership model against exactly this
+        # block) ----
+        lc_counts = lifecycle_sanitizer.counters()
+        lifecycle_block = {
+            "version": 1,
+            "sanitized": lifecycle_sanitized,
+            # armed surfaces (G025's dead-machine/dead-resource
+            # scoping, the G011/G021 fence-tag pattern): the pool
+            # surface arms with real tier traffic (a fleet that never
+            # leaves its rows walks no doc edges), reshard with a
+            # coordinator that actually began, stream with streaming
+            # construction, ingest with a live front, prefetch with
+            # the tiered pool's worker
+            "pool": (stats.evictions + stats.restores
+                     + pool.warm_evictions) > 0,
+            "reshard": (
+                reshard_coord is not None
+                and reshard_coord.state != "idle"
+            ),
+            "stream": stream,
+            "ingest": front is not None,
+            "journal": journal is not None,
+            "prefetch": pool.prefetcher is not None,
+            "machines": lc_counts["machines"],
+            "resources": lc_counts["resources"],
+            "unattributed": lc_counts["unattributed"],
+        }
         log(
             "serve: fs protocols — entries "
             + (", ".join(
@@ -1494,6 +1543,10 @@ def run_serve_bench(
                 "boundary_syncs": boundary_syncs,
                 "thread_crossings": thread_crossings,
                 "fs_ops": fs_ops_block,
+                # versioned lifecycle block: state-machine edge counts
+                # + ownership acquire/release ledger (lint G025's
+                # ground truth; bench_compare: skip-with-note)
+                "lifecycle": lifecycle_block,
                 # versioned typed-metric registry: every counter /
                 # gauge / histogram the drain emitted (obs/metrics.py)
                 "metrics": stats.metrics.to_dict(),

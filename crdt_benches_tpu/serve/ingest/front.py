@@ -48,6 +48,7 @@ import queue
 import threading
 import zlib
 
+from ...lint import lifecycle_sanitizer as lifecycle
 from ...lint.race_sanitizer import published, reveal, share
 
 __all__ = ["IngestFront", "encode_frame", "decode_frame", "FRAME_KINDS"]
@@ -194,7 +195,7 @@ class _Server(socketserver.ThreadingTCPServer):
     owner: "IngestFront"
 
 
-class IngestFront:
+class IngestFront:  # graftlint: state=session states=new,open,closed,dropped edges=new->open,open->closed,open->dropped
     """The sessioned op-intake server (module docstring has the wire
     and confinement contracts).
 
@@ -237,10 +238,19 @@ class IngestFront:
         self.sessions_resumed = 0
         self.sessions_closed = 0
         self.churn_drops = 0
+        # the session machine's legal graph, mirrored from the class
+        # marker (G022/G025).  Edges are counted UNKEYED: a resumed
+        # session re-enters new->open under the same name, and the
+        # handler threads race the pump — per-instance sequencing
+        # belongs to the client protocol (seq numbers), not this model.
+        lifecycle.declare_machine(
+            "session", ("new", "open", "closed", "dropped"),
+            (("new", "open"), ("open", "closed"), ("open", "dropped")),
+        )
 
     # ---- driver-side lifecycle (G013: never constructed mid-drain) --
 
-    def start(self) -> int:
+    def start(self) -> int:  # graftlint: acquire=socket
         if self._srv is not None:
             return self.port  # type: ignore[return-value]
         srv = _Server(("127.0.0.1", 0), _IngestHandler)
@@ -252,9 +262,10 @@ class IngestFront:
             kwargs={"poll_interval": 0.05},
         )
         self._thread.start()
+        lifecycle.acquire("socket", id(self))
         return self.port
 
-    def stop(self) -> None:
+    def stop(self) -> None:  # graftlint: release=socket
         if self._srv is None:
             return
         self._srv.shutdown()
@@ -263,6 +274,7 @@ class IngestFront:
             self._thread.join(timeout=5.0)
         self._srv = None
         self._thread = None
+        lifecycle.release("socket", id(self))
 
     # ---- handler surface (the ingest thread) ----
 
@@ -301,11 +313,13 @@ class IngestFront:
         ``set_health`` pattern)."""
         self.churn_gen = self.churn_gen + 1
 
-    def drain(self) -> list[dict]:  # graftlint: thread=hot
+    def drain(self) -> list[dict]:  # graftlint: thread=hot  # graftlint: transition=session:new->open,open->closed,open->dropped
         """Harvest every pending payload (never blocks).  Each one
         passes the ``reveal`` gate — the reader side of the publish
         contract — and all counters are tallied here, on the hot
-        thread that owns them."""
+        thread that owns them.  Session edges are counted here too
+        (hot side, after the crossing) so the artifact's lifecycle
+        block attributes every open/close/drop."""
         out: list[dict] = []
         while True:
             try:
@@ -322,12 +336,15 @@ class IngestFront:
                 self.sessions_opened += 1
                 if payload.get("resume"):
                     self.sessions_resumed += 1
+                lifecycle.transition("session", "new", "open")
             elif kind == "bye":
                 self.sessions_closed += 1
+                lifecycle.transition("session", "open", "closed")
             elif kind == "bad_frame":
                 self.bad_frames += 1
             elif kind == "churn_drop":
                 self.churn_drops += 1
+                lifecycle.transition("session", "open", "dropped")
             out.append(payload)
         return out
 

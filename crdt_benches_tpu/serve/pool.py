@@ -95,6 +95,7 @@ from ..ops.serve_fused import (
     serve_macro_rounds_xla,
     trivial_round_tokens,
 )
+from ..lint import lifecycle_sanitizer as lifecycle
 from ..lint.fs_sanitizer import fs_protocol
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
@@ -174,10 +175,17 @@ def decode_row_np(doc: np.ndarray, length: int, nvis: int,
 
 
 @dataclass
-class DocRecord:
+class DocRecord:  # graftlint: state=doc field=spool states=live,cold edges=live->cold,cold->live
     """Host-side bookkeeping for one document (no device syncs needed to
     schedule it: length/capacity evolve deterministically with the
-    stream, so the scheduler promotes/admits from host state alone)."""
+    stream, so the scheduler promotes/admits from host state alone).
+
+    The ``spool`` field is a declared lifecycle state machine on the
+    cold-tier axis (``live`` = no spool claim, ``cold`` = checkpointed
+    out): every write MUST route through ``DocPool._set_spool`` — the
+    ``_n_cold`` counter the tier gauges read is maintained there, so a
+    direct write silently drifts the cold-doc accounting (exactly the
+    bug G022 caught in ``admit``'s restore path)."""
 
     doc_id: int
     n_init: int
@@ -272,7 +280,7 @@ class Bucket:
     def set_live(self, shard: int, flag: bool) -> None:
         self.live[shard] = bool(flag)
 
-    def alloc_row(self) -> int:
+    def alloc_row(self) -> int:  # graftlint: acquire=rows
         """Lowest local index on the emptiest LIVE shard (ties ->
         lowest shard) — balances the mesh while packing rows toward the
         front.  Draining/retired shards never allocate."""
@@ -287,20 +295,23 @@ class Bucket:
             l = heapq.heappop(h)
             if l in self._free[s]:
                 self._free[s].discard(l)
+                lifecycle.acquire("rows", (self.C, s * self.Rg + l))
                 return s * self.Rg + l
         raise RuntimeError(f"bucket c{self.C}: free-heap drift")
 
-    def take_row(self, row: int) -> None:
+    def take_row(self, row: int) -> None:  # graftlint: acquire=rows
         """Claim a SPECIFIC free row (compaction relocations)."""
         s, l = divmod(row, self.Rg)
         if l not in self._free[s]:
             raise RuntimeError(f"bucket c{self.C}: row {row} not free")
         self._free[s].discard(l)  # heap entry invalidated lazily
+        lifecycle.acquire("rows", (self.C, row))
 
-    def release_row(self, row: int) -> None:
+    def release_row(self, row: int) -> None:  # graftlint: release=rows
         s, l = divmod(row, self.Rg)
         self._free[s].add(l)
         heapq.heappush(self._heaps[s], l)
+        lifecycle.release("rows", (self.C, row))
 
 
 @dataclass
@@ -506,6 +517,13 @@ class DocPool:
         #: rec.spool transition routes through :meth:`_set_spool`, so
         #: the per-round gauge refresh never scans the fleet
         self._n_cold = 0
+        # the doc residency machine's legal graph, mirrored from the
+        # DocRecord marker — armed runs enforce it live, every run
+        # counts its edges for the artifact's lifecycle block (G025)
+        lifecycle.declare_machine(
+            "doc", ("live", "cold"),
+            (("live", "cold"), ("cold", "live")),
+        )
         self.prefetcher: Prefetcher | None = None
         if warm_docs > 0 and prefetch:
             self.prefetcher = Prefetcher(capacity=prefetch_capacity)
@@ -715,13 +733,20 @@ class DocPool:
     def _spool_path(self, doc_id: int) -> str:
         return os.path.join(self.spool_dir, f"doc{doc_id}.npz")
 
-    def _set_spool(self, rec: DocRecord, path: str | None) -> None:
+    def _set_spool(self, rec: DocRecord, path: str | None) -> None:  # graftlint: transition=doc:live->cold,cold->live
         """THE rec.spool transition point: every move of a doc into or
         out of the cold tier goes through here so ``cold_docs`` stays
         an O(1) counter (the per-round gauge refresh must never scan a
         64k-doc fleet).  Idempotent on no-op transitions."""
         if (rec.spool is None) != (path is None):
-            self._n_cold += 1 if path is not None else -1
+            if path is not None:
+                self._n_cold += 1
+                lifecycle.transition("doc", "live", "cold",
+                                     key=rec.doc_id)
+            else:
+                self._n_cold -= 1
+                lifecycle.transition("doc", "cold", "live",
+                                     key=rec.doc_id)
         rec.spool = path
 
     def recount_cold(self) -> int:
@@ -933,10 +958,13 @@ class DocPool:
             # of the doc was gone with nothing device-resident yet —
             # under the warm tier a doc cycles warm→cold repeatedly, so
             # the window would reopen on every cycle.  The file itself
-            # is left behind (rec.spool = None marks it stale); a later
-            # re-eviction's save_state atomically replaces it, so the
-            # spool stays bounded at one file per doc.
-            rec.spool = None
+            # is left behind (clearing the claim marks it stale); a
+            # later re-eviction's save_state atomically replaces it, so
+            # the spool stays bounded at one file per doc.  The clear
+            # MUST route through _set_spool: the direct write this used
+            # to be left ``_n_cold`` permanently high — every restore
+            # leaked one phantom cold doc into the tier gauges (G022).
+            self._set_spool(rec, None)
             return out
         self.fresh_admits += 1
         return self._install(

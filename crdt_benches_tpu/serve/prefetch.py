@@ -65,6 +65,7 @@ from typing import Callable, Iterable
 
 import numpy as np
 
+from ..lint import lifecycle_sanitizer as lifecycle
 from ..lint.race_sanitizer import published, reveal, share
 from ..utils.checkpoint import load_state
 
@@ -130,18 +131,20 @@ class Prefetcher:
             n = len(seqs)
         self.lost += n
         self.inflight = max(0, self.inflight - n)
+        lifecycle.gauge("prefetch_inflight", self.inflight)
 
     # ---- driver-side lifecycle (G013: never constructed mid-drain) --
 
-    def start(self) -> None:
+    def start(self) -> None:  # graftlint: acquire=thread
         if self._thread is not None:
             return
         self._thread = threading.Thread(
             target=self._run, name="serve-prefetch", daemon=True
         )
         self._thread.start()
+        lifecycle.acquire("thread", id(self))
 
-    def stop(self) -> None:
+    def stop(self) -> None:  # graftlint: release=thread
         """Stop the worker (driver side).  Bounded waits only — a
         wedged worker is abandoned as a daemon, never joined forever."""
         if self._thread is None:
@@ -152,6 +155,7 @@ class Prefetcher:
             pass  # worker wedged mid-load: daemon thread, abandoned
         self._thread.join(timeout=5.0)
         self._thread = None
+        lifecycle.release("thread", id(self))
 
     @property
     def alive(self) -> bool:
@@ -218,6 +222,7 @@ class Prefetcher:
                 self.reap_dropped += 1
                 continue
             self.inflight -= 1
+            lifecycle.gauge("prefetch_inflight", self.inflight)
             self.harvested += 1
             if payload.get("error") is not None:
                 self.errors += 1

@@ -44,6 +44,7 @@ import json
 import os
 from dataclasses import dataclass, field
 
+from ..lint import lifecycle_sanitizer as lifecycle
 from ..lint.fs_sanitizer import fs_protocol
 from ..lint.sanitizer import fenced
 from ..utils.fsdur import fsync_dir
@@ -343,7 +344,7 @@ def check_shard_partition(pool) -> list[str]:
 # ---------------------------------------------------------------------------
 
 
-class ReshardCoordinator:
+class ReshardCoordinator:  # graftlint: state=row field=state states=idle,active,crashed,done edges=idle->active,active->crashed,crashed->active,active->done
     """Drives one shard-map change through a serving fleet.
 
     Ticked by the scheduler once per macro-round, AFTER the round's
@@ -369,6 +370,15 @@ class ReshardCoordinator:
         self.telemetry = telemetry
         self.state = "idle"
         self.reshard_id = 0
+        # the coordinator machine's legal graph, mirrored from the
+        # class marker (G022/G025): the only exit from `crashed` is a
+        # resume — a commit straight out of a crash would retire shards
+        # whose pending set was never re-derived
+        lifecycle.declare_machine(
+            "row", ("idle", "active", "crashed", "done"),
+            (("idle", "active"), ("active", "crashed"),
+             ("crashed", "active"), ("active", "done")),
+        )
         self._shards: tuple[int, ...] = self._resolve_shards()
         if plan.kind == "grow":
             # the target shards are provisioned (rows exist) but not
@@ -523,7 +533,7 @@ class ReshardCoordinator:
             return True
         return p.at_round is None and p.imbalance is None and rnd >= 2
 
-    def _begin(self, rnd: int) -> None:
+    def _begin(self, rnd: int) -> None:  # graftlint: transition=row:idle->active,active->crashed
         """The commit point: manifest first (durable decision), then
         the live shard-map flip, then the begin record.  The
         ``reshard_crash`` kill point sits immediately after — between
@@ -549,6 +559,7 @@ class ReshardCoordinator:
                 self.pool.drain_shard(s)
         self._event("begin", rnd, change=self.plan.kind,
                     shards=list(self._shards), docs=docs0)
+        lifecycle.transition("row", "idle", "active", key=id(self))
         self.state = "active"
         if self.faults is not None:
             ev = self.faults.reshard_crash_event(rnd)
@@ -560,10 +571,12 @@ class ReshardCoordinator:
                 ev.fire(rnd, stage="post_manifest_pre_moves",
                         shards=list(self._shards), docs=docs0)
                 self._crash_ev = ev
+                lifecycle.transition("row", "active", "crashed",
+                                     key=id(self))
                 self.state = "crashed"
         self._gauge_refresh(docs0)
 
-    def _resume(self, rnd: int) -> None:
+    def _resume(self, rnd: int) -> None:  # graftlint: transition=row:crashed->active
         """Deterministic in-run recovery of a crashed coordinator:
         everything needed to finish lives in the committed manifest
         and the pool's own shard map — re-read the manifest (the
@@ -578,6 +591,7 @@ class ReshardCoordinator:
         if self._crash_ev is not None:
             self._crash_ev.recover(via="coordinator_resume", round=rnd)
             self._crash_ev = None
+        lifecycle.transition("row", "crashed", "active", key=id(self))
         self.state = "active"
 
     def _migrate_batch(self, rnd: int, plan, pending, note_deferred
@@ -662,7 +676,7 @@ class ReshardCoordinator:
             return ops
         return 0
 
-    def _commit(self, rnd: int) -> None:
+    def _commit(self, rnd: int) -> None:  # graftlint: transition=row:active->done
         """The draining shards are empty: retire them, journal the
         commit record, retire the manifest (read-witnessed unlink)."""
         retired: list[int] = []
@@ -679,6 +693,7 @@ class ReshardCoordinator:
             migrated=self.migrated, evicted=self.evicted,
         )
         retire_manifest(self.journal.dir)
+        lifecycle.transition("row", "active", "done", key=id(self))
         self.state = "done"
         self._gauge_refresh(0)
 

@@ -78,6 +78,7 @@ from functools import partial
 
 import numpy as np
 
+from ..lint import lifecycle_sanitizer as lifecycle
 from ..lint.sanitizer import entries_total, fenced, hot_path
 from ..obs.metrics import (
     DEPTH_BUCKETS,
@@ -250,14 +251,19 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
     range checking that raises rather than wraps — means staging copies
     narrow-to-narrow and a macro round uploads half the bytes."""
     streams: dict[int, DocStream] = {}
-    cache: dict[int, tuple] = {}  # id(trace) -> (arrays, rt)
+    # id(trace)-keyed, G024-shaped — made safe by PINNING the trace
+    # object in the cache value: a pinned id can never be freed and
+    # recycled for the cache's lifetime, and the identity check
+    # re-verifies the pin on every hit (the lazy path's cache poisoning
+    # incident, closed at the eager path too).
+    cache: dict[int, tuple] = {}  # id(trace) -> (trace pin, (arrays, rt))
     for s in sessions:
         hit = cache.get(id(s.trace))
-        if hit is None:
-            hit = cache[id(s.trace)] = _tensorize_trace(
+        if hit is None or hit[0] is not s.trace:
+            hit = cache[id(s.trace)] = (s.trace, _tensorize_trace(
                 s.trace, batch_chars, max(pool.classes)
-            )
-        (kind, pos, rlen, slot0), ins_cum, unit_cum, rt = hit
+            ))
+        (kind, pos, rlen, slot0), ins_cum, unit_cum, rt = hit[1]
         pool.register(
             s.doc_id, n_init=len(rt.init_chars),
             capacity_need=rt.capacity, chars=rt.chars,
@@ -280,7 +286,7 @@ def prepare_streams(sessions, pool: DocPool, batch: int = 64,
 _EMPTY_I32 = np.zeros(0, np.int32)
 
 
-class LazyStreams:
+class LazyStreams:  # graftlint: state=stream states=genesis,live,released edges=genesis->live,live->released
     """Mapping-shaped view over a :class:`FleetSpec`: the op queues of
     a fleet, materialized per doc on first access — the streaming
     construction path.  Construction cost and host footprint scale
@@ -318,6 +324,14 @@ class LazyStreams:
         self.prefetch_built = 0  # streams adopted from the worker
         self.patches_total = 0  # n_patches over materialized docs
         pool.set_genesis_population(spec.n_docs)
+        # the stream construction machine's legal graph, mirrored from
+        # the class marker (G022/G025): a doc's op queue is built once
+        # and released once — there is no resurrection edge, adopt()
+        # and release() both guard on the live table
+        lifecycle.declare_machine(
+            "stream", ("genesis", "live", "released"),
+            (("genesis", "live"), ("live", "released")),
+        )
 
     # ---- mapping surface ----
 
@@ -352,8 +366,10 @@ class LazyStreams:
     # ---- materialization edges ----
 
     @fenced
-    def _install(self, st: DocStream, n_init: int, capacity: int,  # graftlint: fence=genesis
+    def _install(self, st: DocStream, n_init: int, capacity: int,  # graftlint: fence=genesis  # graftlint: transition=stream:genesis->live
                  chars) -> DocStream:
+        lifecycle.transition("stream", "genesis", "live",
+                             key=st.doc_id)
         self.pool.register(
             st.doc_id, n_init=n_init, capacity_need=capacity,
             chars=chars,
@@ -432,12 +448,13 @@ class LazyStreams:
         self.prefetch_built += 1
         return True
 
-    def release(self, doc_id: int) -> None:
+    def release(self, doc_id: int) -> None:  # graftlint: transition=stream:live->released
         """Drop a drained doc's op arrays (keep the stream object: the
         victim picker and fault paths still index it).  Idempotent."""
         st = self._live.get(doc_id)
         if st is None or st.kind is _EMPTY_I32:
             return
+        lifecycle.transition("stream", "live", "released", key=doc_id)
         st.kind = st.pos = st.rlen = st.slot0 = _EMPTY_I32
         st.ins_cum = st.unit_cum = _EMPTY_I32
         st.cursor = 0

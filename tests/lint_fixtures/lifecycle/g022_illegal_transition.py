@@ -1,0 +1,20 @@
+"""G022 seed: the doc-residency machine with one illegal declared
+edge (the PR 18 same-round-admit shape — a migration straight out of
+GENESIS) and one rogue direct write to the guarded state field."""
+
+
+class Pool:  # graftlint: state=doc field=phase states=genesis,live,cold,gone edges=genesis->live,live->cold,cold->live,live->gone,cold->gone
+    def __init__(self):
+        self.phase = "genesis"
+
+    def install(self, rec):  # graftlint: transition=doc:genesis->live
+        rec.phase = "live"
+
+    def spool_out(self, rec):  # graftlint: transition=doc:live->cold,cold->live
+        rec.phase = "cold"
+
+    def migrate(self, rec):  # graftlint: transition=doc:genesis->gone  # expect: G022
+        rec.phase = "gone"
+
+    def evict(self, rec):
+        rec.phase = "cold"  # expect: G022

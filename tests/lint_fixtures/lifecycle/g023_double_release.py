@@ -1,0 +1,23 @@
+"""G023 seed: the duplicate-GC-enqueue shape — the same resource
+released twice, once past a live acquire (balance goes negative) and
+once in a release-only cleanup that repeats itself verbatim."""
+
+
+class Spool:
+    def open_segment(self):  # graftlint: acquire=segment
+        return object()
+
+    def drop_segment(self):  # graftlint: release=segment
+        return None
+
+
+def reclaim(spool):
+    seg = spool.open_segment()
+    spool.drop_segment()
+    spool.drop_segment()  # expect: G023
+    return seg
+
+
+def teardown(spool):
+    spool.drop_segment()
+    spool.drop_segment()  # expect: G023

@@ -1,0 +1,33 @@
+"""G023 seed: an acquired row whose only exits drop the handle — no
+release on the fall-off path, no ownership escape.  The legal twin
+releases in a finally, covering every exit."""
+
+
+class Rows:
+    def alloc(self):  # graftlint: acquire=rows
+        return object()
+
+    def free(self, row):  # graftlint: release=rows
+        return row
+
+
+class Sched:
+    def __init__(self):
+        self.rows = Rows()
+
+    def place_ok(self, doc):
+        row = self.rows.alloc()
+        try:
+            return bind(doc, row)
+        finally:
+            self.rows.free(row)
+
+    def place_leaks(self, doc):
+        row = self.rows.alloc()  # expect: G023
+        if doc is None:
+            return None
+        return None
+
+
+def bind(doc, row):
+    return (doc, row)

@@ -1,0 +1,30 @@
+"""G024 seed: the PR 17 incident pair — a long-lived cache keyed by
+a recyclable ``id()`` and a paired inflight counter decremented with
+no underflow guard.  The generation-tupled key and the
+positivity-guarded decrement are the legal twins."""
+
+
+class Prefetch:
+    def start(self):  # graftlint: acquire=thread
+        self.inflight = 0
+        return self
+
+    def stop(self):  # graftlint: release=thread
+        return None
+
+    def enqueue(self, item):
+        self._cache[id(item)] = item  # expect: G024
+        self.inflight += 1
+
+    def enqueue_generational(self, item, gen):
+        self._cache[(id(item), gen)] = item
+
+    def lookup(self, item):
+        return self._cache.get(id(item))  # expect: G024
+
+    def drain_one(self):
+        self.inflight -= 1  # expect: G024
+
+    def drain_guarded(self):
+        if self.inflight > 0:
+            self.inflight -= 1

@@ -1,0 +1,17 @@
+"""G025 seed (artifact-driven, see artifact.json): a declared doc
+machine and a declared rows resource the recorded run — pool surface
+armed — never touched, vs runtime counters for a session machine and
+a socket resource nothing here declares."""
+
+
+class Pool:  # graftlint: state=doc states=genesis,live edges=genesis->live  # expect: G025
+    def install(self, rec):  # graftlint: transition=doc:genesis->live
+        rec.resident = True
+
+
+class Bucket:
+    def alloc_row(self):  # graftlint: acquire=rows  # expect: G025
+        return 1
+
+    def release_row(self, row):  # graftlint: release=rows
+        return row

@@ -1,0 +1,27 @@
+"""Suppression contract for the lifecycle suite: every violation on
+this page is explicitly `# graftlint: disable=`d, so the file lints
+clean — the reviewed escape hatch works for G022-G024 like every
+other rule."""
+
+
+class Pool:  # graftlint: state=doc field=phase states=genesis,live edges=genesis->live
+    def __init__(self):
+        self.phase = "genesis"
+
+    def rogue_write(self, rec):
+        rec.phase = "live"  # graftlint: disable=G022
+
+    def alloc(self):  # graftlint: acquire=rows
+        return object()
+
+    def free(self, row):  # graftlint: release=rows
+        return row
+
+    def leaky(self, doc):
+        row = self.alloc()  # graftlint: disable=G023
+        if doc is None:
+            return None
+        return None
+
+    def poisoned(self, item):
+        self._cache[id(item)] = item  # graftlint: disable=G024

@@ -1,0 +1,234 @@
+"""graftlint v5 headline harness: the churn-heavy protocol-complete
+lifecheck drain runs armed and leak-free, drained-doc record eviction
+keeps pool records O(active-set) regardless of fleet size, and the
+G025 cross-check is green in both directions on a real sanitized
+bench artifact (plus red on a doctored one)."""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+from crdt_benches_tpu.lint import lifecycle_sanitizer as lcs
+from crdt_benches_tpu.lint.core import run_lint
+from crdt_benches_tpu.serve.pool import DocPool
+from crdt_benches_tpu.serve.scheduler import FleetScheduler, LazyStreams
+from crdt_benches_tpu.serve.workload import FleetSpec
+
+PACKAGE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "crdt_benches_tpu")
+
+_BANDS = {"synth-small": ("synth", (8, 36))}
+_MIX = {"synth-small": 1.0}
+
+
+@pytest.fixture(autouse=True)
+def _lc_reset(monkeypatch):
+    """Every test owns a clean sanitizer (declarations restored — other
+    suites' pools declare machines as a construction side effect)."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_LIFECYCLE", raising=False)
+    saved = dict(lcs._decls)
+    lcs.disarm()
+    lcs.reset_counters()
+    yield
+    lcs.disarm()
+    lcs.reset_counters()
+    lcs._decls.clear()
+    lcs._decls.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# the headline drain
+# ---------------------------------------------------------------------------
+
+
+def test_lifecheck_small_drains_leak_free_with_full_coverage(tmp_path):
+    """THE graftlint v5 acceptance gate: both drains (journaled churn
+    + reshard + ingest, then journal-less record-evict streaming) run
+    armed with zero unreleased acquisitions at each drain end, every
+    required machine/resource records activity, and acquire==release
+    across the board."""
+    from crdt_benches_tpu.serve.lifecheck import (
+        _REQUIRED_MACHINES, _REQUIRED_RESOURCES, run_lifecheck)
+
+    report = run_lifecheck(str(tmp_path), small=True)
+    assert report["leaked"] == 0
+    assert report["unattributed"] == []
+    for name in _REQUIRED_MACHINES:
+        assert report["machines"].get(name), report["machines"]
+    for res in _REQUIRED_RESOURCES:
+        t = report["resources"][res]
+        assert t["acquire"] == t["release"] > 0, (res, t)
+    # drain 1 actually churned (the keyed doc machine walked edges)
+    assert report["churn"]["evictions"] > 0
+    # drain 2 reclaimed records and stayed inside the active-set bound
+    ev = report["record_evict"]
+    assert ev["gc_docs"] > 0 and ev["released_streams"] > 0
+    assert ev["records_at_end"] <= ev["fleet"]
+    # the sanitizer is left disarmed for the rest of the suite
+    assert not lcs.armed()
+
+
+# ---------------------------------------------------------------------------
+# O(active-set) record eviction: footprint must not scale with fleet
+# ---------------------------------------------------------------------------
+
+
+def _drained_gc_records(tmp_path, n: int) -> tuple[int, int]:
+    pool = DocPool(classes=(256,), slots=(2,),
+                   spool_dir=str(tmp_path / f"sp{n}"), warm_docs=2)
+    try:
+        spec = FleetSpec.build(n, mix=_MIX, seed=7, arrival_span=4,
+                               bands=_BANDS)
+        streams = LazyStreams(spec, pool, batch=16, batch_chars=64)
+        sched = FleetScheduler(pool, streams, batch=16, macro_k=2,
+                               batch_chars=64, drained_gc=True)
+        sched.run()
+        return len(pool.docs), sched.spool_gc_docs
+    finally:
+        for doc_id, rec in sorted(pool.docs.items()):
+            if rec.cls is not None:
+                pool.evict(doc_id)
+        pool.gc_drained_docs(sorted(pool.docs))
+        pool.close()
+
+
+def test_record_eviction_keeps_pool_records_o_active_set(tmp_path):
+    """ROADMAP million-doc item (b): with ``drained_gc`` the record
+    table at drain end is bounded by hot slots + warm budget + one
+    unflushed GC batch — the SAME bound at 3x the fleet — while the
+    number of reclaimed records scales with the fleet."""
+    bound = 2 + 2 + 32  # slots + warm_docs + one GC batch
+    rec_small, gc_small = _drained_gc_records(tmp_path, 12)
+    rec_big, gc_big = _drained_gc_records(tmp_path, 36)
+    assert gc_small > 0 and gc_big > gc_small
+    assert rec_small <= bound and rec_big <= bound
+    # the steady-state footprint did not grow with the fleet
+    assert rec_big <= rec_small + 32
+
+
+# ---------------------------------------------------------------------------
+# G025 cross-check on a real sanitized record-evict bench
+# ---------------------------------------------------------------------------
+
+
+def test_g025_cross_check_clean_both_directions(tmp_path, monkeypatch):
+    """A sanitized streaming record-evict drain emits a lifecycle
+    block that cross-checks clean against the static markers in BOTH
+    directions: no dead declared machine/resource on an armed surface,
+    no rogue runtime names, no unattributed transitions."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_LIFECYCLE", "1")
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=_MIX, bands=_BANDS,
+        n_docs=10, batch=16, classes=(256,), slots=(2,),
+        macro_k=2, batch_chars=64, arrival_span=2, verify_sample=3,
+        stream=True, record_evict=True,
+        results_dir=str(tmp_path), save_name="lc_smoke",
+        log=lambda s: None,
+    )
+    assert info["verify_ok"]
+    block = r.extra["lifecycle"]
+    assert block["version"] == 1 and block["sanitized"]
+    assert block["pool"] and block["stream"]
+    assert block["machines"].get("doc"), block["machines"]
+    assert block["machines"].get("stream"), block["machines"]
+    assert block["resources"].get("rows", {}).get("acquire", 0) > 0
+    assert block["unattributed"] == []
+    artifact = str(tmp_path / "lc_smoke.json")
+    assert os.path.exists(artifact)
+    findings = run_lint([PACKAGE], select={"G025"},
+                        lifecycle_artifact=artifact)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.msg}" for f in findings
+    )
+
+
+def test_g025_flags_dead_machine_rogue_and_unattributed_on_doctored(
+        tmp_path):
+    """All three failure directions against a doctored block: a dead
+    declared machine on an armed surface, a rogue runtime machine no
+    static declaration explains, and an unattributed transition."""
+    artifact = tmp_path / "doctored.json"
+    artifact.write_text(json.dumps({"lifecycle": {
+        "version": 1, "sanitized": True,
+        "pool": True, "reshard": False, "stream": False,
+        "ingest": False, "journal": False, "prefetch": False,
+        "machines": {"ghost": {"a->b": 3}},
+        "resources": {"rows": {"acquire": 4, "release": 4}},
+        "unattributed": ["spool:live->cold"],
+    }}))
+    findings = run_lint([PACKAGE], select={"G025"},
+                        lifecycle_artifact=str(artifact))
+    msgs = [f.msg for f in findings]
+    # pool armed but the doc machine recorded nothing -> dead
+    assert any("`doc` recorded zero transitions" in m for m in msgs)
+    # reshard NOT armed -> row machine is not dead-checked
+    assert not any("`row` recorded zero" in m for m in msgs)
+    assert any("runtime machine `ghost`" in m for m in msgs)
+    assert any("unattributed runtime transition `spool:live->cold`"
+               in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the lifecycle block rides the one-sided matrix
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    repo = pathlib.Path(PACKAGE).parent
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_lifecycle", repo / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare_lifecycle"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _compare_artifact(tmp_path, name: str, *, lifecycle: bool) -> str:
+    extra = {
+        "family": "serve",
+        "patches_per_sec": 100_000.0,
+        "batch_latency": {"p50": 0.001, "p95": 0.004, "p99": 0.005},
+        "rounds": 40,
+        "range_ops": 10_000,
+        "journal": None,
+    }
+    if lifecycle:
+        extra["lifecycle"] = {
+            "version": 1, "sanitized": True,
+            "pool": True, "reshard": False, "stream": True,
+            "ingest": False, "journal": False, "prefetch": False,
+            "machines": {"doc": {"cold->live": 40, "live->cold": 40}},
+            "resources": {"rows": {"acquire": 41, "release": 41}},
+            "unattributed": [],
+        }
+    data = [{"group": "serve", "trace": "mixed", "backend": "512",
+             "extra": extra}]
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_lifecycle_block_skips_both_directions(
+        tmp_path, capsys):
+    """A sanitized run diffed against a pre-v5 baseline (and vice
+    versa) is a schema difference, never an error: the lifecycle block
+    is a skip-with-note in both directions, and matched pairs diff
+    silently."""
+    bc = _bench_compare()
+    with_lc = _compare_artifact(tmp_path, "lc.json", lifecycle=True)
+    without = _compare_artifact(tmp_path, "plain.json", lifecycle=False)
+    for pair in ((with_lc, without), (without, with_lc)):
+        assert bc.main(list(pair)) == 0
+        out = capsys.readouterr().out
+        assert "SKIP" in out and "lifecycle block" in out
+        assert "present only in" in out
+    # both sides carrying the block is NOT a schema difference
+    assert bc.main([with_lc, with_lc]) == 0
+    assert "lifecycle block" not in capsys.readouterr().out

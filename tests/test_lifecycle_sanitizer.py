@@ -1,0 +1,212 @@
+"""graftlint v5 runtime twin: the lifecycle sanitizer's
+disarmed-identity contract, the armed typed-error surface (undeclared
+/ illegal / wrong-state transitions, double release, use-after-release,
+negative gauges, the drain-end leak gate), the generation tags that pin
+the PR 17 id-recycling and lazy-tensorize cache incidents, and the
+Prefetcher reap-race integration (the inflight gauge can never go
+negative again)."""
+
+import time
+
+import pytest
+
+from crdt_benches_tpu.lint import lifecycle_sanitizer as lcs
+from crdt_benches_tpu.serve.prefetch import Prefetcher
+
+
+@pytest.fixture(autouse=True)
+def _lc_reset(monkeypatch):
+    """Every test owns a clean sanitizer: counters zeroed, disarmed
+    unless the test arms it, and machine declarations restored (they
+    survive reset_counters by design — other suites' pools declare
+    machines as a side effect of construction)."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_LIFECYCLE", raising=False)
+    saved = dict(lcs._decls)
+    lcs.disarm()
+    lcs.reset_counters()
+    yield
+    lcs.disarm()
+    lcs.reset_counters()
+    lcs._decls.clear()
+    lcs._decls.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# disarmed identity
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_counts_everything_but_enforces_nothing():
+    """Disarmed, the sanitizer is a pure counter (the G025 ground
+    truth): illegal edges, double releases, and negative gauges all
+    record without raising, and no live-object model exists."""
+    assert not lcs.armed()
+    lcs.declare_machine("spool", ("live", "cold"), (("live", "cold"),))
+    lcs.transition("spool", "cold", "live")  # illegal edge: counted
+    lcs.acquire("rows", 7)
+    lcs.release("rows", 7)
+    lcs.release("rows", 7)  # double release: counted, no raise
+    lcs.gauge("prefetch_inflight", -3)  # negative: recorded, no raise
+    lcs.touch("rows", 7)  # no tracking, no raise
+    c = lcs.counters()
+    assert c["machines"] == {"spool": {"cold->live": 1}}
+    assert c["resources"]["rows"] == {"acquire": 1, "release": 2}
+    assert c["gauges"]["prefetch_inflight"] == -3
+    assert lcs.live_count() == 0 and lcs.live_keys() == []
+    lcs.assert_all_released()  # nothing tracked -> nothing leaked
+
+
+def test_disarmed_undeclared_transition_lands_in_unattributed():
+    lcs.transition("ghost", "x", "y")
+    assert lcs.counters()["unattributed"] == ["ghost:x->y"]
+
+
+def test_env_flag_arms_eagerly_at_reset(monkeypatch):
+    """``CRDT_BENCH_SANITIZE_LIFECYCLE=1`` arms at reset_counters (not
+    at first transition) so acquisitions before any edge are tracked."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_LIFECYCLE", "1")
+    lcs.reset_counters()
+    assert lcs.armed()
+    with pytest.raises(lcs.DoubleReleaseError, match="never acquired"):
+        lcs.release("rows", 1)
+
+
+# ---------------------------------------------------------------------------
+# armed enforcement
+# ---------------------------------------------------------------------------
+
+
+def test_armed_undeclared_machine_is_a_typed_error():
+    lcs.arm()
+    with pytest.raises(lcs.UndeclaredTransitionError,
+                       match="undeclared machine `ghost`"):
+        lcs.transition("ghost", "x", "y")
+    # still counted on the way out: the artifact names the rogue edge
+    assert lcs.counters()["unattributed"] == ["ghost:x->y"]
+
+
+def test_armed_illegal_edge_is_a_typed_error():
+    lcs.arm()
+    lcs.declare_machine("spool", ("live", "cold"), (("live", "cold"),))
+    with pytest.raises(lcs.UndeclaredTransitionError,
+                       match="not in the declared edge graph"):
+        lcs.transition("spool", "cold", "live")
+
+
+def test_armed_keyed_transition_tracks_per_instance_state():
+    """A keyed transition must depart from the instance's ACTUAL state;
+    a key the model has not seen yet passes any legal departure (docs
+    exist before their first counted edge)."""
+    lcs.arm()
+    lcs.declare_machine(
+        "spool", ("live", "cold"),
+        (("live", "cold"), ("cold", "live")),
+    )
+    lcs.transition("spool", "cold", "live", key=11)  # unseen key: ok
+    lcs.transition("spool", "live", "cold", key=11)
+    with pytest.raises(lcs.UndeclaredTransitionError,
+                       match="is in state `cold`, not `live`"):
+        lcs.transition("spool", "live", "cold", key=11)
+    # unkeyed edges never consult instance state
+    lcs.transition("spool", "live", "cold")
+
+
+def test_armed_double_release_distinguishes_its_two_shapes():
+    lcs.arm()
+    lcs.acquire("segment", "wal-0001")
+    lcs.release("segment", "wal-0001")
+    with pytest.raises(lcs.DoubleReleaseError, match="already released"):
+        lcs.release("segment", "wal-0001")
+    with pytest.raises(lcs.DoubleReleaseError, match="never acquired"):
+        lcs.release("segment", "wal-0002")
+
+
+def test_armed_use_after_release_raises_but_unseen_keys_pass():
+    lcs.arm()
+    lcs.acquire("stream", 5)
+    lcs.touch("stream", 5)  # live: fine
+    lcs.release("stream", 5)
+    with pytest.raises(lcs.UseAfterReleaseError, match="after its release"):
+        lcs.touch("stream", 5)
+    lcs.touch("stream", 99)  # out of jurisdiction: passes
+
+
+def test_generation_tag_bumps_on_reacquire():
+    """The PR 17 id-recycling pin: a recycled key re-acquired is a NEW
+    object under a fresh generation — cache layers keying entries as
+    ``(key, generation(...))`` (the lazy-tensorize fix) can never take
+    a stale hit, because the dead object's generation is unreachable."""
+    lcs.arm()
+    lcs.acquire("stream", 0xBEEF)
+    g1 = lcs.generation("stream", 0xBEEF)
+    lcs.release("stream", 0xBEEF)
+    assert lcs.generation("stream", 0xBEEF) is None
+    lcs.acquire("stream", 0xBEEF)  # id recycled by the allocator
+    g2 = lcs.generation("stream", 0xBEEF)
+    assert g2 == g1 + 1
+    lcs.touch("stream", 0xBEEF)  # live again under the new generation
+    lcs.release("stream", 0xBEEF)
+
+
+def test_negative_gauge_is_a_typed_error_armed():
+    """The PR 17 inflight-underflow pin, as a typed error instead of a
+    silently wrong submission budget."""
+    lcs.arm()
+    lcs.gauge("prefetch_inflight", 2)
+    lcs.gauge("prefetch_inflight", 0)
+    with pytest.raises(lcs.NegativeGaugeError, match="observed at -1"):
+        lcs.gauge("prefetch_inflight", -1)
+
+
+def test_leak_gate_names_the_leaked_keys_then_passes_after_release():
+    lcs.arm()
+    lcs.acquire("rows", (64, 3))
+    lcs.acquire("socket", "front")
+    assert lcs.live_count() == 2
+    assert lcs.live_count("rows") == 1
+    with pytest.raises(lcs.LifecycleLeakError) as ei:
+        lcs.assert_all_released()
+    msg = str(ei.value)
+    assert "2 unreleased acquisition(s) at drain end" in msg
+    assert "rows:(64, 3)" in msg and "socket:'front'" in msg
+    lcs.release("rows", (64, 3))
+    lcs.release("socket", "front")
+    lcs.assert_all_released()
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher integration: the reap race stays fixed
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_reap_race_never_drives_the_gauge_negative():
+    """A payload whose read outlives its reaping used to decrement
+    ``inflight`` a second time; armed, that underflow would now be a
+    NegativeGaugeError at the callsite — so this drain completing
+    without one IS the regression pin."""
+    lcs.arm()
+    p = Prefetcher(capacity=8)
+    p.start()
+    try:
+        seq = p.submit_construct(7, lambda: {"row": None})
+        assert seq >= 1 and p.inflight == 1
+        p.note_lost([seq])  # reaped before the payload lands
+        assert p.inflight == 0
+        deadline = time.time() + 10.0
+        while p.reap_dropped == 0 and time.time() < deadline:
+            p.drain()
+            time.sleep(0.01)
+        assert p.reap_dropped == 1
+        assert p.inflight == 0  # no second decrement
+        assert lcs.counters()["gauges"]["prefetch_inflight"] == 0
+    finally:
+        p.stop()
+    lcs.assert_all_released()  # start/stop thread pairing is clean
+
+
+def test_prefetcher_count_only_reap_clamps_at_zero():
+    lcs.arm()
+    p = Prefetcher()
+    p.note_lost(3)  # bare-int reap with nothing in flight: clamped
+    assert p.inflight == 0
+    assert lcs.counters()["gauges"]["prefetch_inflight"] == 0

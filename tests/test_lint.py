@@ -41,13 +41,14 @@ ALL_FIXTURE_FILES = sorted(p for p in FIXTURES.glob("**/*.py"))
 
 #: Cross-module corpora (``xmod_*`` directories) lint as a UNIT — their
 #: rules see nothing in a single-file run — so the per-file contract
-#: below covers only the standalone fixtures.  The G017 and G021
+#: below covers only the standalone fixtures.  The G017, G021, and G025
 #: fixtures are artifact-driven the same way G011 is (no ground truth,
 #: no findings), so their explicit tests pass the artifact instead.
 FIXTURE_FILES = [
     p for p in ALL_FIXTURE_FILES
     if not any(part.startswith("xmod_") for part in p.parts)
-    and p.name not in ("g017_dead_publish.py", "g021_dead_protocol.py")
+    and p.name not in ("g017_dead_publish.py", "g021_dead_protocol.py",
+                       "g025_dead_machine.py")
 ]
 XMOD_DIRS = sorted(
     d for d in FIXTURES.iterdir()
@@ -57,6 +58,7 @@ G008_DIR = FIXTURES / "xmod_g008"
 G011_DIR = FIXTURES / "xmod_g011"
 THREADS_DIR = FIXTURES / "threads"
 FSOPS_DIR = FIXTURES / "fsops"
+LIFECYCLE_DIR = FIXTURES / "lifecycle"
 
 
 def test_corpus_is_nonempty():
@@ -291,6 +293,7 @@ def test_every_rule_has_a_detection_case():
         "G008", "G009", "G010", "G011", "G012", "G013",
         "G014", "G015", "G016", "G017",
         "G018", "G019", "G020", "G021",
+        "G022", "G023", "G024", "G025",
     } <= covered
 
 
@@ -518,6 +521,105 @@ def test_sarif_covers_the_fsops_rules():
     doc = json.loads(format_sarif(findings))
     rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
     assert rules == {"G018", "G019", "G020"}
+    assert all(r["level"] == "error" for r in doc["runs"][0]["results"])
+
+
+def test_lifecycle_corpus_covers_each_rule_per_hazard():
+    """The lifecycle corpus seeds the canonical shape of each hazard
+    at exact lines: the illegal declared edge + the rogue direct write
+    to the state field (G022), the leak-on-path acquire, the
+    balance-negative release past a live acquire, and the verbatim
+    repeated release (G023), and the PR 17 incident pair — the bare
+    id()-keyed long-lived map and the unguarded paired-counter
+    decrement (G024) — while every legal twin (declared edges routed
+    through transition functions, the finally-covered release, the
+    generation-tupled key, the positivity-guarded decrement) stays
+    silent."""
+    g022_path = LIFECYCLE_DIR / "g022_illegal_transition.py"
+    g022 = run_lint([str(g022_path)])
+    assert {f.rule for f in g022} == {"G022"}
+    assert [(f.rule, f.line) for f in g022] == sorted(
+        expected_markers(g022_path), key=lambda rl: rl[1]
+    )
+    assert "not an edge of the declared graph" in g022[0].msg
+    assert "direct write to state field" in g022[1].msg
+    leak_path = LIFECYCLE_DIR / "g023_leak_on_path.py"
+    leak = run_lint([str(leak_path)])
+    assert [(f.rule, f.line) for f in leak] == sorted(
+        expected_markers(leak_path), key=lambda rl: rl[1]
+    )
+    assert len(leak) == 1 and "never released" in leak[0].msg
+    dbl_path = LIFECYCLE_DIR / "g023_double_release.py"
+    dbl = run_lint([str(dbl_path)])
+    assert {f.rule for f in dbl} == {"G023"}
+    assert [(f.rule, f.line) for f in dbl] == sorted(
+        expected_markers(dbl_path), key=lambda rl: rl[1]
+    )
+    assert "without a dominating acquire" in dbl[0].msg
+    assert "double release" in dbl[1].msg
+    g024_path = LIFECYCLE_DIR / "g024_id_keyed_cache.py"
+    g024 = run_lint([str(g024_path)])
+    assert {f.rule for f in g024} == {"G024"}
+    assert [(f.rule, f.line) for f in g024] == sorted(
+        expected_markers(g024_path), key=lambda rl: rl[1]
+    )
+    assert "recycles" in g024[0].msg
+    assert "recycles" in g024[1].msg
+    assert "underflow guard" in g024[2].msg
+
+
+def test_g025_dead_machine_and_unattributed_transitions():
+    """G025 mirrors G011/G017/G021 for lifecycle declarations: a
+    declared machine/resource the artifact's run never touched is
+    flagged at its decl line (scoped by armed surface — the fixture
+    artifact armed ``pool`` only), runtime machines/resources with no
+    marker and unattributed transitions are flagged against the
+    artifact.  Without an artifact the rule stays silent."""
+    artifact = LIFECYCLE_DIR / "artifact.json"
+    path = LIFECYCLE_DIR / "g025_dead_machine.py"
+    findings = run_lint([str(path)], lifecycle_artifact=str(artifact))
+    dead = {(f.path, f.rule, f.line) for f in findings
+            if f.path.endswith(".py")}
+    assert dead == {
+        (str(path), r, ln) for r, ln in expected_markers(path)
+    }, "\n".join(f"  {f.path}:{f.line} {f.rule} {f.msg}" for f in findings)
+    from_artifact = [f for f in findings if f.path == str(artifact)]
+    assert len(from_artifact) == 3
+    assert any("runtime machine `session`" in f.msg for f in from_artifact)
+    assert any("runtime resource `socket`" in f.msg for f in from_artifact)
+    assert any("unattributed runtime transition `spool:live->cold`" in f.msg
+               for f in from_artifact)
+    assert run_lint([str(path)]) == []  # no artifact -> no G025
+
+
+def test_g025_selected_without_artifact_fails_like_g011():
+    findings = run_lint(
+        [str(LIFECYCLE_DIR / "g025_dead_machine.py")], select={"G025"}
+    )
+    assert [f.rule for f in findings] == ["G000"]
+    assert "--lifecycle-artifact" in findings[0].msg
+
+
+def test_lifecycle_suppression_contract():
+    """`# graftlint: disable=G022/23/24` silences the lifecycle rules
+    exactly like every other rule."""
+    findings = run_lint([str(LIFECYCLE_DIR / "suppressed_clean.py")])
+    assert findings == []
+
+
+def test_sarif_covers_the_lifecycle_rules():
+    """The SARIF reporter carries the lifecycle rules with the same
+    everything-is-an-error gate semantics."""
+    from crdt_benches_tpu.lint import format_sarif
+
+    findings = run_lint([
+        str(LIFECYCLE_DIR / "g022_illegal_transition.py"),
+        str(LIFECYCLE_DIR / "g023_double_release.py"),
+        str(LIFECYCLE_DIR / "g024_id_keyed_cache.py"),
+    ])
+    doc = json.loads(format_sarif(findings))
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rules == {"G022", "G023", "G024"}
     assert all(r["level"] == "error" for r in doc["runs"][0]["results"])
 
 

@@ -158,10 +158,14 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: resharding run diffed against a fixed-map baseline (or vice versa)
 #: is a family difference, never an error, and a shrink-vs-grow pair
 #: skips the mid-reshard latency gate (kind mismatch).
+#: ``lifecycle`` is the graftlint v5 lifecycle & ownership block
+#: (machine edge + resource acquire/release counters, G025's ground
+#: truth) — same both-directions skip: artifacts written before the
+#: block existed diff cleanly against runs that carry it.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
                     "recovery", "residency", "fs_ops", "ingest",
-                    "knee", "construction", "reshard")
+                    "knee", "construction", "reshard", "lifecycle")
 
 
 def _tier_hit_rate(extra: dict) -> float | None:

@@ -47,8 +47,14 @@
 # 12-doc drain under CRDT_BENCH_SANITIZE_FS=1 (fs ops attributed to
 # their declared durable protocols, G019 orderings enforced live, the
 # G021 cross-check green in both directions against the emitted fs_ops
-# block) and the exhaustive crash-point enumeration harness (a crash
-# at EVERY mutating fs-op boundary must recover byte-verified).
+# block) — then the graftlint v5 lifecycle legs: a churn-heavy
+# record-evict streaming drain under CRDT_BENCH_SANITIZE_LIFECYCLE=1
+# (keyed residency edges + ownership checked live, the G025
+# cross-check green in both directions against the emitted lifecycle
+# block) and the lifecheck zero-leak headline (every declared machine
+# exercised, zero unreleased acquisitions at drain end) — and finally
+# the exhaustive crash-point enumeration harness (a crash at EVERY
+# mutating fs-op boundary must recover byte-verified).
 #
 # The serve-stream family is the STREAMING-CONSTRUCTION smoke: the
 # same tiered fleet built LAZILY (--serve-stream: FleetSpec-derived
@@ -624,11 +630,54 @@ print(f"fs leg: {sum(fo['protocols'].values())} protocol entries, "
       f"{sum(n for t in fo['ops'].values() for n in t.values())} fs ops "
       "attributed, zero unattributed, G021 clean both directions")
 PYEOF
-    # ...and the headline: exhaustive crash-point enumeration — a
-    # crash injected at EVERY mutating fs-op boundary of the
-    # sub-minute protocol workload (snapshot barriers, delta chains,
-    # WAL seal+GC, spool churn, flight dump) must be followed by
-    # byte-verified recovery; the per-protocol point counts are
+    # Lifecycle-sanitized leg (graftlint v5): a churn-heavy journal-less
+    # streaming drain with drained-doc record eviction under
+    # CRDT_BENCH_SANITIZE_LIFECYCLE=1 — every keyed doc residency edge,
+    # row acquire/release, and stream release is checked LIVE (illegal
+    # edges, double releases, and negative gauges raise at the
+    # callsite), and the artifact's lifecycle block is cross-checked by
+    # G025 in both directions: dead declared machines on armed surfaces
+    # and rogue/unattributed runtime transitions both fail the gate.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      CRDT_BENCH_SANITIZE_LIFECYCLE=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 2 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 4,2,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 4 \
+        --serve-stream --serve-record-evict \
+        --serve-save-name serve_longhaul_lc_smoke
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G025 \
+      --lifecycle-artifact bench_results/serve_longhaul_lc_smoke.json
+    python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_longhaul_lc_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+lc = extras[0]["lifecycle"]
+assert lc["sanitized"] and lc["pool"] and lc["stream"], lc
+for m in ("doc", "stream"):
+    assert lc["machines"].get(m), (m, lc["machines"])
+assert lc["resources"].get("rows", {}).get("acquire", 0) > 0, lc["resources"]
+assert lc["unattributed"] == [], lc["unattributed"]
+edges = sum(n for t in lc["machines"].values() for n in t.values())
+print(f"lifecycle leg: {edges} transitions across "
+      f"{len(lc['machines'])} machines, "
+      f"{lc['resources']['rows']['acquire']} row acquisitions, zero "
+      "unattributed, G025 clean both directions")
+PYEOF
+    # ...the lifecycle headline: the churn-heavy protocol-complete
+    # lifecheck drain (journaled churn + reshard + live ingest front,
+    # then a record-evict streaming drain) armed end to end, requiring
+    # ZERO unreleased acquisitions at each drain end and nonzero edge
+    # counts on every declared machine.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.serve.lifecheck --small
+    # ...and the durability headline: exhaustive crash-point
+    # enumeration — a crash injected at EVERY mutating fs-op boundary
+    # of the sub-minute protocol workload (snapshot barriers, delta
+    # chains, WAL seal+GC, spool churn, flight dump) must be followed
+    # by byte-verified recovery; the per-protocol point counts are
     # asserted nonzero inside the harness so it can never silently
     # cover nothing.
     exec timeout -k 10 300 env JAX_PLATFORMS=cpu \

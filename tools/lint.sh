@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # The lint gate: graftlint (JAX hygiene G001-G013 + thread-confinement
-# G014-G017 + crash-consistency G018-G020; the artifact-driven
-# cross-checks G011/G017/G021 run in the bench smoke) + ruff (when
-# installed).  Exits NONZERO on any finding — CI and the tier-1 gate
-# both call this before running a single test.
+# G014-G017 + crash-consistency G018-G020 + lifecycle & ownership
+# G022-G025; the artifact-driven cross-checks G011/G017/G021/G025 run
+# in the bench smoke) + ruff (when installed).  Exits NONZERO on any
+# finding — CI and the tier-1 gate both call this before running a
+# single test.
 #
 # Usage:
 #   tools/lint.sh                 # lint the shipped tree (the CI gate)
