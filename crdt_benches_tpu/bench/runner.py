@@ -659,6 +659,42 @@ def run_serve(args) -> int:
         run_serve_soak,
     )
 
+    if args.serve_edgecheck is not None:
+        # the dtype-edge adversarial harness (serve/edgecheck.py) owns
+        # its fleets, both kernels, and the armed range sanitizer —
+        # flags that shape a bench drain are REJECTED, not silently
+        # dropped (same contract as the replicated/open matrices below)
+        unsupported = [
+            ("--serve-writers", args.serve_writers > 1),
+            ("--serve-open", args.serve_open is not None),
+            ("--serve-soak", args.serve_soak is not None),
+            ("--serve-longhaul", args.serve_longhaul > 0),
+            ("--serve-recover", args.serve_recover),
+            ("--serve-crash-round", args.serve_crash_round > 0),
+            ("--serve-reshard", args.serve_reshard is not None),
+            ("--serve-record-evict", args.serve_record_evict),
+            ("--serve-mesh", args.serve_mesh > 1),
+            ("--serve-tiers", args.serve_tiers is not None),
+            ("--serve-stream", args.serve_stream),
+            ("--serve-journal", args.serve_journal is not None),
+            ("--serve-faults", args.serve_faults is not None),
+        ]
+        bad = [flag for flag, hit in unsupported if hit]
+        if bad:
+            print(
+                f"{', '.join(bad)} not supported with "
+                "--serve-edgecheck (the harness builds its own "
+                "adversarial fleets and drains them through BOTH "
+                "kernels, sanitizer armed)",
+                file=sys.stderr,
+            )
+            return 2
+        from ..serve.edgecheck import main as edge_main
+
+        return edge_main(
+            ["--small"] if args.serve_edgecheck == "small" else []
+        )
+
     if args.serve_writers > 1:
         # replicated family: serve/repl/<mix>/<fleet>x<writers>
         # (serve/replicate/bench.py).  Exit gate is the verification
@@ -1050,6 +1086,17 @@ def main(argv=None) -> int:
                          "resolve+apply lax.scan body (the differential "
                          "baseline).  Recorded in the artifact as "
                          "extra['kernel']")
+    ap.add_argument("--serve-edgecheck", default=None,
+                    choices=("small", "full"), metavar="MODE",
+                    help="run the dtype-edge adversarial harness "
+                         "(serve/edgecheck.py) instead of a bench "
+                         "drain: adversarial fleets through BOTH "
+                         "kernels with the range sanitizer armed, "
+                         "oracle byte-verified, plus the seeded "
+                         "boundary-contract fuzz.  'small' keeps the "
+                         "structural edges; 'full' adds the two "
+                         "uint16-bracket ladders.  Exit 0 clean / 1 "
+                         "violation / 2 usage")
     ap.add_argument("--serve-save-name", default=None,
                     help="artifact basename (default serve_<mix>_<docs>)")
     ap.add_argument("--serve-journal", default=None, metavar="DIR",

@@ -7,6 +7,7 @@
                                     [--thread-artifact bench.json]
                                     [--fs-artifact bench.json]
                                     [--lifecycle-artifact bench.json]
+                                    [--ranges-artifact bench.json]
 
 Exits nonzero when any finding survives suppression (CI gates on this);
 ``--format sarif`` emits SARIF 2.1.0 for CI annotation surfaces with
@@ -43,6 +44,12 @@ acquire/release counters) is cross-checked against the static
 ``# graftlint: state=`` / ``acquire=`` / ``release=`` markers — dead
 declared machines/resources and unattributed runtime transitions both
 fail.
+
+``--ranges-artifact`` is G029's: the artifact's ``ranges`` block (the
+range sanitizer's index-check and clamp-mask dispatch counters) is
+cross-checked against the static ``# graftlint: inrange=... check=`` /
+``mask=`` declarations — dead declared facts/masks and unattributed
+runtime counters both fail.
 
 ``--boundaries`` dumps the jit-boundary contract registry as JSON by
 importing the package modules that declare them (the only mode that
@@ -147,6 +154,11 @@ def main(argv: list[str] | None = None) -> int:
              "resource cross-check (lifecycle block)",
     )
     ap.add_argument(
+        "--ranges-artifact", default=None, metavar="JSON",
+        help="serve bench artifact for the G029 value-range "
+             "cross-check (ranges block)",
+    )
+    ap.add_argument(
         "--boundaries", action="store_true",
         help="dump the jit-boundary contract registry as JSON and exit",
     )
@@ -201,6 +213,7 @@ def main(argv: list[str] | None = None) -> int:
         thread_artifact=args.thread_artifact,
         fs_artifact=args.fs_artifact,
         lifecycle_artifact=args.lifecycle_artifact,
+        ranges_artifact=args.ranges_artifact,
     )
     out = (
         format_json(findings) if args.format == "json"

@@ -634,6 +634,7 @@ ARTIFACT_RULES = {
     "G017": ("thread_artifact", "--thread-artifact"),
     "G021": ("fs_artifact", "--fs-artifact"),
     "G025": ("lifecycle_artifact", "--lifecycle-artifact"),
+    "G029": ("ranges_artifact", "--ranges-artifact"),
 }
 
 
@@ -641,7 +642,8 @@ def run_lint(paths: list[str], select: set[str] | None = None,
              sync_artifact: str | None = None,
              thread_artifact: str | None = None,
              fs_artifact: str | None = None,
-             lifecycle_artifact: str | None = None) -> list[Finding]:
+             lifecycle_artifact: str | None = None,
+             ranges_artifact: str | None = None) -> list[Finding]:
     """Run the rule suite over ``paths``.  ``sync_artifact`` names a
     serve bench artifact (or raw ``boundary_syncs`` JSON) to enable the
     G011 fence-cost cross-check — without it G011 is skipped (it has no
@@ -652,7 +654,9 @@ def run_lint(paths: list[str], select: set[str] | None = None,
     (the fs sanitizer's per-protocol op counters);
     ``lifecycle_artifact`` for G025's ``lifecycle`` machine/resource
     cross-check (the lifecycle sanitizer's transition and
-    acquire/release counters)."""
+    acquire/release counters); ``ranges_artifact`` for G029's
+    ``ranges`` bounds cross-check (the range sanitizer's index-check
+    and clamp-mask dispatch counters)."""
     from . import rules as _rules
 
     artifacts = {
@@ -660,6 +664,7 @@ def run_lint(paths: list[str], select: set[str] | None = None,
         "thread_artifact": thread_artifact,
         "fs_artifact": fs_artifact,
         "lifecycle_artifact": lifecycle_artifact,
+        "ranges_artifact": ranges_artifact,
     }
     index, findings = build_index(paths)
     for rule_id, fn in _rules.RULES.items():

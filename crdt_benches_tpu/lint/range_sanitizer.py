@@ -1,0 +1,236 @@
+"""Runtime value-range sanitizer: the dynamic half of the G026-G029
+value-range & index-space model (lint/ranges.py), and the bounds oracle
+behind the dtype-edge harness (serve/edgecheck.py).
+
+graftlint's range rules prove *statically* that every dynamic gather /
+scatter / Pallas-ref index is dominated by a clamp, a mod, or a
+declared ``# graftlint: inrange=`` fact, that clamped gathers feed a
+declared mask, and that narrow uint16/int8 op lanes widen before
+arithmetic — but the static model trusts the declarations.  XLA makes
+the runtime half mandatory in a way no other rule family is: an
+out-of-range index does not crash, it CLAMPS, and a wrapped narrow
+lane does not overflow, it aliases another slot id — both corrupt
+bytes silently.  This module supplies the runtime evidence, the same
+architecture as the sync, race, fs and lifecycle sanitizers:
+
+- every declared index check routes through :func:`check_index` (keyed
+  by the ``check=<name>`` payload of its static ``inrange=`` marker so
+  runtime counters line up with the declarations) and counts its
+  dispatches — always, in every mode, one lock-guarded dict increment
+  per staged macro.  Likewise :func:`check_narrow` per narrow lane and
+  :func:`note_mask` per declared clamp-mask region.  These counters
+  are the ground truth the serve artifact exports as its ``ranges``
+  block (lint G029 cross-validates dead declared facts and
+  unattributed runtime counters against it, G011/G017/G021/G025's
+  mirror);
+- with ``CRDT_BENCH_SANITIZE_RANGES=1`` the bounds are enforced
+  **live, on the staged host tensors pre-dispatch** — the op arrays
+  are host-side numpy at the staging boundary already, so validation
+  costs zero hot-path device syncs.  An index operand outside
+  ``[lo, bound)`` raises :class:`IndexOutOfBoundsError` at the
+  callsite with doc/class/round attribution (the value XLA would have
+  silently clamped); a narrow-lane value past its headroom ceiling
+  raises :class:`NarrowOverflowError` (the value a uint16 repack
+  would wrap); a PAD/sentinel value on a lane that must be
+  sentinel-free post-masking raises :class:`PadLeakError`.
+
+Disarmed (the default), nothing is validated — the only cost anywhere
+is the counter bump, exactly the zero-overhead contract every
+sanitizer in this repo keeps.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+_ENV = "CRDT_BENCH_SANITIZE_RANGES"
+
+#: The armed-surface vocabulary for the ``ranges`` artifact block.
+#: ``staging`` is armed on every drain (the host staging boundary is
+#: always crossed); ``fused``/``scan`` track which resolve kernel the
+#: run dispatched, so a kernel-scoped mask declared for the fused
+#: gather is only dead-checked against runs that ran the fused path.
+KNOWN_SURFACES = ("staging", "fused", "scan")
+
+
+class RangeSanitizerError(RuntimeError):
+    """Base class for every armed value-range violation."""
+
+
+class IndexOutOfBoundsError(RangeSanitizerError):
+    """A staged index operand outside its declared ``[lo, bound)``
+    range — the value XLA's gather/scatter would clamp (or drop)
+    silently instead of faulting."""
+
+
+class NarrowOverflowError(RangeSanitizerError):
+    """A staged narrow-lane value past its dtype headroom — the value
+    a uint16/int8 repack would wrap into an aliased slot id."""
+
+
+class PadLeakError(RangeSanitizerError):
+    """A PAD/sentinel value on a lane declared sentinel-free — the
+    sentinel escaped its mask and is about to enter arithmetic."""
+
+
+#: Checks fire from whatever thread stages the macro (the prefetch
+#: worker stages off-thread), so the counter tables take a real mutex
+#: — same reasoning as lifecycle_sanitizer._mu.
+_mu = threading.Lock()
+_checks: dict[str, int] = {}  # check name -> staged-dispatch count
+_masks: dict[str, int] = {}  # mask tag -> masked-region dispatch count
+
+_armed = False
+_forced = False  # armed explicitly (edgecheck harness), not via env
+
+
+def sanitizing() -> bool:
+    """True when ``CRDT_BENCH_SANITIZE_RANGES`` arms the sanitizer.
+    Read at reset (not at import) so tests can flip it."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+def _sync_armed() -> None:
+    global _armed
+    if not _forced:
+        _armed = sanitizing()
+
+
+def armed() -> bool:
+    return _armed
+
+
+def arm() -> None:
+    """Force-arm (the edgecheck harness; tests), independent of the
+    env flag."""
+    global _armed, _forced
+    _armed = True
+    _forced = True
+
+
+def disarm() -> None:
+    global _armed, _forced
+    _armed = False
+    _forced = False
+
+
+def reset_counters() -> None:
+    """Zero the counter tables (each bench run owns its window).  When
+    the env flag is set the sanitizer arms HERE, eagerly, so the very
+    first staged macro is validated too."""
+    _sync_armed()
+    with _mu:
+        _checks.clear()
+        _masks.clear()
+
+
+def _where(doc=None, cls=None, rnd=None) -> str:
+    parts = []
+    if doc is not None:
+        parts.append(f"doc={doc}")
+    if cls is not None:
+        parts.append(f"class={cls}")
+    if rnd is not None:
+        parts.append(f"round={rnd}")
+    return f" [{', '.join(parts)}]" if parts else ""
+
+
+def check_index(name: str, arr, bound, *, lo: int = 0,
+                doc=None, cls=None, rnd=None) -> None:
+    """One staged index-operand validation.  Counted in EVERY mode
+    under ``name`` (the G029 ground truth, matching the static
+    ``inrange=... check=<name>`` marker); armed, every element of
+    ``arr`` must lie in ``[lo, bound)`` or the out-of-range value is a
+    typed error at the callsite — BEFORE dispatch, while the tensor is
+    still host-side numpy (zero device syncs).
+
+    ``arr`` may be a zero-arg callable (e.g. a lambda masking out PAD
+    lanes) — it is only evaluated when armed, so the disarmed cost
+    stays exactly one counter bump."""
+    with _mu:
+        _checks[name] = _checks.get(name, 0) + 1
+    if not _armed:
+        return
+    # the staged lanes are host numpy ALREADY (pre-dispatch staging
+    # boundary): this asarray is a no-copy view, never a device sync
+    a = np.asarray(arr() if callable(arr) else arr)  # graftlint: disable=G002
+    if a.size == 0:
+        return
+    amin = int(a.min())
+    amax = int(a.max())
+    b = int(bound)
+    if amin < lo or amax >= b:
+        bad = amin if amin < lo else amax
+        raise IndexOutOfBoundsError(
+            f"index check `{name}`: value {bad} outside [{lo}, {b}) "
+            f"on the staged host tensor{_where(doc, cls, rnd)} — XLA "
+            f"would clamp this silently, never fault ({_ENV}=1)"
+        )
+
+
+def check_narrow(name: str, arr, bound, *,
+                 doc=None, cls=None, rnd=None) -> None:
+    """One narrow-lane headroom validation.  Counted in EVERY mode;
+    armed, every element must fit ``[0, bound]`` — the ceiling a
+    narrow (uint16/int8) repack of this lane can carry losslessly.  A
+    value past it is the silent-wrap corruption ``pack_ops`` exists to
+    refuse, caught even on paths that skip the pack (the same-dtype
+    passthrough)."""
+    with _mu:
+        _checks[name] = _checks.get(name, 0) + 1
+    if not _armed:
+        return
+    # host numpy already, same as check_index
+    a = np.asarray(arr() if callable(arr) else arr)  # graftlint: disable=G002
+    if a.size == 0:
+        return
+    amin = int(a.min())
+    amax = int(a.max())
+    b = int(bound)
+    if amin < 0 or amax > b:
+        bad = amin if amin < 0 else amax
+        raise NarrowOverflowError(
+            f"narrow lane `{name}`: value {bad} outside [0, {b}] "
+            f"headroom{_where(doc, cls, rnd)} — a narrow repack would "
+            f"wrap it into an aliased id ({_ENV}=1)"
+        )
+
+
+def check_no_pad(name: str, arr, pad, *,
+                 doc=None, cls=None, rnd=None) -> None:
+    """One sentinel-free-lane validation.  Counted in EVERY mode;
+    armed, no element may equal the ``pad`` sentinel — a surviving
+    sentinel here escaped its mask and is headed into arithmetic."""
+    with _mu:
+        _checks[name] = _checks.get(name, 0) + 1
+    if not _armed:
+        return
+    a = np.asarray(arr() if callable(arr) else arr)
+    if a.size and bool((a == pad).any()):
+        raise PadLeakError(
+            f"lane `{name}`: PAD/sentinel value {pad} present on a "
+            f"lane declared sentinel-free{_where(doc, cls, rnd)} — "
+            f"the mask upstream leaked it ({_ENV}=1)"
+        )
+
+
+def note_mask(tag: str, n: int = 1) -> None:
+    """One dispatch through a declared clamp-mask region (the
+    ``# graftlint: mask=<tag>`` pair).  Counted in EVERY mode — the
+    G029 dead-mask ground truth: a declared mask whose region no
+    armed-surface run ever dispatched is stale."""
+    with _mu:
+        _masks[tag] = _masks.get(tag, 0) + n
+
+
+def counters() -> dict:
+    """Snapshot: ``{"checks": {name: n}, "masks": {tag: n}}`` —
+    populated in every mode (the G029 ground truth)."""
+    with _mu:
+        return {
+            "checks": dict(sorted(_checks.items())),
+            "masks": dict(sorted(_masks.items())),
+        }

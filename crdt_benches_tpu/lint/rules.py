@@ -46,6 +46,12 @@ from .lifecycle import (
     g025_lifecycle_artifact,
 )
 from .pallas_rules import g009_pallas_grid, g010_block_lane
+from .ranges import (
+    g026_index_guard,
+    g027_narrow_overflow,
+    g028_pad_flow,
+    g029_ranges_artifact,
+)
 from .threads import (
     g014_shared_escape,
     g015_publish_discipline,
@@ -1080,4 +1086,8 @@ RULES = {
     "G023": g023_acquire_release,
     "G024": g024_identity_hazards,
     "G025": g025_lifecycle_artifact,  # artifact-driven; see run_lint
+    "G026": g026_index_guard,
+    "G027": g027_narrow_overflow,
+    "G028": g028_pad_flow,
+    "G029": g029_ranges_artifact,  # artifact-driven; see run_lint
 }

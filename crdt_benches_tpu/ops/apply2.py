@@ -102,7 +102,9 @@ def count_le_tiled(sorted_rc: jax.Array, q: jax.Array) -> jax.Array:
             (smax[:, None, :] <= q[:, :, None]).astype(jnp.int32), axis=2
         )
         sq = jnp.minimum(nsf, ns - 1)
-        srow = jnp.take_along_axis(sup, sq[:, :, None], axis=1, mode="clip")
+        # the clamp region (nsf == ns) reads the LAST super-block; the
+        # nfull >= nt select below overwrites those queries with C
+        srow = jnp.take_along_axis(sup, sq[:, :, None], axis=1, mode="clip")  # graftlint: mask=count-le-clamp
         nfull = sq * LANE + jnp.sum(
             (srow <= q[:, :, None]).astype(jnp.int32), axis=2
         )
@@ -110,11 +112,11 @@ def count_le_tiled(sorted_rc: jax.Array, q: jax.Array) -> jax.Array:
     # Fetch each query's crossing tile row.  Integer gather of B rows (exact;
     # an MXU one-hot matmul here silently rounds through bf16 passes and
     # would corrupt cumvis values above 2^8-mantissa range).
-    rows = jnp.take_along_axis(
+    rows = jnp.take_along_axis(  # graftlint: mask=count-le-clamp
         tiles, tq[:, :, None], axis=1, mode="clip"
     )  # (R, B, LANE)
     within = jnp.sum((rows <= q[:, :, None]).astype(jnp.int32), axis=2)
-    return jnp.where(nfull >= nt, C, nfull * LANE + within)
+    return jnp.where(nfull >= nt, C, nfull * LANE + within)  # graftlint: mask=count-le-clamp
 
 
 def rank_to_phys2(cumvis: jax.Array, rank: jax.Array) -> jax.Array:

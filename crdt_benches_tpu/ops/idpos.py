@@ -125,7 +125,11 @@ def query(
     (an id inserted at level k takes its in-run position, already in that
     level's frame, then shifts through newer levels)."""
     R, C = snap.shape
-    p = jnp.take_along_axis(snap, jnp.clip(ids, 0, C - 1), axis=1)
+    # ids < 0 is IN the contract (docstring): the clamp region's
+    # garbage is masked by every caller, which lives outside this
+    # module (engine/downstream*), so the in-module mask-pair rule
+    # cannot see it — suppressed, not annotated
+    p = jnp.take_along_axis(snap, jnp.clip(ids, 0, C - 1), axis=1)  # graftlint: disable=G026
     for lv in levels:
         shift = jnp.sum(
             jnp.where(

@@ -37,8 +37,10 @@ import numpy as np
 NARROW_DTYPES = (np.int8, np.uint16, np.uint16, np.uint16)
 WIDE_DTYPES = (np.int8, np.int32, np.int32, np.int32)
 
-#: Largest id-space bound the narrow (uint16) lanes can carry.
-NARROW_ID_BOUND = np.iinfo(np.uint16).max  # 65535
+#: Largest id-space bound the narrow (uint16) lanes can carry.  Kept a
+#: literal (== np.iinfo(np.uint16).max) so the lint constant
+#: environment can resolve ``inrange=...<=NARROW_ID_BOUND`` markers.
+NARROW_ID_BOUND = 65535
 
 
 class OpRangeError(ValueError):

@@ -377,8 +377,11 @@ def serve_apply_round_xla(state: PackedState, tokens, dints,
     delta_cum = jnp.cumsum(dd_dense, axis=1)
 
     # ---- expansion as one clamped gather + fill ----
-    doc = jnp.take_along_axis(doc, jnp.maximum(col - cnt, 0), axis=1)
-    doc = jnp.where(
+    # col - cnt < 0 exactly on the insert-fill columns; the clamped
+    # gather reads column 0 garbage there, and the ind > 0 select
+    # below overwrites every such column with the fill encoding
+    doc = jnp.take_along_axis(doc, jnp.maximum(col - cnt, 0), axis=1)  # graftlint: mask=fused-gap-gather surface=fused
+    doc = jnp.where(  # graftlint: mask=fused-gap-gather surface=fused
         ind > 0, jnp.left_shift(col + delta_cum + 2, 1) | 1, doc
     )
 

@@ -52,6 +52,7 @@ from ..lint import (
     fs_sanitizer,
     lifecycle_sanitizer,
     race_sanitizer,
+    range_sanitizer,
     sanitizer,
 )
 from ..obs import trace as obs_trace
@@ -450,11 +451,10 @@ def run_serve_bench(
                 "--serve-stream does not compose with --serve-open: "
                 "the open-loop plan tensorizes every stream up front"
             )
-        if mesh_devices > 1:
-            raise ValueError(
-                "--serve-stream is single-host for now (lazy "
-                "materialization feeds one scheduler)"
-            )
+        # --serve-mesh composes: the FleetSpec is pure (seed, doc_id)
+        # arithmetic, so the doc range shards trivially
+        # (FleetSpec.shard_range) and the pool's mesh sharding applies
+        # to lazily installed rows exactly as it does to eager ones
     # elastic reconfiguration (--serve-reshard): a live shard-map change
     # mid-drain — its own bench-id family serve/reshard/<mix>/<fleet>.
     # The coordinator journals every migration decision, so the WAL is
@@ -641,6 +641,19 @@ def run_serve_bench(
         if lifecycle_sanitized:
             log("serve: lifecycle sanitizer ARMED "
                 "(CRDT_BENCH_SANITIZE_LIFECYCLE)")
+        # value-range ground truth (lint G029's ranges block): staged
+        # index-check and clamp-mask dispatch counters, reset per
+        # drain; with CRDT_BENCH_SANITIZE_RANGES=1 every declared
+        # index operand is validated against its bound on the staged
+        # HOST tensors pre-dispatch — out-of-range indices, narrow-
+        # lane overflow and PAD leaks raise typed errors at their
+        # callsites instead of corrupting bytes silently
+        # (lint/range_sanitizer.py)
+        range_sanitizer.reset_counters()
+        range_sanitized = range_sanitizer.armed()
+        if range_sanitized:
+            log("serve: range sanitizer ARMED "
+                "(CRDT_BENCH_SANITIZE_RANGES)")
         if journal_dir:
             fs_sanitizer.watch_root(journal_dir)
         if telemetry is not None:
@@ -698,6 +711,15 @@ def run_serve_bench(
                 + f"; classes={classes} slots={slots} batch={batch} "
                 f"chars={batch_chars} K={macro_k} kernel={serve_kernel}"
             )
+            if mesh is not None:
+                # the lazy fleet over a mesh: doc ranges split per
+                # shard by pure arithmetic — no shard ever touches
+                # another shard's sessions to materialize its own
+                spans = ", ".join(
+                    "{}:[{},{})".format(s, *spec.shard_range(s, pool.n_sh))
+                    for s in range(pool.n_sh)
+                )
+                log(f"serve: streaming doc range over mesh — {spans}")
         else:
             streams = prepare_streams(
                 sessions, pool, batch=batch, batch_chars=batch_chars
@@ -1346,6 +1368,23 @@ def run_serve_bench(
             "resources": lc_counts["resources"],
             "unattributed": lc_counts["unattributed"],
         }
+        # ---- value-range ground truth (lint G029 cross-checks the
+        # declared inrange=/mask= model against exactly this block) ----
+        range_counts = range_sanitizer.counters()
+        ranges_block = {
+            "version": 1,
+            "sanitized": range_sanitized,
+            # armed surfaces (the dead-fact/dead-mask scoping): the
+            # staging boundary is crossed on every drain; fused/scan
+            # track which serve kernel this run dispatched, so a
+            # kernel-scoped mask (the fused gap gather) is only
+            # dead-checked against runs that ran that kernel
+            "staging": True,
+            "fused": serve_kernel == "fused",
+            "scan": serve_kernel == "scan",
+            "checks": range_counts["checks"],
+            "masks": range_counts["masks"],
+        }
         log(
             "serve: fs protocols — entries "
             + (", ".join(
@@ -1547,6 +1586,10 @@ def run_serve_bench(
                 # + ownership acquire/release ledger (lint G025's
                 # ground truth; bench_compare: skip-with-note)
                 "lifecycle": lifecycle_block,
+                # versioned value-range block: staged index-check and
+                # clamp-mask dispatch counters (lint G029's ground
+                # truth; bench_compare: skip-with-note)
+                "ranges": ranges_block,
                 # versioned typed-metric registry: every counter /
                 # gauge / histogram the drain emitted (obs/metrics.py)
                 "metrics": stats.metrics.to_dict(),

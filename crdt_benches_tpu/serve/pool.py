@@ -79,7 +79,7 @@ from ..lint.sanitizer import fenced
 from ..obs.metrics import Counter, Gauge
 from .prefetch import Prefetcher
 from ..ops.apply2 import LANE, PackedState, apply_batch3
-from ..ops.packing import op_lane_dtypes, widen_ops
+from ..ops.packing import NARROW_ID_BOUND, op_lane_dtypes, widen_ops
 from ..ops.resolve import resolve_batch
 from ..ops.serve_fused import (
     NARROW_RESOLVE_OPS,
@@ -96,6 +96,7 @@ from ..ops.serve_fused import (
     trivial_round_tokens,
 )
 from ..lint import lifecycle_sanitizer as lifecycle
+from ..lint import range_sanitizer as range_rt
 from ..lint.fs_sanitizer import fs_protocol
 from ..traces.tensorize import PAD
 from ..utils.checkpoint import (
@@ -142,6 +143,10 @@ def fleet_step(state: PackedState, kind, pos, slot) -> PackedState:
 
 @partial(jax.jit, donate_argnums=(0,))
 def _write_row(state: PackedState, row, doc, length, nvis) -> PackedState:
+    # graftlint: inrange=row<nrows check=pool.write-row
+    # (row is a host int validated against the bucket's row count by
+    # range_sanitizer.check_index at _install, the only caller — an
+    # out-of-range row here would silently DROP the write)
     return PackedState(
         doc=state.doc.at[row].set(doc),
         length=state.length.at[row].set(length),
@@ -721,6 +726,9 @@ class DocPool:
             doc_row = np.concatenate(
                 [doc_row, np.full(b.C - len(doc_row), 2, np.int32)]
             )
+        range_rt.check_index(
+            "pool.write-row", row, len(b.rows), doc=rec.doc_id, cls=cls,
+        )
         b.state = _write_row(
             b.state, jnp.int32(row), jnp.asarray(doc_row),
             jnp.int32(length), jnp.int32(nvis),
@@ -1162,9 +1170,18 @@ class DocPool:
         rows the compose actually rewrote; the default (None) marks
         every row — conservative, never wrong."""
         b = self.buckets[cls]
+        if dirty_rows is not None:
+            dirty_rows = [int(r) for r in dirty_rows]
+            # the scheduler's batched install path rewrites these rows
+            # on host and re-uploads — same row-bound contract as the
+            # unit _install, same declared check name, so either write
+            # path keeps the pool.write-row runtime evidence alive
+            # graftlint: inrange=row<nrows check=pool.write-row
+            range_rt.check_index(
+                "pool.write-row", dirty_rows, len(b.rows), cls=cls,
+            )
         self._dirty[cls].update(
-            range(b.R) if dirty_rows is None
-            else (int(r) for r in dirty_rows)
+            range(b.R) if dirty_rows is None else dirty_rows
         )
         state = PackedState(
             doc=jnp.asarray(doc), length=jnp.asarray(length),
@@ -1635,7 +1652,29 @@ class DocPool:
         if Rt % b.n_sh or not b.n_sh <= Rt <= b.R:
             raise ValueError(f"tier {Rt} incompatible with bucket {b.R}")
         self._mark_op_rows(cls, kind, Rt)
+        # the staged-lane bound checks: host numpy, pre-dispatch, PAD
+        # lanes masked out (their pos/slot payloads are don't-care).
+        # Disarmed this is two counter bumps; armed it is the oracle
+        # for the silent clamp/wrap XLA would otherwise hand us.
+        # graftlint: inrange=pos<=cap check=pool.macro-pos
+        range_rt.check_index(
+            "pool.macro-pos", lambda: pos[kind != PAD], b.C + 1, cls=cls,
+        )
+        # graftlint: inrange=slot0<=NARROW_ID_BOUND check=pool.macro-ids
+        # (the declared fact is the NARROW ladder's repack ceiling; a
+        # wide ladder has no narrow repack, so its id space is bounded
+        # by the class capacity instead — ids are per-doc slot indices
+        # < capacity_need <= C)
+        narrow = self.op_dtypes[3] == np.dtype(np.uint16)
+        range_rt.check_narrow(
+            "pool.macro-ids", lambda: slot0[kind != PAD],
+            NARROW_ID_BOUND if narrow else b.C - 1, cls=cls,
+        )
+        # both serve kernels dispatch the count_le_tiled clamp region
+        # (fused directly, scan through the merge body's count passes)
+        range_rt.note_mask("count-le-clamp")
         if self.serve_kernel == "fused":
+            range_rt.note_mask("fused-gap-gather")
             fresh = self._fused_macro(cls, kind, pos, rlen, slot0, nbits)
             b.steps += K
             return fresh

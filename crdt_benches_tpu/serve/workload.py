@@ -356,6 +356,27 @@ class FleetSpec:
         """The whole fleet, eagerly (the legacy shape)."""
         return [self.session(i) for i in range(self.n_docs)]
 
+    def shard_range(self, shard: int, n_shards: int) -> tuple[int, int]:
+        """The ``[lo, hi)`` doc-id range shard ``shard`` of ``n_shards``
+        owns — the balanced contiguous split (sizes differ by at most
+        one).  The spec is pure ``(seed, doc_id)`` arithmetic, so a
+        shard materializes its range with NO reference to any other
+        shard's docs: this is the whole of the ROADMAP million-doc
+        item (d), the mesh split of the streaming path."""
+        if not 0 <= shard < n_shards:
+            raise ValueError(
+                f"shard {shard} outside [0, {n_shards})"
+            )
+        return (
+            shard * self.n_docs // n_shards,
+            (shard + 1) * self.n_docs // n_shards,
+        )
+
+    def shard_doc_ids(self, shard: int, n_shards: int) -> range:
+        """:meth:`shard_range` as an iterable of doc ids."""
+        lo, hi = self.shard_range(shard, n_shards)
+        return range(lo, hi)
+
 
 def build_fleet(
     n_docs: int,

@@ -15,7 +15,7 @@ BIG = jnp.int32(2**30)  # graftlint: disable=G001
 
 @jax.jit
 def shift(x):
-    return x + BIG
+    return x + BIG  # graftlint: disable=G028
 
 
 def make(n):

@@ -1,0 +1,35 @@
+"""G026 seeds: an unguarded dynamic gather, a clamped gather whose
+clamp region has no declared mask consumer, and a mask tag with no
+paired consumer site — next to the legal twins (a clip+mask pair and
+a declared-inrange scatter) that must stay silent."""
+
+import jax.numpy as jnp
+
+
+def unguarded_gather(doc, idx):
+    # idx is a bare parameter with no guard on any call path
+    return jnp.take_along_axis(doc, idx, axis=1)  # expect: G026
+
+
+def clamp_and_hope(doc, idx):
+    safe = jnp.maximum(idx, 0)
+    # clamped, so "guarded" — but the clamp region's garbage has no
+    # declared mask consumer
+    return jnp.take_along_axis(doc, safe, axis=1)  # expect: G026
+
+
+def half_pair(doc, idx):
+    safe = jnp.minimum(idx, 9)
+    # the tag never appears on a consuming `where` line
+    return jnp.take_along_axis(doc, safe, axis=1)  # graftlint: mask=fx-lonely  # expect: G026
+
+
+def masked_pair_ok(doc, idx):
+    safe = jnp.clip(idx, 0, 7)
+    g = jnp.take_along_axis(doc, safe, axis=1)  # graftlint: mask=fx-gap
+    return jnp.where(idx >= 0, g, 0)  # graftlint: mask=fx-gap
+
+
+def declared_fact_ok(doc, row):
+    # graftlint: inrange=row<128
+    return doc.at[row].set(0)

@@ -1,0 +1,35 @@
+"""G027 seeds: arithmetic on uint16 op lanes before the widen — the
+pos+rlen end-position sum that wraps past 65535 — plus a
+marker-declared narrow lane, next to the legal orders (widen-first,
+and arithmetic dominated by the OpRangeError staging bound check)."""
+
+import numpy as np
+
+
+class OpRangeError(ValueError):
+    pass
+
+
+def overflow_pos_rlen(pos, rlen):
+    pos16 = pos.astype(np.uint16)
+    rlen16 = rlen.astype(np.uint16)
+    # the end-position sum on two narrow lanes: wraps, never faults
+    return pos16 + rlen16  # expect: G027  expect: G027
+
+
+def declared_lane(slot0):
+    slot = slot0  # graftlint: narrow=slot
+    return slot * 2  # expect: G027
+
+
+def widen_first(pos):
+    pos16 = pos.astype(np.uint16)
+    wide = pos16.astype(np.int32)
+    return wide + 1
+
+
+def checked_first(pos, rlen):
+    pos16 = pos.astype(np.uint16)
+    if int(pos16.max()) > 65535:
+        raise OpRangeError("pos lane out of range")
+    return pos16 + 1

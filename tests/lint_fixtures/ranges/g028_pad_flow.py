@@ -1,0 +1,34 @@
+"""G028 seeds: the PAD sentinel used directly in arithmetic, and a
+sentinel-carrying local (planted by a `where`) leaking into a sum and
+an ordering comparison — next to the legal twins (comparison AGAINST
+the sentinel, and a mask applied before the arithmetic)."""
+
+import jax.numpy as jnp
+
+PAD = 0
+_BIG = 1 << 30
+
+
+def pad_in_arithmetic(kind):
+    return kind + PAD  # expect: G028
+
+
+def carrier_into_sum(live, d):
+    dd = jnp.where(live, d, _BIG)  # plants the sentinel on dead lanes
+    return dd + 1  # expect: G028
+
+
+def carrier_into_ordering(live, d, other):
+    dd = jnp.where(live, d, _BIG)
+    return dd < other  # expect: G028
+
+
+def compare_against_sentinel_ok(live, d):
+    dd = jnp.where(live, d, _BIG)
+    return dd >= _BIG  # the masking idiom itself
+
+
+def masked_first_ok(live, d):
+    dd = jnp.where(live, d, _BIG)
+    clean = jnp.where(dd >= _BIG, 0, dd)
+    return clean + 1

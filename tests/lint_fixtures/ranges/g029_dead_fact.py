@@ -1,0 +1,24 @@
+"""G029 seeds (artifact-driven, see artifact.json): a declared check
+and a declared mask the recorded run — staging surface armed — never
+counted, vs runtime counters for a check and a mask nothing here
+declares.  The fused-scoped pair stays silent: that surface was not
+armed in the recorded run."""
+
+import jax.numpy as jnp
+
+
+def stage(pos):
+    # graftlint: inrange=pos<=4096 check=fx.dead-check  # expect: G029
+    return pos
+
+
+def gather(doc, idx):
+    safe = jnp.clip(idx, 0, 7)
+    g = jnp.take_along_axis(doc, safe, axis=1)  # graftlint: mask=fx-dead-mask  # expect: G029
+    return jnp.where(idx < 8, g, 0)  # graftlint: mask=fx-dead-mask
+
+
+def fused_gather(doc, idx):
+    safe = jnp.maximum(idx, 0)
+    g = jnp.take_along_axis(doc, safe, axis=1)  # graftlint: mask=fx-fused-mask surface=fused
+    return jnp.where(idx > 0, g, 0)  # graftlint: mask=fx-fused-mask surface=fused

@@ -1,0 +1,21 @@
+"""Suppression contract for the ranges suite: every violation on this
+page is explicitly `# graftlint: disable=`d, so the file lints clean —
+the reviewed escape hatch works for G026-G028 like every other rule."""
+
+import jax.numpy as jnp
+import numpy as np
+
+PAD = 0
+
+
+def unguarded(doc, idx):
+    return jnp.take_along_axis(doc, idx, axis=1)  # graftlint: disable=G026
+
+
+def narrow_sum(pos):
+    pos16 = pos.astype(np.uint16)
+    return pos16 + 1  # graftlint: disable=G027
+
+
+def pad_math(kind):
+    return kind + PAD  # graftlint: disable=G028

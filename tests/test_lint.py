@@ -41,14 +41,15 @@ ALL_FIXTURE_FILES = sorted(p for p in FIXTURES.glob("**/*.py"))
 
 #: Cross-module corpora (``xmod_*`` directories) lint as a UNIT — their
 #: rules see nothing in a single-file run — so the per-file contract
-#: below covers only the standalone fixtures.  The G017, G021, and G025
-#: fixtures are artifact-driven the same way G011 is (no ground truth,
-#: no findings), so their explicit tests pass the artifact instead.
+#: below covers only the standalone fixtures.  The G017, G021, G025,
+#: and G029 fixtures are artifact-driven the same way G011 is (no
+#: ground truth, no findings), so their explicit tests pass the
+#: artifact instead.
 FIXTURE_FILES = [
     p for p in ALL_FIXTURE_FILES
     if not any(part.startswith("xmod_") for part in p.parts)
     and p.name not in ("g017_dead_publish.py", "g021_dead_protocol.py",
-                       "g025_dead_machine.py")
+                       "g025_dead_machine.py", "g029_dead_fact.py")
 ]
 XMOD_DIRS = sorted(
     d for d in FIXTURES.iterdir()
@@ -59,6 +60,7 @@ G011_DIR = FIXTURES / "xmod_g011"
 THREADS_DIR = FIXTURES / "threads"
 FSOPS_DIR = FIXTURES / "fsops"
 LIFECYCLE_DIR = FIXTURES / "lifecycle"
+RANGES_DIR = FIXTURES / "ranges"
 
 
 def test_corpus_is_nonempty():
@@ -294,6 +296,7 @@ def test_every_rule_has_a_detection_case():
         "G014", "G015", "G016", "G017",
         "G018", "G019", "G020", "G021",
         "G022", "G023", "G024", "G025",
+        "G026", "G027", "G028", "G029",
     } <= covered
 
 
@@ -620,6 +623,101 @@ def test_sarif_covers_the_lifecycle_rules():
     doc = json.loads(format_sarif(findings))
     rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
     assert rules == {"G022", "G023", "G024"}
+    assert all(r["level"] == "error" for r in doc["runs"][0]["results"])
+
+
+def test_ranges_corpus_covers_each_rule_exactly():
+    """The value-range corpus seeds the canonical shape of each static
+    hazard: the unguarded dynamic gather, the clamp-and-hope gather
+    with no declared mask consumer, the half-declared mask pair
+    (G026); narrow uint16 arithmetic before the widen and a
+    marker-declared narrow lane (G027); the PAD constant in
+    arithmetic and a sentinel-carrying local leaking into a sum and
+    an ordering comparison (G028) — while every legal twin (clip+mask
+    pair, declared inrange fact, widen-first, OpRangeError-dominated,
+    compare-against-sentinel, mask-first) stays silent."""
+    g026_path = RANGES_DIR / "g026_unguarded_gather.py"
+    g026 = run_lint([str(g026_path)])
+    assert {f.rule for f in g026} == {"G026"}
+    assert [(f.rule, f.line) for f in g026] == sorted(
+        expected_markers(g026_path), key=lambda rl: rl[1]
+    )
+    assert "unguarded dynamic index" in g026[0].msg
+    assert "no declared mask consumer" in g026[1].msg
+    assert "no paired consumer" in g026[2].msg
+    g027_path = RANGES_DIR / "g027_narrow_overflow.py"
+    g027 = run_lint([str(g027_path)])
+    assert {f.rule for f in g027} == {"G027"}
+    # line 17 fires twice — once per narrow operand lane
+    assert sorted((f.rule, f.line) for f in g027) == [
+        ("G027", 17), ("G027", 17), ("G027", 22),
+    ]
+    assert expected_markers(g027_path) == {("G027", 17), ("G027", 22)}
+    assert all("before a widen" in f.msg for f in g027)
+    g028_path = RANGES_DIR / "g028_pad_flow.py"
+    g028 = run_lint([str(g028_path)])
+    assert {f.rule for f in g028} == {"G028"}
+    assert [(f.rule, f.line) for f in g028] == sorted(
+        expected_markers(g028_path), key=lambda rl: rl[1]
+    )
+    assert "used directly in arithmetic" in g028[0].msg
+    assert "no intervening mask" in g028[1].msg
+    assert "ordering comparison" in g028[2].msg
+
+
+def test_g029_dead_fact_and_rogue_counters():
+    """G029 mirrors G011/G017/G021/G025 for range declarations: a
+    declared check/mask the artifact's run never counted is flagged at
+    its declaration line (scoped by armed surface — the fixture
+    artifact armed ``staging`` only, so the fused-scoped mask stays
+    silent), and runtime counters with no declaration are flagged
+    against the artifact.  Without an artifact the rule stays
+    silent."""
+    artifact = RANGES_DIR / "artifact.json"
+    path = RANGES_DIR / "g029_dead_fact.py"
+    findings = run_lint([str(path)], ranges_artifact=str(artifact))
+    dead = {(f.path, f.rule, f.line) for f in findings
+            if f.path.endswith(".py")}
+    assert dead == {
+        (str(path), r, ln) for r, ln in expected_markers(path)
+    }, "\n".join(f"  {f.path}:{f.line} {f.rule} {f.msg}" for f in findings)
+    assert any("dead fact" in f.msg for f in findings)
+    assert any("dead mask" in f.msg for f in findings)
+    from_artifact = [f for f in findings if f.path == str(artifact)]
+    assert len(from_artifact) == 2
+    assert any("runtime range check `fx.rogue-check`" in f.msg
+               for f in from_artifact)
+    assert any("runtime mask counter `fx-rogue-mask`" in f.msg
+               for f in from_artifact)
+    assert run_lint([str(path)]) == []  # no artifact -> no G029
+
+
+def test_g029_selected_without_artifact_fails_like_g011():
+    findings = run_lint(
+        [str(RANGES_DIR / "g029_dead_fact.py")], select={"G029"}
+    )
+    assert [f.rule for f in findings] == ["G000"]
+    assert "--ranges-artifact" in findings[0].msg
+
+
+def test_ranges_suppression_contract():
+    """`# graftlint: disable=G026/27/28` silences the range rules
+    exactly like every other rule."""
+    findings = run_lint([str(RANGES_DIR / "suppressed_clean.py")])
+    assert findings == []
+
+
+def test_sarif_covers_the_range_rules():
+    from crdt_benches_tpu.lint import format_sarif
+
+    findings = run_lint([
+        str(RANGES_DIR / "g026_unguarded_gather.py"),
+        str(RANGES_DIR / "g027_narrow_overflow.py"),
+        str(RANGES_DIR / "g028_pad_flow.py"),
+    ])
+    doc = json.loads(format_sarif(findings))
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rules == {"G026", "G027", "G028"}
     assert all(r["level"] == "error" for r in doc["runs"][0]["results"])
 
 

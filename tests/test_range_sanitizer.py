@@ -1,0 +1,137 @@
+"""Unit contract of the value-range sanitizer (lint/range_sanitizer.py)
+and the dtype ceilings of the packed op lanes: counters bump in every
+mode, armed violations raise their typed error with attribution, and
+``pack_ops`` refuses — never wraps — a value past the uint16 ceiling."""
+
+import numpy as np
+import pytest
+
+from crdt_benches_tpu.lint import range_sanitizer as rs
+from crdt_benches_tpu.ops.packing import (
+    NARROW_ID_BOUND, OpRangeError, op_lane_dtypes, pack_ops)
+
+
+@pytest.fixture(autouse=True)
+def _reset(monkeypatch):
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_RANGES", raising=False)
+    rs.disarm()
+    rs.reset_counters()
+    yield
+    rs.disarm()
+    rs.reset_counters()
+
+
+# ---------------------------------------------------------------------------
+# counters: the G029 ground truth bumps in EVERY mode
+# ---------------------------------------------------------------------------
+
+
+def test_counters_bump_disarmed_and_nothing_validates():
+    assert not rs.armed()
+    # wildly out of range — disarmed, only the counter moves
+    rs.check_index("t.idx", np.array([99, -5]), 4)
+    rs.check_narrow("t.lane", np.array([1 << 20]), 255)
+    rs.check_no_pad("t.pad", np.array([0, 0]), 0)
+    rs.note_mask("t-mask", n=3)
+    c = rs.counters()
+    assert c["checks"] == {"t.idx": 1, "t.lane": 1, "t.pad": 1}
+    assert c["masks"] == {"t-mask": 3}
+
+
+def test_callable_operand_is_not_evaluated_disarmed():
+    """The lazy-operand contract: disarmed cost is ONE counter bump —
+    a callable arr (e.g. a lambda masking PAD lanes) must not run."""
+    def boom():
+        raise RuntimeError("evaluated while disarmed")
+
+    rs.check_index("t.lazy", boom, 8)
+    rs.arm()
+    with pytest.raises(RuntimeError, match="evaluated while disarmed"):
+        rs.check_index("t.lazy", boom, 8)
+    assert rs.counters()["checks"]["t.lazy"] == 2
+
+
+def test_env_flag_arms_at_reset(monkeypatch):
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_RANGES", "1")
+    assert rs.sanitizing()
+    rs.reset_counters()  # arming happens here, eagerly
+    assert rs.armed()
+
+
+# ---------------------------------------------------------------------------
+# armed: each violation is its typed error, with attribution
+# ---------------------------------------------------------------------------
+
+
+def test_index_out_of_bounds_is_typed_and_attributed():
+    rs.arm()
+    rs.check_index("t.idx", np.array([0, 3]), 4, doc=7, cls=256, rnd=2)
+    with pytest.raises(rs.IndexOutOfBoundsError) as ei:
+        rs.check_index("t.idx", np.array([0, 4]), 4, doc=7, cls=256)
+    msg = str(ei.value)
+    assert "value 4 outside [0, 4)" in msg
+    assert "doc=7" in msg and "class=256" in msg
+    with pytest.raises(rs.IndexOutOfBoundsError, match="value -1"):
+        rs.check_index("t.idx", np.array([-1]), 4)
+    # the lo= floor widens the legal window
+    rs.check_index("t.idx", np.array([-1]), 4, lo=-1)
+
+
+def test_narrow_overflow_is_inclusive_at_the_ceiling():
+    rs.arm()
+    rs.check_narrow("t.lane", np.array([NARROW_ID_BOUND]),
+                    NARROW_ID_BOUND)  # == bound is legal (inclusive)
+    with pytest.raises(rs.NarrowOverflowError, match="65536"):
+        rs.check_narrow("t.lane", np.array([NARROW_ID_BOUND + 1]),
+                        NARROW_ID_BOUND)
+
+
+def test_pad_leak_is_typed():
+    rs.arm()
+    rs.check_no_pad("t.pad", np.array([1, 2, 3]), 0)
+    with pytest.raises(rs.PadLeakError, match="sentinel value 0"):
+        rs.check_no_pad("t.pad", np.array([1, 0, 3]), 0)
+
+
+def test_typed_errors_share_a_base_class():
+    for exc in (rs.IndexOutOfBoundsError, rs.NarrowOverflowError,
+                rs.PadLeakError):
+        assert issubclass(exc, rs.RangeSanitizerError)
+
+
+# ---------------------------------------------------------------------------
+# pack_ops at the uint16 ceiling: refuse, never wrap
+# ---------------------------------------------------------------------------
+
+
+def _lanes(slot0_val: int):
+    kind = np.array([1], np.int8)
+    pos = np.array([0], np.int64)
+    rlen = np.array([1], np.int64)
+    slot0 = np.array([slot0_val], np.int64)
+    return kind, pos, rlen, slot0
+
+
+def test_pack_ops_narrow_ceiling_65534_65535_65536():
+    """The headline dtype edge: 65534 and 65535 pack losslessly into
+    the narrow uint16 lanes; 65536 raises ``OpRangeError`` — it must
+    NEVER wrap to 0 and alias slot id 0."""
+    assert op_lane_dtypes(NARROW_ID_BOUND)[3] == np.dtype(np.uint16)
+    for v in (65534, 65535):
+        k, p, r, s = pack_ops(*_lanes(v), max_class=NARROW_ID_BOUND)
+        assert s.dtype == np.uint16 and int(s[0]) == v
+    with pytest.raises(OpRangeError, match="65536"):
+        pack_ops(*_lanes(65536), max_class=NARROW_ID_BOUND)
+
+
+def test_pack_ops_wide_lanes_carry_past_the_ceiling():
+    """One past the narrow bound flips the WHOLE pool to int32 lanes —
+    and 65536 is then a legal id, not an error."""
+    assert op_lane_dtypes(NARROW_ID_BOUND + 1)[3] == np.dtype(np.int32)
+    k, p, r, s = pack_ops(*_lanes(65536), max_class=NARROW_ID_BOUND + 1)
+    assert s.dtype == np.int32 and int(s[0]) == 65536
+
+
+def test_pack_ops_negative_never_wraps_into_uint16():
+    with pytest.raises(OpRangeError, match="-1"):
+        pack_ops(*_lanes(-1), max_class=NARROW_ID_BOUND)

@@ -1,0 +1,236 @@
+"""graftlint v6 headline harness: the dtype-edge adversarial drains
+(serve/edgecheck.py) run armed and byte-exact across both kernels, the
+G029 cross-check is green in both directions on a real sanitized bench
+artifact (and red on a doctored one), and the ``ranges`` block rides
+bench_compare's one-sided skip matrix."""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+from crdt_benches_tpu.lint import range_sanitizer as rs
+from crdt_benches_tpu.lint.core import run_lint
+
+PACKAGE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "crdt_benches_tpu")
+
+_BANDS = {"synth-small": ("synth", (8, 36))}
+_MIX = {"synth-small": 1.0}
+
+
+@pytest.fixture(autouse=True)
+def _rs_reset(monkeypatch):
+    """Every test owns a clean sanitizer window."""
+    monkeypatch.delenv("CRDT_BENCH_SANITIZE_RANGES", raising=False)
+    rs.disarm()
+    rs.reset_counters()
+    yield
+    rs.disarm()
+    rs.reset_counters()
+
+
+# ---------------------------------------------------------------------------
+# the headline drain
+# ---------------------------------------------------------------------------
+
+
+def test_edgecheck_small_is_byte_exact_with_full_coverage(tmp_path):
+    """THE graftlint v6 acceptance gate (tier-1 shape): the structural
+    dtype-edge fleet — position extremes, empty churn, the zero-op
+    all-PAD stream, exact-capacity landings, id pressure — drains
+    armed through BOTH kernels, every doc oracle- and cross-kernel
+    byte-identical, every required range check and mask counter
+    nonzero, and every boundary contract rejects its edge
+    perturbations."""
+    from crdt_benches_tpu.serve.edgecheck import (
+        _REQUIRED_CHECKS, _REQUIRED_MASKS, run_edgecheck)
+
+    report = run_edgecheck(str(tmp_path), small=True)
+    assert set(report["ladders"]) == {"small-ladder"}
+    lad = report["ladders"]["small-ladder"]
+    assert lad["docs"] >= 9
+    assert lad["rounds"]["fused"] > 0 and lad["rounds"]["scan"] > 0
+    for name in _REQUIRED_CHECKS:
+        assert report["checks"].get(name), report["checks"]
+    for tag in _REQUIRED_MASKS:
+        assert report["masks"].get(tag), report["masks"]
+    fuzz = report["boundary_fuzz"]
+    assert fuzz["contracts"] >= 10
+    assert fuzz["rejected"] > 0
+    assert all(n > 0 for n in fuzz["per_entry"].values())
+    # the harness leaves the sanitizer disarmed for the rest of the suite
+    assert not rs.armed()
+
+
+def test_edgecheck_cli_exit_codes(tmp_path, capsys):
+    from crdt_benches_tpu.serve import edgecheck
+
+    assert edgecheck.main(["--bogus"]) == 2
+    assert "usage:" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# G029 cross-check on a real sanitized bench artifact
+# ---------------------------------------------------------------------------
+
+
+def test_g029_cross_check_clean_both_directions(tmp_path, monkeypatch):
+    """A sanitized fused-kernel drain emits a ``ranges`` block that
+    cross-checks clean against the static ``inrange=``/``mask=``
+    markers in BOTH directions: no dead declared fact or mask on an
+    armed surface, no rogue runtime counter."""
+    monkeypatch.setenv("CRDT_BENCH_SANITIZE_RANGES", "1")
+    from crdt_benches_tpu.serve.bench import run_serve_bench
+
+    r, info = run_serve_bench(
+        mix=_MIX, bands=_BANDS,
+        n_docs=10, batch=16, classes=(256,), slots=(2,),
+        macro_k=2, batch_chars=64, arrival_span=2, verify_sample=3,
+        results_dir=str(tmp_path), save_name="rg_smoke",
+        log=lambda s: None,
+    )
+    assert info["verify_ok"]
+    block = r.extra["ranges"]
+    assert block["version"] == 1 and block["sanitized"]
+    assert block["staging"] and block["fused"] and not block["scan"]
+    # the scheduler's batched install path keeps the write-row check
+    # alive (DocPool.admit is NOT on this path — upload_bucket is)
+    assert block["checks"].get("pool.write-row", 0) > 0
+    assert block["checks"].get("pool.macro-pos", 0) > 0
+    assert block["checks"].get("pool.macro-ids", 0) > 0
+    assert block["masks"].get("count-le-clamp", 0) > 0
+    assert block["masks"].get("fused-gap-gather", 0) > 0
+    artifact = str(tmp_path / "rg_smoke.json")
+    assert os.path.exists(artifact)
+    findings = run_lint([PACKAGE], select={"G029"},
+                        ranges_artifact=artifact)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.msg}" for f in findings
+    )
+
+
+def test_g029_flags_dead_fact_dead_mask_and_rogue_on_doctored(tmp_path):
+    """All the failure directions against a doctored block: every
+    declared staging fact/mask is dead (the doctored run counted none
+    of them), the fused-scoped mask is NOT dead-checked (that surface
+    was not armed), and rogue runtime counters are flagged against the
+    artifact."""
+    artifact = tmp_path / "doctored.json"
+    artifact.write_text(json.dumps({"ranges": {
+        "version": 1, "sanitized": True,
+        "staging": True, "fused": False, "scan": False,
+        "checks": {"ghost.check": 3},
+        "masks": {"rogue-tag": 2},
+    }}))
+    findings = run_lint([PACKAGE], select={"G029"},
+                        ranges_artifact=str(artifact))
+    msgs = [f.msg for f in findings]
+    assert any("`pool.write-row`" in m and "dead fact" in m for m in msgs)
+    assert any("`pool.macro-pos`" in m and "dead fact" in m for m in msgs)
+    assert any("`count-le-clamp`" in m for m in msgs)
+    # fused not armed in the doctored run -> the fused gap-gather mask
+    # is out of scope, not dead
+    assert not any("fused-gap-gather" in m for m in msgs)
+    assert any("`ghost.check`" in m for m in msgs)
+    assert any("`rogue-tag`" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the ranges block rides the one-sided matrix
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    repo = pathlib.Path(PACKAGE).parent
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_ranges", repo / "tools" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_compare_ranges"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _compare_artifact(tmp_path, name: str, *, ranges: bool) -> str:
+    extra = {
+        "family": "serve",
+        "patches_per_sec": 100_000.0,
+        "batch_latency": {"p50": 0.001, "p95": 0.004, "p99": 0.005},
+        "rounds": 40,
+        "range_ops": 10_000,
+        "journal": None,
+    }
+    if ranges:
+        extra["ranges"] = {
+            "version": 1, "sanitized": True,
+            "staging": True, "fused": True, "scan": False,
+            "checks": {"pool.write-row": 40},
+            "masks": {"count-le-clamp": 40},
+        }
+    data = [{"group": "serve", "trace": "mixed", "backend": "512",
+             "extra": extra}]
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_ranges_block_skips_both_directions(
+        tmp_path, capsys):
+    """A sanitized run diffed against a pre-v6 baseline (and vice
+    versa) is a schema difference, never an error: the ranges block is
+    a skip-with-note in both directions, and matched pairs diff
+    silently."""
+    bc = _bench_compare()
+    with_rg = _compare_artifact(tmp_path, "rg.json", ranges=True)
+    without = _compare_artifact(tmp_path, "plain.json", ranges=False)
+    for pair in ((with_rg, without), (without, with_rg)):
+        assert bc.main(list(pair)) == 0
+        out = capsys.readouterr().out
+        assert "SKIP" in out and "ranges block" in out
+        assert "present only in" in out
+    # both sides carrying the block is NOT a schema difference
+    assert bc.main([with_rg, with_rg]) == 0
+    assert "ranges block" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# runner rejection matrix (exit 2 — rejected before any fleet is built)
+# ---------------------------------------------------------------------------
+
+
+_REJECTS = [
+    (["--serve-edgecheck", "small", "--serve-stream"], "stream"),
+    (["--serve-edgecheck", "small", "--serve-journal", "auto"],
+     "journal"),
+    (["--serve-edgecheck", "small", "--serve-mesh", "3"], "mesh"),
+    (["--serve-edgecheck", "small", "--serve-writers", "2"], "writers"),
+    (["--serve-edgecheck", "small", "--serve-open", "32"], "open"),
+    (["--serve-edgecheck", "small", "--serve-record-evict"],
+     "record-evict"),
+    (["--serve-edgecheck", "bogus"], "bad-mode"),
+]
+
+
+@pytest.mark.parametrize("extra,tag", _REJECTS,
+                         ids=[t for _, t in _REJECTS])
+def test_runner_rejects_edgecheck_conflicts(extra, tag):
+    """--serve-edgecheck owns its fleets, both kernels, and the armed
+    sanitizer: bench-drain-shaping flags are usage errors — exit 2
+    with a message naming the flag, no fleet built."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "crdt_benches_tpu.bench.runner",
+         "--family", "serve", "--serve-docs", "8"] + extra,
+        capture_output=True, text=True, timeout=120,
+        cwd=str(pathlib.Path(PACKAGE).parent),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2, proc.stderr
+    assert "--serve-edgecheck" in proc.stderr
+    if tag != "bad-mode":  # bad-mode is argparse's own choices error
+        assert "not supported with" in proc.stderr, proc.stderr

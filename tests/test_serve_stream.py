@@ -505,3 +505,57 @@ def test_bench_compare_construction_matrix(tmp_path, capsys):
         assert bc.main(list(pair)) == 0
         out = capsys.readouterr().out
         assert "SKIP" in out
+
+
+# ---------------------------------------------------------------------------
+# mesh split of the streaming path (ROADMAP million-doc item (d))
+# ---------------------------------------------------------------------------
+
+
+def test_shard_range_is_a_balanced_partition():
+    """`FleetSpec.shard_range` is a partition of the doc-id space:
+    contiguous, disjoint, covering, balanced to within one doc — pure
+    (seed, doc_id) arithmetic, so a shard never needs another shard's
+    docs to materialize its range."""
+    spec = _spec(n=23)
+    for n_shards in (1, 2, 5, 8, 23, 30):
+        ranges = [spec.shard_range(s, n_shards) for s in range(n_shards)]
+        ids = [list(spec.shard_doc_ids(s, n_shards))
+               for s in range(n_shards)]
+        # covering + disjoint: concatenation IS the doc-id space
+        assert [i for chunk in ids for i in chunk] == list(range(23))
+        sizes = [hi - lo for lo, hi in ranges]
+        assert max(sizes) - min(sizes) <= 1, (n_shards, sizes)
+    with pytest.raises(ValueError):
+        spec.shard_range(8, 8)
+    with pytest.raises(ValueError):
+        spec.shard_range(-1, 8)
+
+
+def test_mesh_stream_fleet_matches_unsharded(tmp_path):
+    """The streaming construction path over the 8-device virtual mesh:
+    a LazyStreams drain with the pool sharded decodes byte-identically
+    to the single-device drain, and both match the oracle."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    from crdt_benches_tpu.parallel.mesh import replica_mesh
+
+    def run(mesh, sub):
+        spec = _spec(n=12, seed=5, arrival_span=2)
+        pool = DocPool(classes=(128,), slots=(8,), mesh=mesh,
+                       spool_dir=str(tmp_path / sub))
+        try:
+            streams = LazyStreams(spec, pool, batch=8, batch_chars=32)
+            FleetScheduler(pool, streams, batch=8, macro_k=4,
+                           batch_chars=32).run()
+            return spec, {i: pool.decode(i) for i in range(spec.n_docs)}
+        finally:
+            pool.close()
+
+    spec, plain = run(None, "plain")
+    _, sharded = run(replica_mesh(8), "mesh")
+    assert plain == sharded
+    for i in range(spec.n_docs):
+        assert plain[i] == replay_trace(spec.session(i).trace)

@@ -162,10 +162,14 @@ def _syncs_per_round(extra: dict) -> float | None:
 #: (machine edge + resource acquire/release counters, G025's ground
 #: truth) — same both-directions skip: artifacts written before the
 #: block existed diff cleanly against runs that carry it.
+#: ``ranges`` is the graftlint v6 value-range block (declared range
+#: check + mask-consumer counters, G029's ground truth) — again
+#: presence-mismatch is a skip-with-note, never a failure.
 _OPTIONAL_BLOCKS = ("timeseries", "anomalies", "replication",
                     "convergence", "reqtrace", "slo", "flight",
                     "recovery", "residency", "fs_ops", "ingest",
-                    "knee", "construction", "reshard", "lifecycle")
+                    "knee", "construction", "reshard", "lifecycle",
+                    "ranges")
 
 
 def _tier_hit_rate(extra: dict) -> float | None:

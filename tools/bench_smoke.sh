@@ -52,9 +52,17 @@
 # (keyed residency edges + ownership checked live, the G025
 # cross-check green in both directions against the emitted lifecycle
 # block) and the lifecheck zero-leak headline (every declared machine
-# exercised, zero unreleased acquisitions at drain end) — and finally
-# the exhaustive crash-point enumeration harness (a crash at EVERY
-# mutating fs-op boundary must recover byte-verified).
+# exercised, zero unreleased acquisitions at drain end) — then the
+# graftlint v6 value-range legs: a drain under
+# CRDT_BENCH_SANITIZE_RANGES=1 (staged index/narrow-lane/PAD bounds
+# validated live on the host tensors, the G029 cross-check green in
+# both directions against the emitted ranges block) and the
+# dtype-edge adversarial headline (edgecheck --small: the structural
+# edge fleet through BOTH kernels, oracle- and cross-kernel
+# byte-identical, every boundary contract fuzz-rejected at its dtype
+# edges) — and finally the exhaustive crash-point enumeration harness
+# (a crash at EVERY mutating fs-op boundary must recover
+# byte-verified).
 #
 # The serve-stream family is the STREAMING-CONSTRUCTION smoke: the
 # same tiered fleet built LAZILY (--serve-stream: FleetSpec-derived
@@ -666,6 +674,48 @@ print(f"lifecycle leg: {edges} transitions across "
       f"{lc['resources']['rows']['acquire']} row acquisitions, zero "
       "unattributed, G025 clean both directions")
 PYEOF
+    # Range-sanitized leg (graftlint v6): the same drain under
+    # CRDT_BENCH_SANITIZE_RANGES=1 — every staged gather/scatter index,
+    # narrow uint16 lane, and PAD-masked operand is bounds-validated
+    # LIVE on the host tensors pre-dispatch (an out-of-range value
+    # raises a typed error at the staging callsite instead of XLA
+    # clamping it silently), and the artifact's ranges block is
+    # cross-checked by G029 in both directions: dead declared
+    # inrange=/mask= facts on armed surfaces and rogue runtime
+    # counters both fail the gate.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      CRDT_BENCH_SANITIZE_RANGES=1 \
+      python -m crdt_benches_tpu.bench.runner --family serve \
+        --serve-docs 24 --serve-mix mixed --serve-batch 16 \
+        --serve-macro 2 --serve-batch-chars 64 \
+        --serve-classes 256,1024,4096,8192,49152 \
+        --serve-slots 4,2,2,2,2 \
+        --serve-arrival-span 2 --serve-verify-sample 4 \
+        --serve-save-name serve_longhaul_rg_smoke
+    python -m crdt_benches_tpu.lint crdt_benches_tpu --select G029 \
+      --ranges-artifact bench_results/serve_longhaul_rg_smoke.json
+    python - <<'PYEOF'
+import json
+extras = [e["extra"] for e in json.load(open("bench_results/serve_longhaul_rg_smoke.json"))
+          if e.get("extra", {}).get("family") == "serve"]
+rg = extras[0]["ranges"]
+assert rg["sanitized"] and rg["staging"], rg
+for name in ("pool.write-row", "pool.macro-pos", "pool.macro-ids"):
+    assert rg["checks"].get(name, 0) > 0, (name, rg["checks"])
+assert rg["masks"].get("count-le-clamp", 0) > 0, rg["masks"]
+print(f"ranges leg: {sum(rg['checks'].values())} armed range checks "
+      f"across {len(rg['checks'])} declared facts, "
+      f"{sum(rg['masks'].values())} mask dispatches, G029 clean both "
+      "directions")
+PYEOF
+    # ...the value-range headline: the dtype-edge adversarial fleet
+    # (position extremes, empty churn, a zero-op all-PAD stream,
+    # exact-capacity landings, id pressure) drained ARMED through both
+    # kernels — every doc oracle- and cross-kernel byte-identical —
+    # plus the seeded differential fuzz of every @boundary contract at
+    # its dtype edges (each must reject every one-field perturbation).
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m crdt_benches_tpu.serve.edgecheck --small
     # ...the lifecycle headline: the churn-heavy protocol-complete
     # lifecheck drain (journaled churn + reshard + live ingest front,
     # then a record-evict streaming drain) armed end to end, requiring
